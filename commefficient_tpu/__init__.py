@@ -1,0 +1,25 @@
+"""commefficient_tpu — TPU-native communication-efficient federated learning.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+CommEfficient framework (FetchSGD / sketched-SGD line): a parameter server
+holding the global model as a flat weight vector, simulated federated clients
+computing (optionally compressed) updates, summed with XLA collectives over a
+TPU device mesh and applied server-side with error feedback and virtual
+momentum.
+
+Architecture (vs. reference layer map, SURVEY.md §1):
+  - L0 distributed substrate: one JAX process per host + ``jax.sharding.Mesh``;
+    the reference's NCCL reduce (fed_worker.py:136-138) becomes ``lax.psum``
+    over ICI inside ``shard_map``; mp.Queue/shared-memory disappear — clients
+    are vmapped shards of a single SPMD program.
+  - L2 compression: pure-JAX + Pallas count-sketch (``ops.sketch``) replacing
+    the external ``csvec`` CUDA-backed package; ``ops.topk``.
+  - L3 worker runtime: pure functions in ``federated.worker`` (vmapped over
+    clients) replacing fed_worker.py's per-process loop.
+  - L4 federated core: ``federated.server`` update rules + ``FedModel`` /
+    ``FedOptimizer`` API shells in ``federated.aggregator``.
+  - L1 data/models: ``data_utils`` (FedDataset family, FedSampler) and
+    ``models`` (flax ResNet/Fixup/GPT-2 zoo).
+"""
+
+__version__ = "0.1.0"

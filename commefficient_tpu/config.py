@@ -1,0 +1,133 @@
+"""CLI / config surface.
+
+Flag-for-flag parity with the reference CLI (reference utils.py:102-230): same
+names, dests, choices and defaults, so recipes written against the reference
+drive this framework unchanged. TPU-specific deviations, all documented here:
+
+- ``--device`` accepts ``{tpu, cpu}`` (auto-detected default) instead of
+  ``{cuda, cpu}``.
+- ``--num_devices`` means the size of the JAX device mesh the round is
+  shard_map'ed over (default: all visible devices), not "number of GPUs"; there
+  is no parameter-server device, so ``--share_ps_gpu`` is accepted and ignored.
+- ``--port`` is accepted for compatibility but unused: there is no NCCL
+  process group to rendezvous (the collective is an XLA ``psum`` over ICI).
+
+``parse_args`` also enforces the reference's fedavg invariants
+(reference utils.py:225-228).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+MODES = ["sketch", "true_topk", "local_topk", "fedavg", "uncompressed"]
+ERROR_TYPES = ["none", "local", "virtual"]
+DP_MODES = ["worker", "server"]
+
+
+def _model_names():
+    from commefficient_tpu import models
+
+    return [m for m in dir(models) if not m.startswith("__") and m[0].isupper()]
+
+
+def _dataset_names():
+    from commefficient_tpu.data_utils import fed_datasets
+
+    return list(fed_datasets.keys())
+
+
+def build_parser(default_lr=None) -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser()
+
+    # meta-args
+    parser.add_argument("--test", action="store_true", dest="do_test")
+    parser.add_argument("--mode", choices=MODES, default="sketch")
+    parser.add_argument("--tensorboard", dest="use_tensorboard", action="store_true")
+    parser.add_argument("--seed", type=int, default=21)
+
+    # data/model args
+    parser.add_argument("--model", default="ResNet9", choices=_model_names(),
+                        help="Name of the model.")
+    parser.add_argument("--finetune", action="store_true", dest="do_finetune")
+    parser.add_argument("--checkpoint", action="store_true", dest="do_checkpoint")
+    parser.add_argument("--checkpoint_path", type=str, default="./checkpoint")
+    parser.add_argument("--finetune_path", type=str, default="./finetune")
+    parser.add_argument("--finetuned_from", type=str, choices=_dataset_names(),
+                        help="Name of the dataset you pretrained on.")
+    parser.add_argument("--num_results_train", type=int, default=2)
+    parser.add_argument("--num_results_val", type=int, default=2)
+    parser.add_argument("--dataset_name", type=str, default="",
+                        choices=_dataset_names() + [""])
+    parser.add_argument("--dataset_dir", type=str, default="./dataset")
+    parser.add_argument("--batchnorm", action="store_true", dest="do_batchnorm")
+    parser.add_argument("--nan_threshold", type=float, default=999)
+
+    # compression args
+    parser.add_argument("--k", type=int, default=50000)
+    parser.add_argument("--num_cols", type=int, default=500000)
+    parser.add_argument("--num_rows", type=int, default=5)
+    parser.add_argument("--num_blocks", type=int, default=20)
+    parser.add_argument("--topk_down", action="store_true", dest="do_topk_down")
+
+    # optimization args
+    parser.add_argument("--local_momentum", type=float, default=0.9)
+    parser.add_argument("--virtual_momentum", type=float, default=0)
+    parser.add_argument("--weight_decay", type=float, default=5e-4)
+    parser.add_argument("--num_epochs", type=float, default=24)
+    parser.add_argument("--num_fedavg_epochs", type=int, default=1)
+    parser.add_argument("--fedavg_batch_size", type=int, default=-1)
+    parser.add_argument("--fedavg_lr_decay", type=float, default=1)
+    parser.add_argument("--error_type", choices=ERROR_TYPES, default="none")
+    parser.add_argument("--lr_scale", type=float, default=default_lr)
+    parser.add_argument("--pivot_epoch", type=float, default=5)
+
+    # parallelization args
+    parser.add_argument("--port", type=int, default=5315,
+                        help="Unused on TPU (kept for CLI compatibility).")
+    parser.add_argument("--num_clients", type=int)
+    parser.add_argument("--num_workers", type=int, default=1,
+                        help="Clients sampled per round (reference semantics).")
+    parser.add_argument("--device", type=str, choices=["cpu", "tpu"], default=None,
+                        help="Platform; default = whatever JAX auto-detects.")
+    parser.add_argument("--num_devices", type=int, default=-1,
+                        help="Mesh size; -1 = all visible JAX devices.")
+    parser.add_argument("--share_ps_gpu", action="store_true",
+                        help="Unused on TPU (no separate PS device).")
+    parser.add_argument("--iid", action="store_true", dest="do_iid")
+    parser.add_argument("--train_dataloader_workers", type=int, default=0)
+    parser.add_argument("--val_dataloader_workers", type=int, default=0)
+
+    # GPT2 args
+    parser.add_argument("--model_checkpoint", type=str, default="gpt2")
+    parser.add_argument("--num_candidates", type=int, default=2)
+    parser.add_argument("--max_history", type=int, default=2)
+    parser.add_argument("--local_batch_size", type=int, default=8)
+    parser.add_argument("--valid_batch_size", type=int, default=8)
+    parser.add_argument("--microbatch_size", type=int, default=-1)
+    parser.add_argument("--lm_coef", type=float, default=1.0)
+    parser.add_argument("--mc_coef", type=float, default=1.0)
+    parser.add_argument("--max_grad_norm", type=float)
+    parser.add_argument("--personality_permutations", type=int, default=1)
+    parser.add_argument("--eval_before_start", action="store_true")
+
+    # Differential Privacy args
+    parser.add_argument("--dp", action="store_true", dest="do_dp")
+    parser.add_argument("--dp_mode", choices=DP_MODES, default="worker")
+    parser.add_argument("--l2_norm_clip", type=float, default=1.0)
+    parser.add_argument("--noise_multiplier", type=float, default=0.0)
+
+    return parser
+
+
+def validate_args(args):
+    if args.mode == "fedavg":
+        assert args.local_batch_size == -1, "fedavg requires local_batch_size == -1"
+        assert args.local_momentum == 0, "fedavg requires local_momentum == 0"
+        assert args.error_type == "none", "fedavg requires error_type == none"
+    return args
+
+
+def parse_args(default_lr=None, argv=None):
+    args = build_parser(default_lr).parse_args(argv)
+    return validate_args(args)

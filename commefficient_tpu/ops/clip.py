@@ -1,0 +1,20 @@
+"""L2-norm clipping (for DP and max_grad_norm).
+
+Parity with reference ``clip_grad`` (reference utils.py:305-313): scale the
+record down so its L2 norm is at most ``l2_norm_clip``; records already inside
+the ball are untouched. ``norm`` can be supplied externally — the sketch-space
+caller passes the count-sketch ``l2estimate`` the way the reference calls
+``record.l2estimate()`` when the record is a CSVec.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def clip_by_l2(record: jax.Array, l2_norm_clip, norm=None) -> jax.Array:
+    if norm is None:
+        norm = jnp.linalg.norm(record)
+    scale = jnp.where(norm <= l2_norm_clip, 1.0, l2_norm_clip / jnp.maximum(norm, 1e-12))
+    return record * scale

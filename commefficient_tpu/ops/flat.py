@@ -1,0 +1,33 @@
+"""Flat-parameter-vector plumbing.
+
+The reference keeps the authoritative model as a flat float vector and
+scatters/gathers it into the torch module per step (``get_param_vec`` /
+``set_param_vec``, reference utils.py:281-297). In JAX the idiomatic
+equivalent is ``jax.flatten_util.ravel_pytree``: ravel once at init to obtain
+the flat vector and a closed-over ``unravel`` function; the forward pass
+unravels under jit, where XLA turns the reshape/slice into free views.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree as _ravel_pytree
+
+
+def ravel_pytree(params: Any) -> Tuple[jax.Array, Callable[[jax.Array], Any]]:
+    """Flatten a parameter pytree into a float32 vector + unravel closure."""
+    flat, unravel = _ravel_pytree(params)
+    return flat.astype(jnp.float32), unravel
+
+
+def make_unravel(params: Any) -> Tuple[int, Callable[[jax.Array], Any]]:
+    """Return (grad_size, unravel) for a template pytree.
+
+    ``grad_size`` is the reference's count of trainable scalars
+    (reference fed_aggregator.py:81-88).
+    """
+    flat, unravel = _ravel_pytree(params)
+    return int(flat.size), unravel

@@ -1,0 +1,153 @@
+"""Count-sketch compression (the CSVec replacement), pure JAX.
+
+Re-implements the capability surface of the external ``csvec`` package the
+reference depends on (used at reference fed_aggregator.py:5,464-467,584-611 and
+fed_worker.py:10,313-320):
+
+- sketch a d-dim vector into an ``(r, c)`` table with r independent bucket
+  hashes and ±1 sign hashes  (``CSVec.accumulateVec``  → ``sketch_vec``)
+- tables are linear: sum of sketches == sketch of sum
+  (``CSVec.accumulateTable`` → plain ``+`` on tables)
+- recover the top-k heavy hitters via median-of-rows estimation
+  (``CSVec.unSketch(k)``    → ``unsketch``)
+- L2-norm estimate of the sketched vector (``CSVec.l2estimate``)
+- block decomposition bounding peak memory (``numBlocks`` → ``num_blocks``)
+
+Design deviation (deliberate, documented): CSVec draws bucket/sign hashes from
+polynomial hash families mod the Mersenne prime 2**61-1 in int64 — int64
+multiplies that are emulated and slow on TPU. We instead derive both hashes
+from the murmur3 32-bit finalizer (xor-shift/multiply avalanche) keyed per row
+and per seed: pure uint32 VPU arithmetic, empirically indistinguishable
+collision behavior for sketching, and identical API semantics. Hash identity
+is fully determined by ``(seed, r, c, d)``, so two sketches built with the
+same geometry are mergeable, which is what FetchSGD's linearity argument
+requires.
+
+All compute paths are chunked over the coordinate axis (``num_blocks`` chunks)
+so the transient hash tensors stay bounded for GPT-2-scale d≈1.2e8, and are
+jit/vmap/shard_map-safe (static shapes, no data-dependent control flow).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+_M1 = np.uint32(0x85EBCA6B)
+_M2 = np.uint32(0xC2B2AE35)
+
+
+def _mix32(x: jax.Array) -> jax.Array:
+    """murmur3 fmix32 avalanche over uint32."""
+    x = x ^ (x >> 16)
+    x = x * _M1
+    x = x ^ (x >> 13)
+    x = x * _M2
+    x = x ^ (x >> 16)
+    return x
+
+
+@struct.dataclass
+class CountSketch:
+    """Hash geometry for a count-sketch. A pytree; static ints are aux data."""
+
+    row_keys: jax.Array  # (r,) uint32 — per-row hash keys derived from seed
+    sign_keys: jax.Array  # (r,) uint32
+    d: int = struct.field(pytree_node=False)
+    c: int = struct.field(pytree_node=False)
+    r: int = struct.field(pytree_node=False)
+    num_blocks: int = struct.field(pytree_node=False)
+
+    @property
+    def table_shape(self):
+        return (self.r, self.c)
+
+
+def make_sketch(d: int, c: int, r: int, seed: int = 42, num_blocks: int = 20) -> CountSketch:
+    """Build sketch geometry (mirrors ``args2sketch``, reference
+    fed_aggregator.py:464-467). Host-side, deterministic in ``seed``."""
+    rng = np.random.RandomState(seed)
+    keys = rng.randint(1, 2**32 - 1, size=(2, r), dtype=np.uint64).astype(np.uint32)
+    num_blocks = max(1, min(num_blocks, d))
+    return CountSketch(
+        row_keys=jnp.asarray(keys[0]),
+        sign_keys=jnp.asarray(keys[1]),
+        d=int(d),
+        c=int(c),
+        r=int(r),
+        num_blocks=int(num_blocks),
+    )
+
+
+def _chunking(cs: CountSketch):
+    chunk = -(-cs.d // cs.num_blocks)  # ceil
+    padded = chunk * cs.num_blocks
+    return chunk, padded
+
+
+def _buckets_signs(cs: CountSketch, idx: jax.Array):
+    """Hashes for coordinate indices ``idx`` (uint32 ``(n,)``).
+
+    Returns buckets ``(r, n)`` int32 in [0, c) and signs ``(r, n)`` float32 ±1.
+    """
+    h = _mix32(idx[None, :] ^ cs.row_keys[:, None])
+    buckets = (h % np.uint32(cs.c)).astype(jnp.int32)
+    s = _mix32(idx[None, :] ^ cs.sign_keys[:, None])
+    signs = ((s & np.uint32(1)).astype(jnp.float32) * 2.0) - 1.0
+    return buckets, signs
+
+
+def sketch_vec(cs: CountSketch, v: jax.Array) -> jax.Array:
+    """Accumulate a dense ``(d,)`` vector into an ``(r, c)`` table.
+
+    Equivalent of ``CSVec.accumulateVec`` + ``.table`` (reference
+    fed_worker.py:313-320). Linear in ``v``.
+    """
+    chunk, padded = _chunking(cs)
+    v_p = jnp.pad(v.astype(jnp.float32), (0, padded - cs.d))
+
+    def body(i, table):
+        start = i * chunk
+        idx = (start + jnp.arange(chunk, dtype=jnp.uint32)).astype(jnp.uint32)
+        vals = jax.lax.dynamic_slice(v_p, (start,), (chunk,))
+        buckets, signs = _buckets_signs(cs, idx)
+        contrib = jax.vmap(
+            lambda b, sv: jnp.zeros((cs.c,), jnp.float32).at[b].add(sv)
+        )(buckets, signs * vals[None, :])
+        return table + contrib
+
+    init = jnp.zeros((cs.r, cs.c), jnp.float32)
+    return jax.lax.fori_loop(0, cs.num_blocks, body, init)
+
+
+def estimates(cs: CountSketch, table: jax.Array) -> jax.Array:
+    """Median-of-rows unbiased estimate of every coordinate — ``(d,)``."""
+    chunk, padded = _chunking(cs)
+
+    def body(start, _):
+        idx = (start + jnp.arange(chunk, dtype=jnp.uint32)).astype(jnp.uint32)
+        buckets, signs = _buckets_signs(cs, idx)
+        vals = jnp.take_along_axis(table, buckets, axis=1) * signs  # (r, chunk)
+        return start + chunk, jnp.median(vals, axis=0)
+
+    starts = jnp.uint32(0)
+    _, est = jax.lax.scan(body, starts, None, length=cs.num_blocks)
+    return est.reshape(padded)[: cs.d]
+
+
+def unsketch(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:
+    """Dense ``(d,)`` vector holding the estimated values of the k
+    largest-magnitude coordinates, zero elsewhere (``CSVec.unSketch(k)``,
+    reference fed_aggregator.py:590)."""
+    from commefficient_tpu.ops.topk import topk
+
+    return topk(estimates(cs, table), k)
+
+
+def l2estimate(table: jax.Array) -> jax.Array:
+    """Median-of-rows estimate of the sketched vector's L2 norm
+    (``CSVec.l2estimate``, used via reference utils.py:305-313)."""
+    return jnp.sqrt(jnp.median(jnp.sum(jnp.square(table), axis=1)))

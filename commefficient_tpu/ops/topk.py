@@ -1,0 +1,30 @@
+"""Magnitude top-k sparsification.
+
+Parity with the reference's ``_topk`` (reference utils.py:232-252): keep the k
+largest-magnitude coordinates of a vector (or of each row of a matrix), zero
+the rest. Uses ``jax.lax.top_k`` — XLA's native implementation — instead of
+the reference's CUDA workaround for NaN-poisoned ``torch.topk`` output.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _topk_1d(vec: jax.Array, k: int) -> jax.Array:
+    _, idx = jax.lax.top_k(jnp.square(vec), k)
+    return jnp.zeros_like(vec).at[idx].set(vec[idx])
+
+
+def topk(vec: jax.Array, k: int) -> jax.Array:
+    """Dense vector with only the k largest-magnitude entries kept.
+
+    Accepts 1-D ``(d,)`` or 2-D ``(rows, d)`` input (row-wise top-k), mirroring
+    reference utils.py:246-252.
+    """
+    if vec.ndim == 1:
+        return _topk_1d(vec, k)
+    if vec.ndim == 2:
+        return jax.vmap(lambda v: _topk_1d(v, k))(vec)
+    raise ValueError(f"topk supports 1-D or 2-D input, got ndim={vec.ndim}")
