@@ -1,0 +1,170 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.federated.server import (
+    ServerConfig,
+    ServerState,
+    init_server_state,
+    mask_client_velocities,
+    server_update,
+)
+from commefficient_tpu.ops.sketch import make_sketch, sketch_vec
+
+
+def _dense_cfg(mode, **kw):
+    return ServerConfig(mode=mode, grad_size=8, **kw)
+
+
+class TestUncompressed:
+    def test_momentum_recursion(self):
+        """v_t = g_t + rho*v_{t-1}; update = lr * v_t (closed form,
+        reference fed_aggregator.py:497-509)."""
+        cfg = _dense_cfg("uncompressed", virtual_momentum=0.5)
+        state = init_server_state(cfg)
+        g1 = jnp.arange(8.0)
+        u1, state = server_update(g1, state, cfg, lr=0.1)
+        np.testing.assert_allclose(u1, 0.1 * g1, rtol=1e-6)
+        g2 = jnp.ones(8)
+        u2, state = server_update(g2, state, cfg, lr=0.1)
+        np.testing.assert_allclose(u2, 0.1 * (g2 + 0.5 * g1), rtol=1e-6)
+
+    def test_vector_lr(self):
+        cfg = _dense_cfg("uncompressed")
+        state = init_server_state(cfg)
+        lr_vec = jnp.linspace(0.1, 0.8, 8)
+        u, _ = server_update(jnp.ones(8), state, cfg, lr=lr_vec)
+        np.testing.assert_allclose(u, lr_vec, rtol=1e-6)
+
+    def test_server_dp_noise(self):
+        cfg = _dense_cfg("uncompressed", do_dp=True, dp_mode="server",
+                         noise_multiplier=1.0)
+        state = init_server_state(cfg)
+        u, _ = server_update(jnp.zeros(8), state, cfg, lr=1.0,
+                             rng=jax.random.key(0))
+        assert float(jnp.abs(u).sum()) > 0  # noise was added
+
+
+class TestFedavg:
+    def test_update_is_velocity(self):
+        cfg = _dense_cfg("fedavg", virtual_momentum=0.9)
+        state = init_server_state(cfg)
+        d1 = jnp.ones(8)
+        u1, state = server_update(d1, state, cfg, lr=1)
+        np.testing.assert_allclose(u1, d1)
+        u2, state = server_update(d1, state, cfg, lr=1)
+        np.testing.assert_allclose(u2, d1 * 1.9, rtol=1e-6)
+
+    def test_config_legality(self):
+        with pytest.raises(AssertionError):
+            ServerConfig(mode="fedavg", error_type="local")
+        with pytest.raises(AssertionError):
+            ServerConfig(mode="fedavg", local_momentum=0.9)
+
+
+class TestTrueTopk:
+    def test_requires_virtual_error(self):
+        with pytest.raises(AssertionError):
+            ServerConfig(mode="true_topk", error_type="none")
+
+    def test_error_feedback_carries_residual(self):
+        """Coordinates not selected accumulate in Verror and win later
+        (reference fed_aggregator.py:511-542)."""
+        cfg = _dense_cfg("true_topk", error_type="virtual", k=1)
+        state = init_server_state(cfg)
+        g = jnp.array([1.0, 0.6, 0.0, 0, 0, 0, 0, 0])
+        u1, state = server_update(g, state, cfg, lr=1.0)
+        # round 1: coord 0 wins, coord 1 residual 0.6 retained
+        np.testing.assert_allclose(u1, [1, 0, 0, 0, 0, 0, 0, 0])
+        np.testing.assert_allclose(state.error[1], 0.6, rtol=1e-6)
+        assert state.error[0] == 0  # fed back
+        # round 2: coord 1 has 0.6 + 0.6 = 1.2 and beats fresh 1.0 at coord 0?
+        # (no: g again puts 1.0 on coord 0, error has 0.6+g[1]=1.2 on coord 1)
+        u2, state = server_update(g, state, cfg, lr=1.0)
+        np.testing.assert_allclose(u2, [0, 1.2, 0, 0, 0, 0, 0, 0], rtol=1e-6)
+
+    def test_velocity_masking(self):
+        cfg = _dense_cfg("true_topk", error_type="virtual", k=1,
+                         virtual_momentum=0.9)
+        state = init_server_state(cfg)
+        g = jnp.array([5.0, 1, 0, 0, 0, 0, 0, 0])
+        _, state = server_update(g, state, cfg, lr=1.0)
+        assert state.velocity[0] == 0  # masked at selected coord
+        np.testing.assert_allclose(state.velocity[1], 1.0)
+
+
+class TestLocalTopk:
+    def test_passthrough_with_momentum(self):
+        cfg = _dense_cfg("local_topk", error_type="local", virtual_momentum=0.5)
+        state = init_server_state(cfg)
+        g = jnp.array([0.0, 2, 0, 0, 0, 0, 0, -1])
+        u1, state = server_update(g, state, cfg, lr=2.0)
+        np.testing.assert_allclose(u1, 2.0 * g)
+        u2, state = server_update(g, state, cfg, lr=2.0)
+        np.testing.assert_allclose(u2, 2.0 * 1.5 * g, rtol=1e-6)
+        # Verror untouched
+        np.testing.assert_allclose(state.error, 0.0)
+
+
+class TestSketched:
+    def _roundtrip(self, error_type, **kw):
+        d = 512
+        sk = make_sketch(d=d, c=1024, r=5, seed=7, num_blocks=2)
+        cfg = ServerConfig(mode="sketch", error_type=error_type, k=2,
+                           grad_size=d, **kw)
+        state = init_server_state(cfg, sk)
+        g = np.zeros(d, np.float32)
+        g[10], g[100] = 4.0, -3.0
+        g[200] = 0.5  # below-k residual
+        table = sketch_vec(sk, jnp.asarray(g))
+        return cfg, sk, state, g, table
+
+    def test_heavy_hitters_recovered(self):
+        cfg, sk, state, g, table = self._roundtrip("virtual")
+        u, state = server_update(table, state, cfg, lr=1.0, sketch=sk)
+        nz = set(np.nonzero(np.asarray(u))[0])
+        assert nz == {10, 100}
+        np.testing.assert_allclose(np.asarray(u)[[10, 100]], [4.0, -3.0],
+                                   rtol=1e-4)
+
+    def test_virtual_error_residual_carries(self):
+        cfg, sk, state, g, table = self._roundtrip("virtual")
+        _, state = server_update(table, state, cfg, lr=1.0, sketch=sk)
+        # error table should still contain the 0.5 residual at coord 200:
+        # feed a zero gradient a few times; the residual accumulates and
+        # eventually surfaces in the update
+        zero_t = jnp.zeros_like(table)
+        surfaced = False
+        for _ in range(4):
+            u, state = server_update(zero_t, state, cfg, lr=1.0, sketch=sk)
+            if np.asarray(u)[200] != 0:
+                surfaced = True
+                break
+        assert surfaced
+
+    def test_local_error_aliasing(self):
+        """After masking, error and velocity must be the same array —
+        reproducing the torch aliasing of reference fed_aggregator.py:580."""
+        cfg, sk, state, g, table = self._roundtrip("local", local_momentum=0.9)
+        _, state = server_update(table, state, cfg, lr=1.0, sketch=sk)
+        np.testing.assert_array_equal(np.asarray(state.error),
+                                      np.asarray(state.velocity))
+
+    def test_mutual_exclusion_asserts(self):
+        with pytest.raises(AssertionError):
+            ServerConfig(mode="sketch", error_type="local", virtual_momentum=0.9)
+        with pytest.raises(AssertionError):
+            ServerConfig(mode="sketch", error_type="virtual", local_momentum=0.9)
+
+
+class TestClientVelocityMasking:
+    def test_masks_only_participating_rows(self):
+        cv = jnp.ones((4, 6))
+        update = jnp.array([1.0, 0, 0, 2.0, 0, 0])
+        ids = jnp.array([1, 3])
+        out = np.asarray(mask_client_velocities(cv, ids, update))
+        np.testing.assert_allclose(out[0], 1.0)
+        np.testing.assert_allclose(out[2], 1.0)
+        np.testing.assert_allclose(out[1], [0, 1, 1, 0, 1, 1])
+        np.testing.assert_allclose(out[3], [0, 1, 1, 0, 1, 1])
