@@ -1,0 +1,40 @@
+"""Data layer: client-partitioned datasets, sampler, loader, transforms.
+
+``fed_datasets`` mirrors the reference's registry of dataset name →
+num_classes (reference utils.py:37-44).
+"""
+
+from commefficient_tpu.data_utils.fed_dataset import FedDataset
+from commefficient_tpu.data_utils.fed_cifar import FedCIFAR10, FedCIFAR100
+from commefficient_tpu.data_utils.fed_emnist import FedEMNIST
+from commefficient_tpu.data_utils.fed_imagenet import FedImageNet
+from commefficient_tpu.data_utils.fed_sampler import FedSampler
+from commefficient_tpu.data_utils.loader import FedLoader, cv_collate
+from commefficient_tpu.data_utils import transforms
+
+fed_datasets = {
+    "CIFAR10": 10,
+    "CIFAR100": 100,
+    "EMNIST": 62,
+    "ImageNet": 1000,
+    "PERSONA": -1,
+}
+
+
+def num_classes_of_dataset(dataset_name):
+    return fed_datasets[dataset_name]
+
+
+__all__ = [
+    "FedDataset",
+    "FedCIFAR10",
+    "FedCIFAR100",
+    "FedEMNIST",
+    "FedImageNet",
+    "FedSampler",
+    "FedLoader",
+    "cv_collate",
+    "transforms",
+    "fed_datasets",
+    "num_classes_of_dataset",
+]

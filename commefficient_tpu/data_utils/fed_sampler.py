@@ -1,0 +1,65 @@
+"""FedSampler — random client sampling with per-client cursors.
+
+Parity with reference data_utils/fed_sampler.py:5-71: shuffle within each
+client, then per step sample ``num_workers`` clients uniformly without
+replacement from the non-exhausted set and take ``local_batch_size`` (or all
+remaining, when -1) items from each; an epoch ends when every client is
+exhausted.
+
+``__iter__`` yields flat index arrays exactly like the reference;
+``iter_structured`` additionally yields (client_ids, list-of-index-arrays) so
+the TPU loader can build static-shaped client-major batches without
+re-deriving the client split.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["FedSampler"]
+
+
+class FedSampler:
+    def __init__(self, dataset, num_workers, local_batch_size,
+                 shuffle_clients=True):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.local_batch_size = local_batch_size
+        self.shuffle_clients = shuffle_clients
+
+    def _gen(self, structured):
+        data_per_client = np.asarray(self.dataset.data_per_client)
+        cumsum = np.hstack([[0], np.cumsum(data_per_client)])
+        permuted = np.hstack([
+            s + np.random.permutation(n)
+            for s, n in zip(cumsum, data_per_client)
+        ]) if len(data_per_client) else np.array([], dtype=int)
+        cursor = np.zeros(self.dataset.num_clients, dtype=np.int64)
+
+        while True:
+            alive = np.where(cursor < data_per_client)[0]
+            if len(alive) == 0:
+                return
+            n = min(self.num_workers, len(alive))
+            workers = np.random.choice(alive, n, replace=False)
+            remaining = data_per_client[workers] - cursor[workers]
+            if self.local_batch_size == -1:
+                sizes = remaining
+            else:
+                sizes = np.clip(remaining, 0, self.local_batch_size)
+            starts = cumsum[workers] + cursor[workers]
+            per_client = [permuted[s:s + sz] for s, sz in zip(starts, sizes)]
+            if structured:
+                yield workers, per_client
+            else:
+                yield np.hstack(per_client)
+            cursor[workers] += sizes
+
+    def __iter__(self):
+        return self._gen(structured=False)
+
+    def iter_structured(self):
+        return self._gen(structured=True)
+
+    def __len__(self):
+        return len(self.dataset)

@@ -1,0 +1,128 @@
+"""FedLoader — static-shaped, client-major batch assembly for XLA.
+
+The reference's DataLoader emits flat ragged batches with per-datum client
+ids, which the PS re-splits per client and ships over queues (reference
+fed_aggregator.py:217-224). XLA wants fixed shapes, so the loader builds the
+client-major layout directly from ``FedSampler.iter_structured``:
+
+  train round batch: {
+    client_ids:  (W,)  int32   sampled client per worker slot
+    worker_mask: (W,)  float32 1.0 for real slots, 0.0 for padding
+    inputs:      (W, B, ...)   transformed model inputs
+    targets:     (W, B)        int32
+    mask:        (W, B)        float32 per-datum validity
+  }
+
+where W = num_workers and B = local_batch_size (or the max client size when
+local_batch_size == -1, the fedavg whole-client mode). Padded slots/datums
+carry zero masks; the worker computes data-weighted sums so they contribute
+nothing — replacing the reference's skip/assert handling of ragged tails.
+
+Val batches are flat: {inputs: (B, ...), targets: (B,), mask: (B,)} with the
+client_id −1 sentinel implied (no per-client state on the val path,
+reference fed_aggregator.py:337-364).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["FedLoader", "cv_collate"]
+
+
+def cv_collate(items):
+    """items: list of (image, target) → stacked arrays."""
+    images = np.stack([np.asarray(i, np.float32) for i, _ in items])
+    targets = np.asarray([t for _, t in items], np.int64)
+    return {"inputs": images, "targets": targets}
+
+
+class FedLoader:
+    def __init__(self, dataset, num_workers=1, local_batch_size=8,
+                 collate_fn=cv_collate, val_batch_size=None):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.local_batch_size = local_batch_size
+        self.collate_fn = collate_fn
+        self.val_batch_size = val_batch_size or 64
+        self.train = dataset.type == "train"
+        if self.train:
+            from commefficient_tpu.data_utils.fed_sampler import FedSampler
+
+            self.sampler = FedSampler(dataset, num_workers, local_batch_size)
+
+    @property
+    def batch_pad(self) -> int:
+        if self.local_batch_size == -1:
+            return int(np.max(self.dataset.data_per_client))
+        return self.local_batch_size
+
+    def steps_per_epoch(self) -> int:
+        # reference utils.py:315-321
+        if self.local_batch_size == -1:
+            return int(self.dataset.num_clients // self.num_workers)
+        return int(np.ceil(len(self.dataset)
+                           / (self.local_batch_size * self.num_workers)))
+
+    def __len__(self):
+        if self.train:
+            return self.steps_per_epoch()
+        return int(np.ceil(len(self.dataset) / self.val_batch_size))
+
+    def _fetch(self, idx_list):
+        items = []
+        for i in idx_list:
+            cid, *rest = self.dataset[int(i)]
+            items.append(tuple(rest))
+        return self.collate_fn(items)
+
+    def __iter__(self):
+        if self.train:
+            yield from self._train_iter()
+        else:
+            yield from self._val_iter()
+
+    def _train_iter(self):
+        W, B = self.num_workers, self.batch_pad
+        for workers, idx_lists in self.sampler.iter_structured():
+            n = len(workers)
+            client_ids = np.zeros(W, np.int32)
+            client_ids[:n] = workers
+            worker_mask = np.zeros(W, np.float32)
+            worker_mask[:n] = 1.0
+            mask = np.zeros((W, B), np.float32)
+            batch_cols = None
+            for w, idxs in enumerate(idx_lists):
+                cols = self._fetch(idxs)
+                if batch_cols is None:
+                    batch_cols = {
+                        k: np.zeros((W, B) + v.shape[1:], v.dtype)
+                        for k, v in cols.items()
+                    }
+                b = len(idxs)
+                mask[w, :b] = 1.0
+                for k, v in cols.items():
+                    batch_cols[k][w, :b] = v
+            batch = dict(batch_cols)
+            batch["client_ids"] = client_ids
+            batch["worker_mask"] = worker_mask
+            batch["mask"] = mask
+            yield batch
+
+    def _val_iter(self):
+        N = len(self.dataset)
+        B = self.val_batch_size
+        for start in range(0, N, B):
+            idxs = range(start, min(start + B, N))
+            cols = self._fetch(idxs)
+            n = len(cols["targets"])
+            mask = np.zeros(B, np.float32)
+            mask[:n] = 1.0
+            batch = {
+                k: np.concatenate(
+                    [v, np.zeros((B - n,) + v.shape[1:], v.dtype)], axis=0)
+                if n < B else v
+                for k, v in cols.items()
+            }
+            batch["mask"] = mask
+            yield batch

@@ -1,0 +1,223 @@
+"""Per-dataset augmentation stacks, numpy host-side.
+
+Parity with reference data_utils/transforms.py:17-75 (torchvision Compose
+stacks) re-implemented on numpy HWC arrays so the device only ever sees
+ready, normalized float32 batches. Each transform maps a single HWC uint8
+image → float32 CHW? No — HWC float32 (TPU-native NHWC layout).
+
+Stacks:
+- CIFAR10/100 train: random crop 32 w/ reflect-pad 4, random horizontal flip,
+  normalize (per-channel mean/std).
+- FEMNIST train: random crop 28 w/ constant-pad 2 (fill 1.0), random resized
+  crop scale (0.8, 1.2) ratio (4/5, 5/4), random rotation ±5° (fill 1.0),
+  normalize.
+- ImageNet train: random resized crop 224, horizontal flip, normalize; val:
+  resize 256 + center crop 224.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "cifar10_train_transforms",
+    "cifar10_test_transforms",
+    "cifar100_train_transforms",
+    "cifar100_test_transforms",
+    "femnist_train_transforms",
+    "femnist_test_transforms",
+    "imagenet_train_transforms",
+    "imagenet_val_transforms",
+    "Compose",
+]
+
+cifar10_mean = np.array((0.4914, 0.4822, 0.4465), np.float32)
+cifar10_std = np.array((0.2471, 0.2435, 0.2616), np.float32)
+cifar100_mean = np.array((0.5071, 0.4867, 0.4408), np.float32)
+cifar100_std = np.array((0.2675, 0.2565, 0.2761), np.float32)
+femnist_mean = np.array((0.9637,), np.float32)
+femnist_std = np.array((0.1597,), np.float32)
+imagenet_mean = np.array((0.485, 0.456, 0.406), np.float32)
+imagenet_std = np.array((0.229, 0.224, 0.225), np.float32)
+
+
+class Compose:
+    def __init__(self, fns):
+        self.fns = fns
+
+    def __call__(self, img):
+        for f in self.fns:
+            img = f(img)
+        return img
+
+
+def _ensure_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def to_float(img):
+    """uint8 [0,255] or float [0,1] → float32 [0,1] HWC."""
+    img = _ensure_hwc(img)
+    if img.dtype == np.uint8:
+        return img.astype(np.float32) / 255.0
+    return img.astype(np.float32)
+
+
+class Normalize:
+    def __init__(self, mean, std):
+        self.mean, self.std = mean, std
+
+    def __call__(self, img):
+        return (img - self.mean) / self.std
+
+
+class RandomCrop:
+    def __init__(self, size, padding, mode="reflect", fill=0.0):
+        self.size, self.padding, self.mode, self.fill = size, padding, mode, fill
+
+    def __call__(self, img):
+        p = self.padding
+        if self.mode == "reflect":
+            img = np.pad(img, ((p, p), (p, p), (0, 0)), mode="reflect")
+        else:
+            img = np.pad(img, ((p, p), (p, p), (0, 0)), mode="constant",
+                         constant_values=self.fill)
+        h = np.random.randint(0, img.shape[0] - self.size + 1)
+        w = np.random.randint(0, img.shape[1] - self.size + 1)
+        return img[h:h + self.size, w:w + self.size]
+
+
+class RandomHorizontalFlip:
+    def __call__(self, img):
+        if np.random.rand() < 0.5:
+            return img[:, ::-1].copy()
+        return img
+
+
+def _resize_bilinear(img, out_h, out_w):
+    """Minimal bilinear resize for HWC float arrays (host-side, small images)."""
+    in_h, in_w = img.shape[:2]
+    if (in_h, in_w) == (out_h, out_w):
+        return img
+    ys = (np.arange(out_h) + 0.5) * in_h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * in_w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, in_h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, in_w - 1)
+    y1 = np.clip(y0 + 1, 0, in_h - 1)
+    x1 = np.clip(x0 + 1, 0, in_w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    a = img[y0][:, x0]
+    b = img[y0][:, x1]
+    c = img[y1][:, x0]
+    d = img[y1][:, x1]
+    return (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+            + c * wy * (1 - wx) + d * wy * wx).astype(img.dtype)
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size, self.scale, self.ratio = size, scale, ratio
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * np.random.uniform(*self.scale)
+            log_ratio = np.log(self.ratio)
+            aspect = np.exp(np.random.uniform(*log_ratio))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                crop = img[i:i + ch, j:j + cw]
+                return _resize_bilinear(crop, self.size, self.size)
+        # fallback: center crop
+        s = min(h, w)
+        i, j = (h - s) // 2, (w - s) // 2
+        return _resize_bilinear(img[i:i + s, j:j + s], self.size, self.size)
+
+
+class RandomRotation:
+    """Nearest-neighbor rotation by a small uniform angle (±degrees)."""
+
+    def __init__(self, degrees, fill=0.0):
+        self.degrees, self.fill = degrees, fill
+
+    def __call__(self, img):
+        theta = np.deg2rad(np.random.uniform(-self.degrees, self.degrees))
+        h, w = img.shape[:2]
+        cy, cx = (h - 1) / 2, (w - 1) / 2
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        ys = cy + (yy - cy) * np.cos(theta) - (xx - cx) * np.sin(theta)
+        xs = cx + (yy - cy) * np.sin(theta) + (xx - cx) * np.cos(theta)
+        yi = np.round(ys).astype(int)
+        xi = np.round(xs).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out = np.full_like(img, self.fill)
+        out[valid] = img[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)][valid]
+        return out
+
+
+class Resize:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        if h < w:
+            return _resize_bilinear(img, self.size, int(round(w * self.size / h)))
+        return _resize_bilinear(img, int(round(h * self.size / w)), self.size)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        i, j = (h - self.size) // 2, (w - self.size) // 2
+        return img[i:i + self.size, j:j + self.size]
+
+
+cifar10_train_transforms = Compose([
+    to_float,
+    RandomCrop(32, padding=4, mode="reflect"),
+    RandomHorizontalFlip(),
+    Normalize(cifar10_mean, cifar10_std),
+])
+cifar10_test_transforms = Compose([to_float, Normalize(cifar10_mean, cifar10_std)])
+
+cifar100_train_transforms = Compose([
+    to_float,
+    RandomCrop(32, padding=4, mode="reflect"),
+    RandomHorizontalFlip(),
+    Normalize(cifar100_mean, cifar100_std),
+])
+cifar100_test_transforms = Compose([to_float, Normalize(cifar100_mean, cifar100_std)])
+
+femnist_train_transforms = Compose([
+    to_float,
+    RandomCrop(28, padding=2, mode="constant", fill=1.0),
+    RandomResizedCrop(28, scale=(0.8, 1.2), ratio=(4 / 5, 5 / 4)),
+    RandomRotation(5, fill=1.0),
+    Normalize(femnist_mean, femnist_std),
+])
+femnist_test_transforms = Compose([to_float, Normalize(femnist_mean, femnist_std)])
+
+imagenet_train_transforms = Compose([
+    to_float,
+    RandomResizedCrop(224),
+    RandomHorizontalFlip(),
+    Normalize(imagenet_mean, imagenet_std),
+])
+imagenet_val_transforms = Compose([
+    to_float,
+    Resize(256),
+    CenterCrop(224),
+    Normalize(imagenet_mean, imagenet_std),
+])

@@ -1,0 +1,51 @@
+"""Workload loss callbacks matching the worker contract.
+
+``compute_loss(params, model_state, batch, rng, train) ->
+(loss_sum, metric_sums, count, new_model_state)`` with sums over valid
+(mask=1) examples.
+
+CV head parity: cross-entropy + accuracy (reference cv_train.py:32-72);
+the mixup variant exists in the reference but is dead code
+(cv_train.py:74-80), so it is not reproduced.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def make_cv_losses(model, has_batch_stats: bool = False):
+    """Returns (compute_loss_train, compute_loss_val) for an image classifier
+    flax module called as ``model.apply(vars, x, train=...)``."""
+
+    def _apply(params, model_state, x, train):
+        variables = {"params": params}
+        if has_batch_stats:
+            variables["batch_stats"] = model_state
+            if train:
+                logits, updates = model.apply(variables, x, train=True,
+                                              mutable=["batch_stats"])
+                return logits, updates["batch_stats"]
+            logits = model.apply(variables, x, train=False)
+            return logits, model_state
+        logits = model.apply(variables, x, train=train)
+        return logits, model_state
+
+    def compute(params, model_state, batch, rng, train):
+        x = batch["inputs"]
+        y = batch["targets"]
+        mask = batch["mask"]
+        logits, new_state = _apply(params, model_state, x, train)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y.astype(jnp.int32))
+        correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
+        loss_sum = jnp.sum(losses * mask)
+        acc_sum = jnp.sum(correct * mask)
+        count = jnp.sum(mask)
+        return loss_sum, (acc_sum,), count, new_state
+
+    return compute, compute

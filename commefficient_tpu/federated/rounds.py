@@ -1,0 +1,260 @@
+"""The federated round as one jitted SPMD program.
+
+This module replaces the reference's entire L0 distributed substrate —
+process spawn + mp.Queue scatter + shared-memory state + NCCL reduce
+(reference fed_aggregator.py:94-164, 301-332; fed_worker.py:14-138) — with a
+single compiled step over a ``jax.sharding.Mesh``:
+
+  - the round's W sampled clients are lanes of a ``vmap``, sharded W/n per
+    device via ``shard_map`` over the ``clients`` mesh axis (the reference's
+    "one worker process per GPU looping over its chunk of clients");
+  - the one collective in the whole system — the sum-reduce of per-client
+    (possibly sketched) contributions (reference fed_worker.py:136-138 ↔
+    fed_aggregator.py:327-330) — is a ``lax.psum`` over ICI. Sketch tables
+    are fixed-shape and linear, which is exactly why they psum cleanly;
+  - per-client persistent state (velocities/errors, reference
+    fed_aggregator.py:116-129) lives in device-sharded ``(num_clients, d)``
+    arrays; participating rows are gathered before the shard_map and
+    scatter-updated afterwards with an add-of-deltas (safe w.r.t. padded
+    duplicate slots);
+  - the server update runs replicated on the fresh round gradient, and
+    ``ps_weights`` never leaves HBM (deliberate improvement over the
+    reference's host-resident PS weights, fed_worker.py:41 /
+    fed_aggregator.py:455).
+
+Train metrics come back per client slot; the host aggregates. ``worker_mask``
+zeroes contributions of padded slots (rounds where fewer than W clients
+remain), replacing the reference's modulo re-dispatch (and its
+double-counting bug, SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from commefficient_tpu.federated.server import (
+    ServerConfig,
+    ServerState,
+    server_update,
+)
+from commefficient_tpu.federated.worker import (
+    WorkerConfig,
+    fedavg_local,
+    forward_grad,
+    get_new_worker_weights,
+    local_step,
+)
+from commefficient_tpu.ops.sketch import CountSketch
+
+
+class ClientStates(NamedTuple):
+    """Per-client persistent state; members are None when the config doesn't
+    need them (matching the reference's conditional allocation,
+    fed_aggregator.py:105-129)."""
+
+    velocities: Optional[jax.Array]  # (num_clients, d) iff local_momentum > 0
+    errors: Optional[jax.Array]      # (num_clients, d) iff error_type == local
+    weights: Optional[jax.Array]     # (num_clients, d) iff do_topk_down
+
+
+def init_client_states(num_clients: int, grad_size: int, wcfg: WorkerConfig,
+                       init_weights: Optional[jax.Array] = None,
+                       sharding=None) -> ClientStates:
+    def alloc(shape):
+        z = jnp.zeros(shape, jnp.float32)
+        return jax.device_put(z, sharding) if sharding is not None else z
+
+    velocities = alloc((num_clients, grad_size)) if wcfg.has_velocity else None
+    errors = alloc((num_clients, grad_size)) if wcfg.has_error else None
+    weights = None
+    if wcfg.do_topk_down:
+        assert init_weights is not None
+        weights = jnp.tile(init_weights[None, :], (num_clients, 1))
+        if sharding is not None:
+            weights = jax.device_put(weights, sharding)
+    return ClientStates(velocities, errors, weights)
+
+
+@dataclass(frozen=True)
+class RoundConfig:
+    worker: WorkerConfig
+    server: ServerConfig
+    grad_size: int
+    do_test: bool = False
+
+
+def build_round_step(
+    compute_loss_train: Callable,
+    compute_loss_val: Callable,
+    unravel: Callable,
+    ravel: Callable,
+    cfg: RoundConfig,
+    sketch: Optional[CountSketch] = None,
+    mesh: Optional[Mesh] = None,
+    axis: str = "clients",
+):
+    """Returns (train_step, val_step), both jitted.
+
+    train_step(ps_weights, server_state, client_states, model_state, batch,
+               lr, rng) -> (ps_weights, server_state, client_states,
+                            model_state, metrics)
+    val_step(ps_weights, model_state, batch) -> metrics
+    """
+    wcfg, scfg = cfg.worker, cfg.server
+
+    def one_client(ps_weights, vel_row, err_row, stale_row, model_state,
+                   batch_row, lr, rng, slot_mask):
+        # choose weights (topk-down stale path, fed_worker.py:150-159)
+        if wcfg.do_topk_down:
+            weights_used = get_new_worker_weights(ps_weights, stale_row,
+                                                  wcfg.k, True)
+        else:
+            weights_used = ps_weights
+
+        if cfg.do_test:
+            # smoke mode: skip fwd/bwd, all-ones transmit
+            # (reference fed_worker.py:117-122)
+            shape = (sketch.r, sketch.c) if wcfg.mode == "sketch" else \
+                (cfg.grad_size,)
+            transmit = jnp.ones(shape, jnp.float32)
+            metrics = (jnp.ones(()), jnp.ones(()), batch_row["mask"].sum())
+            new_vel, new_err, new_ms = vel_row, err_row, model_state
+        elif wcfg.mode == "fedavg":
+            res, new_ms = fedavg_local(compute_loss_train, weights_used,
+                                       unravel, ravel, model_state, batch_row,
+                                       rng, lr, wcfg)
+            transmit, new_vel, new_err, metrics = (res.transmit, vel_row,
+                                                   err_row, res.metrics)
+        else:
+            res, new_ms = local_step(compute_loss_train, weights_used,
+                                     unravel, ravel, model_state, vel_row,
+                                     err_row, batch_row, rng, wcfg, sketch)
+            transmit, new_vel, new_err, metrics = (res.transmit,
+                                                   res.new_velocity,
+                                                   res.new_error, res.metrics)
+
+        # padded slots contribute nothing and keep their state
+        transmit = transmit * slot_mask
+        if new_vel is not None:
+            new_vel = jnp.where(slot_mask > 0, new_vel, vel_row)
+        if new_err is not None:
+            new_err = jnp.where(slot_mask > 0, new_err, err_row)
+        return transmit, new_vel, new_err, new_ms, metrics
+
+    def clients_shard(ps_weights, vel_rows, err_rows, stale_rows, model_state,
+                      batch, lr, rng_keys, worker_mask):
+        """Runs on one device over its W/n client slots; psums the transmit."""
+        f = partial(one_client, ps_weights)
+        transmit, new_vel, new_err, new_ms, metrics = jax.vmap(
+            f, in_axes=(0, 0, 0, None, 0, None, 0, 0),
+            out_axes=(0, 0, 0, 0, 0),
+        )(vel_rows, err_rows, stale_rows, model_state, batch, lr, rng_keys,
+          worker_mask)
+        local_sum = jnp.sum(transmit, axis=0)
+        if mesh is not None:
+            total = jax.lax.psum(local_sum, axis)
+        else:
+            total = local_sum
+        # model_state (e.g. BatchNorm stats): average over clients, weighted
+        # by slot mask — a documented deviation; the reference lets each
+        # worker process's BN stats drift independently
+        wsum = jnp.maximum(worker_mask.sum(), 1.0)
+        new_ms = jax.tree_util.tree_map(
+            lambda x: jnp.einsum("c,c...->...", worker_mask, x) / wsum, new_ms)
+        if mesh is not None:
+            denom = jax.lax.psum(wsum, axis)
+            new_ms = jax.tree_util.tree_map(
+                lambda x: jax.lax.psum(x * wsum, axis) / denom, new_ms)
+        return total, new_vel, new_err, new_ms, metrics
+
+    if mesh is not None:
+        vec = P(axis)
+        rep = P()
+        clients_sharded = shard_map(
+            clients_shard,
+            mesh=mesh,
+            in_specs=(rep, vec, vec, vec, rep, vec, rep, vec, vec),
+            out_specs=(rep, vec, vec, rep, vec),
+            check_rep=False,
+        )
+    else:
+        clients_sharded = clients_shard
+
+    def _maybe_rows(state_arr, ids, width):
+        if state_arr is None:
+            return jnp.zeros((width, 1), jnp.float32)  # inert placeholder
+        return state_arr[ids]
+
+    def train_step(ps_weights, server_state: ServerState,
+                   client_states: ClientStates, model_state, batch, lr, rng):
+        ids = batch["client_ids"]
+        W = ids.shape[0]
+        worker_mask = batch["worker_mask"]
+        data_batch = {k: v for k, v in batch.items()
+                      if k not in ("client_ids", "worker_mask")}
+
+        vel_rows = _maybe_rows(client_states.velocities, ids, W)
+        err_rows = _maybe_rows(client_states.errors, ids, W)
+        stale_rows = _maybe_rows(client_states.weights, ids, W)
+        rngs = jax.random.split(rng, W)
+
+        total, new_vel, new_err, new_model_state, metrics = clients_sharded(
+            ps_weights, vel_rows, err_rows, stale_rows,
+            model_state, data_batch, lr, rngs, worker_mask)
+
+        # data-weighted average (reference fed_aggregator.py:332)
+        total_count = jnp.maximum(batch["mask"].sum(), 1.0)
+        gradient = total / total_count
+
+        # server step — fedavg applies lr on-worker (fed_aggregator.py:451)
+        rng, sub = jax.random.split(rng)
+        eff_lr = 1.0 if wcfg.mode == "fedavg" else lr
+        update, new_server_state = server_update(gradient, server_state, scfg,
+                                                 eff_lr, sketch=sketch, rng=sub)
+        new_ps = ps_weights - update
+
+        # scatter per-client state back via deltas (duplicate padded ids add 0)
+        def scatter(state_arr, old_rows, new_rows):
+            if state_arr is None:
+                return None
+            return state_arr.at[ids].add(new_rows - old_rows)
+
+        cs = ClientStates(
+            velocities=scatter(client_states.velocities, vel_rows, new_vel
+                               if client_states.velocities is not None else None),
+            errors=scatter(client_states.errors, err_rows, new_err
+                           if client_states.errors is not None else None),
+            weights=client_states.weights,
+        )
+        # true_topk momentum factor masking of local velocities at the global
+        # top-k coords (reference fed_aggregator.py:525-533)
+        if (wcfg.mode == "true_topk" and wcfg.local_momentum > 0
+                and cs.velocities is not None):
+            nzmask = (update != 0)
+            rows = cs.velocities[ids] * (~nzmask)[None, :].astype(jnp.float32)
+            cs = cs._replace(velocities=cs.velocities.at[ids].set(rows))
+        # topk-down: participating clients' stale weights advance to the
+        # weights they actually used this round
+        if wcfg.do_topk_down and cs.weights is not None:
+            used = jax.vmap(lambda s: get_new_worker_weights(ps_weights, s,
+                                                             wcfg.k, True))(
+                stale_rows)
+            cs = cs._replace(weights=cs.weights.at[ids].add(used - stale_rows))
+
+        return new_ps, new_server_state, cs, new_model_state, metrics
+
+    def val_step(ps_weights, model_state, batch):
+        params_flat = ps_weights
+        _, metrics, _, _ = forward_grad(
+            compute_loss_val, params_flat, unravel, ravel, model_state, batch,
+            jax.random.key(0), wcfg, sketch, compute_grad=False)
+        return metrics
+
+    return (jax.jit(train_step), jax.jit(val_step))

@@ -1,0 +1,295 @@
+"""Client-side (worker) computation as pure, vmappable functions.
+
+Functional re-design of the reference worker runtime (reference
+fed_worker.py:14-335). Where the reference runs one OS process per GPU, each
+looping over client batches with shared-memory state slices, here a client is
+one lane of a ``vmap`` inside a ``shard_map`` shard — per-client state rows
+are gathered/scattered by the round step (federated/rounds.py).
+
+Semantics preserved (reference anchors):
+- per-example-mean gradient × local batch size (fed_worker.py:184-190), so
+  the cross-client sum is data-weighted;
+- weight decay folded in as ``wd / num_workers × weights``
+  (reference utils.py:254-259);
+- local momentum ``v = g + m·v`` on the client's state row
+  (fed_worker.py:193-195); local error ``e += v``, transmit ``e``
+  (fed_worker.py:197-202);
+- local_topk: transmit top-k, zero error and velocity at the transmitted
+  coordinates (fed_worker.py:204-216);
+- sketch mode transmits the count-sketch table of the weighted gradient
+  (fed_worker.py:311-320) and never carries local error/velocity
+  (fed_worker.py:217-228);
+- DP: clip to ``l2_norm_clip`` then add N(0, noise_multiplier²)·√num_workers
+  noise in worker mode (fed_worker.py:304-309);
+- ``max_grad_norm`` clipping, skipped in dense space for sketch mode where it
+  is applied in sketch space via ``l2estimate`` (fed_worker.py:289-292,
+  317-319);
+- fedavg: ``num_fedavg_epochs`` of local SGD over ``fedavg_batch_size``
+  chunks with per-step decay, transmitting (w₀ − w_final)·|client dataset|
+  (fed_worker.py:61-113);
+- microbatched gradient accumulation (fed_worker.py:256-270) via
+  ``lax.scan``. Documented deviation: the reference's accumulated microbatch
+  gradient is the *sum* of per-microbatch means (an inflation by num_iters
+  that its clip compensates, fed_worker.py:266-292); we compute the exact
+  per-example mean, which matches the reference whenever microbatching is
+  off (its default).
+
+The loss callback contract is
+``compute_loss(params, model_state, microbatch, rng, train) ->
+(loss_sum, metric_sums: tuple, count, new_model_state)`` where sums run over
+*valid* (mask=1) examples only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.ops.clip import clip_by_l2
+from commefficient_tpu.ops.sketch import CountSketch, l2estimate, sketch_vec
+from commefficient_tpu.ops.topk import topk
+
+
+@dataclass(frozen=True)
+class WorkerConfig:
+    mode: str
+    error_type: str = "none"
+    k: int = 0
+    num_workers: int = 1
+    weight_decay: float = 0.0
+    local_momentum: float = 0.0
+    microbatch_size: int = -1
+    max_grad_norm: Optional[float] = None
+    do_dp: bool = False
+    dp_mode: str = "worker"
+    l2_norm_clip: float = 1.0
+    noise_multiplier: float = 0.0
+    num_fedavg_epochs: int = 1
+    fedavg_batch_size: int = -1
+    fedavg_lr_decay: float = 1.0
+    do_topk_down: bool = False
+
+    @property
+    def has_velocity(self) -> bool:
+        # client_velocities allocated iff local_momentum > 0
+        # (reference fed_aggregator.py:127-129)
+        return self.local_momentum > 0
+
+    @property
+    def has_error(self) -> bool:
+        # client_errors allocated iff error_type == "local"
+        # (reference fed_aggregator.py:116-126)
+        return self.error_type == "local"
+
+
+class ClientResult(NamedTuple):
+    transmit: jax.Array  # (d,) dense or (r, c) table — weighted by batch count
+    new_velocity: Optional[jax.Array]
+    new_error: Optional[jax.Array]
+    metrics: Tuple[jax.Array, ...]  # (loss_mean, *metric_means, count)
+
+
+def _microbatch_grads(compute_loss, params, model_state, batch, rng,
+                      cfg: WorkerConfig):
+    """Per-example-mean gradient over the masked batch, accumulated over
+    microbatches with ``lax.scan``. Returns (grad_pytree_mean, loss_mean,
+    metric_means, count, new_model_state)."""
+    B = batch["mask"].shape[0]
+    mb = B if cfg.microbatch_size <= 0 else min(cfg.microbatch_size, B)
+    n_iters = -(-B // mb)
+    pad = n_iters * mb - B
+
+    def pad0(x):
+        return jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) if pad else x
+
+    stacked = {k: pad0(v).reshape((n_iters, mb) + v.shape[1:])
+               for k, v in batch.items()}
+
+    def loss_for_grad(p, mstate, micro, r):
+        loss_sum, msums, count, new_state = compute_loss(p, mstate, micro, r,
+                                                         True)
+        return loss_sum, (msums, count, new_state)
+
+    grad_fn = jax.value_and_grad(loss_for_grad, has_aux=True)
+
+    def body(carry, micro):
+        g_acc, loss_acc, m_acc, n_acc, mstate, r = carry
+        r, sub = jax.random.split(r)
+        (loss_sum, (msums, count, new_state)), g = grad_fn(params, mstate,
+                                                           micro, sub)
+        g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+        m_acc = tuple(a + m for a, m in zip(m_acc, msums))
+        return (g_acc, loss_acc + loss_sum, m_acc, n_acc + count, new_state,
+                r), None
+
+    zeros_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+    # probe number of metrics with eval_shape (no FLOPs)
+    probe = jax.eval_shape(
+        lambda: compute_loss(params, model_state,
+                             jax.tree_util.tree_map(lambda x: x[0], stacked),
+                             jax.random.key(0), True))
+    n_metrics = len(probe[1])
+    init = (zeros_g, jnp.zeros(()), tuple(jnp.zeros(()) for _ in range(n_metrics)),
+            jnp.zeros(()), model_state, rng)
+    (g_sum, loss_sum, m_sums, count, new_state, _), _ = jax.lax.scan(
+        body, init, stacked)
+
+    denom = jnp.maximum(count, 1.0)
+    g_mean = jax.tree_util.tree_map(lambda x: x / denom, g_sum)
+    return (g_mean, loss_sum / denom, tuple(m / denom for m in m_sums), count,
+            new_state)
+
+
+def forward_grad(compute_loss, params_flat, unravel, ravel, model_state,
+                 batch, rng, cfg: WorkerConfig, sketch: Optional[CountSketch],
+                 compute_grad: bool = True):
+    """reference fed_worker.py:249-335 as a pure function.
+
+    Returns (transmit_or_None, (loss_mean, *metric_means, count),
+    new_model_state, dense_mean_grad)."""
+    params = unravel(params_flat)
+    if not compute_grad:
+        loss_sum, msums, count, new_state = compute_loss(
+            params, model_state, batch, rng, False)
+        denom = jnp.maximum(count, 1.0)
+        metrics = (loss_sum / denom,) + tuple(m / denom for m in msums) + (count,)
+        return None, metrics, new_state, None
+
+    g_mean_tree, loss_mean, metric_means, count, new_state = _microbatch_grads(
+        compute_loss, params, model_state, batch, rng, cfg)
+    grad = ravel(g_mean_tree)
+    # weight decay (reference utils.py:254-259)
+    if cfg.weight_decay != 0:
+        grad = grad + (cfg.weight_decay / cfg.num_workers) * params_flat
+    # dense-space max_grad_norm clip, not for sketch (fed_worker.py:289-292)
+    if cfg.max_grad_norm is not None and cfg.mode != "sketch":
+        grad = clip_by_l2(grad, cfg.max_grad_norm)
+    # DP (fed_worker.py:304-309)
+    if cfg.do_dp:
+        grad = clip_by_l2(grad, cfg.l2_norm_clip)
+        if cfg.dp_mode == "worker":
+            rng, sub = jax.random.split(rng)
+            noise = cfg.noise_multiplier * jax.random.normal(
+                sub, grad.shape) * jnp.sqrt(float(cfg.num_workers))
+            grad = grad + noise
+
+    if cfg.mode == "sketch":
+        table = sketch_vec(sketch, grad)
+        if cfg.max_grad_norm is not None:
+            # sketch-space clipping via l2estimate (fed_worker.py:317-319,
+            # utils.py:305-313)
+            table = clip_by_l2(table, cfg.max_grad_norm,
+                               norm=l2estimate(table))
+        g = table
+    else:
+        g = grad
+
+    metrics = (loss_mean,) + metric_means + (count,)
+    return g, metrics, new_state, grad
+
+
+def local_step(compute_loss, params_flat, unravel, ravel, model_state,
+               velocity, error, batch, rng, cfg: WorkerConfig,
+               sketch: Optional[CountSketch]) -> Tuple[ClientResult, Any]:
+    """One client's training contribution (reference fed_worker.py:184-230)."""
+    g, metrics, new_state, _ = forward_grad(
+        compute_loss, params_flat, unravel, ravel, model_state, batch, rng,
+        cfg, sketch)
+    count = metrics[-1]
+    # sum-of-example-gradients scaling (fed_worker.py:190); linear, so it
+    # applies to sketch tables too
+    g = g * count
+
+    new_velocity, new_error = velocity, error
+    if cfg.has_velocity:
+        new_velocity = g + cfg.local_momentum * velocity
+        carrier = new_velocity
+    else:
+        carrier = g
+    if cfg.has_error:
+        new_error = error + carrier
+        to_transmit = new_error
+    else:
+        to_transmit = carrier
+
+    if cfg.mode == "local_topk":
+        to_transmit = topk(to_transmit, cfg.k)
+        nz = to_transmit != 0
+        if cfg.has_error:
+            new_error = jnp.where(nz, 0.0, new_error)
+        if cfg.has_velocity:
+            new_velocity = jnp.where(nz, 0.0, new_velocity)
+
+    return ClientResult(to_transmit, new_velocity, new_error, metrics), new_state
+
+
+def fedavg_local(compute_loss, params_flat, unravel, ravel, model_state,
+                 batch, rng, lr, cfg: WorkerConfig) -> Tuple[ClientResult, Any]:
+    """FedAvg local training (reference fed_worker.py:61-113): local SGD over
+    chunked whole-client batch, transmit (w₀ − w_final)·dataset_size."""
+    B = batch["mask"].shape[0]
+    fbs = B if cfg.fedavg_batch_size == -1 else min(cfg.fedavg_batch_size, B)
+    n_chunks = -(-B // fbs)
+    pad = n_chunks * fbs - B
+
+    def pad0(x):
+        return jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) if pad else x
+
+    chunks = {k: pad0(v).reshape((n_chunks, fbs) + v.shape[1:])
+              for k, v in batch.items()}
+
+    def grad_of(p_flat, mstate, chunk, r):
+        def loss_fn(p, ms):
+            loss_sum, msums, count, new_ms = compute_loss(unravel(p), ms,
+                                                          chunk, r, True)
+            return loss_sum, (msums, count, new_ms)
+
+        (loss_sum, (msums, count, new_ms)), g = jax.value_and_grad(
+            loss_fn, has_aux=True)(p_flat, mstate)
+        return g, loss_sum, msums, count, new_ms
+
+    probe = jax.eval_shape(
+        lambda: compute_loss(unravel(params_flat), model_state,
+                             jax.tree_util.tree_map(lambda x: x[0], chunks),
+                             jax.random.key(0), True))
+    n_metrics = len(probe[1])
+
+    def body(carry, chunk):
+        w, mstate, r, step, loss_acc, m_acc, n_steps = carry
+        r, sub = jax.random.split(r)
+        g, loss_sum, msums, count, new_ms = grad_of(w, mstate, chunk, sub)
+        # average gradient over the chunk (fed_worker.py:96-98)
+        g_mean = g / jnp.maximum(count, 1.0)
+        decay = cfg.fedavg_lr_decay ** step
+        # skip empty (all-padding) chunks
+        valid = (count > 0).astype(jnp.float32)
+        w = w - valid * g_mean * lr * decay
+        denom = jnp.maximum(count, 1.0)
+        m_acc = tuple(a + valid * m / denom for a, m in zip(m_acc, msums))
+        return (w, new_ms, r, step + valid, loss_acc + valid * loss_sum / denom,
+                m_acc, n_steps + valid), None
+
+    init = (params_flat, model_state, rng, jnp.zeros(()), jnp.zeros(()),
+            tuple(jnp.zeros(()) for _ in range(n_metrics)), jnp.zeros(()))
+    for _ in range(cfg.num_fedavg_epochs):
+        (w, mstate, rng, step, loss_acc, m_acc, n_steps), _ = jax.lax.scan(
+            body, init, chunks)
+        init = (w, mstate, rng, step, loss_acc, m_acc, n_steps)
+    w, mstate, _, _, loss_acc, m_acc, n_steps = init
+
+    count = batch["mask"].sum()
+    # weight the delta by client dataset size (fed_worker.py:104-108)
+    transmit = (params_flat - w) * count
+    denom = jnp.maximum(n_steps, 1.0)
+    metrics = (loss_acc / denom,) + tuple(m / denom for m in m_acc) + (count,)
+    return ClientResult(transmit, None, None, metrics), mstate
+
+
+def get_new_worker_weights(ps_weights, worker_weights, k, do_topk_down):
+    """topk-down stale-weight reconstruction (reference fed_worker.py:232-247)."""
+    diff = ps_weights - worker_weights
+    update = topk(diff, k) if do_topk_down else diff
+    return worker_weights + update

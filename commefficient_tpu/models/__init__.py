@@ -1,0 +1,39 @@
+"""Model zoo. Registry = uppercase names in this namespace, mirroring the
+reference's introspection-based registry (reference utils.py:114-118)."""
+
+from commefficient_tpu.models.resnet9 import ResNet9
+from commefficient_tpu.models.fixup_resnet9 import FixupResNet9
+from commefficient_tpu.models.fixup_resnet18 import ResNet18, FixupResNet18
+from commefficient_tpu.models.fixup_resnet import FixupResNet50
+from commefficient_tpu.models.resnet101ln import ResNet101LN
+from commefficient_tpu.models.resnets import (
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    resnext50_32x4d,
+    resnext101_32x8d,
+    wide_resnet50_2,
+    wide_resnet101_2,
+)
+
+__all__ = [
+    "ResNet9",
+    "FixupResNet9",
+    "ResNet18",
+    "FixupResNet18",
+    "FixupResNet50",
+    "ResNet101LN",
+    "ResNet",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+    "resnext50_32x4d",
+    "resnext101_32x8d",
+    "wide_resnet50_2",
+    "wide_resnet101_2",
+]

@@ -1,0 +1,81 @@
+"""FixupResNet50 — normalization-free ImageNet-scale ResNet.
+
+Parity with reference models/fixup_resnet.py:8-10, which wraps the external
+``fixup`` package's ``FixupResNet(FixupBottleneck, [3, 4, 6, 3])``. The
+bottleneck is implemented here directly: scalar biases around each of the
+three convs, a scalar scale after the last, conv1/conv2 init scaled by
+L^(-1/4) (Fixup rule for m=3), zero-init conv3 and classifier.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flax import linen as nn
+
+from commefficient_tpu.models.layers import (
+    ScalarAdd,
+    ScalarMul,
+    fixup_init,
+    global_avg_pool,
+)
+from jax.nn.initializers import variance_scaling
+
+__all__ = ["FixupResNet50"]
+
+
+class FixupBottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    num_layers: float = 16.0
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x):
+        # L^(-1/4) per conv for m=3 → variance scale L^(-1/2) on each of
+        # conv1/conv2
+        scaled = variance_scaling(2.0 / (self.num_layers ** 0.5), "fan_out",
+                                  "normal")
+        out_ch = self.planes * self.expansion
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            shortcut = nn.avg_pool(x, (1, 1), strides=(self.stride, self.stride))
+            shortcut = nn.Conv(out_ch, (1, 1), use_bias=False,
+                               kernel_init=fixup_init(1.0),
+                               name="shortcut")(ScalarAdd(name="bias_sc")(shortcut))
+        out = nn.Conv(self.planes, (1, 1), use_bias=False, kernel_init=scaled,
+                      name="conv1")(ScalarAdd(name="bias1a")(x))
+        out = nn.relu(ScalarAdd(name="bias1b")(out))
+        out = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1,
+                      use_bias=False, kernel_init=scaled,
+                      name="conv2")(ScalarAdd(name="bias2a")(out))
+        out = nn.relu(ScalarAdd(name="bias2b")(out))
+        out = nn.Conv(out_ch, (1, 1), use_bias=False,
+                      kernel_init=nn.initializers.zeros,
+                      name="conv3")(ScalarAdd(name="bias3a")(out))
+        out = ScalarAdd(name="bias3b")(ScalarMul(name="scale")(out))
+        return nn.relu(out + shortcut)
+
+
+class FixupResNet50(nn.Module):
+    layers: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    initial_channels: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train
+        num_layers = float(sum(self.layers))
+        out = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
+                      kernel_init=fixup_init(1.0), name="conv1")(x)
+        out = nn.relu(ScalarAdd(name="bias1")(out))
+        out = nn.max_pool(out, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, (planes, blocks) in enumerate(zip((64, 128, 256, 512), self.layers)):
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                out = FixupBottleneck(planes, stride, num_layers,
+                                      name=f"layer{stage + 1}_{b}")(out)
+        out = global_avg_pool(out)
+        out = ScalarAdd(name="bias2")(out)
+        return nn.Dense(self.num_classes, kernel_init=nn.initializers.zeros,
+                        bias_init=nn.initializers.zeros, name="fc")(out)

@@ -1,0 +1,122 @@
+"""ResNet18 + FixupResNet18 — self-contained CIFAR-scale ResNets.
+
+Parity with reference models/fixup_resnet18.py:24-216: 3x3 prep conv, four
+stages [2,2,2,2] with strides [1,2,2,2] and channel plan 64/128/256/256, head
+= concat(global-avg-pool, global-max-pool) → Linear(512, num_classes). The
+"PreActBlock" in the reference is, as written, a post-activation block with
+conv-BN-relu twice plus shortcut — reproduced as such.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from commefficient_tpu.models.layers import (
+    ScalarAdd,
+    ScalarMul,
+    fixup_init,
+    global_avg_pool,
+    global_max_pool,
+    kaiming_normal_fan_out,
+    torch_conv_init,
+)
+
+__all__ = ["ResNet18", "FixupResNet18"]
+
+
+class FixupBlock(nn.Module):
+    """reference models/fixup_resnet18.py:23-63."""
+
+    c_out: int
+    stride: int = 1
+    num_layers: float = 8.0
+
+    @nn.compact
+    def __call__(self, x):
+        needs_proj = self.stride != 1 or x.shape[-1] != self.c_out
+        shortcut = x
+        if needs_proj:
+            shortcut = nn.Conv(self.c_out, (1, 1), strides=self.stride,
+                               use_bias=False, kernel_init=fixup_init(1.0),
+                               name="shortcut")(x)
+        out = ScalarAdd(name="add1a")(x)
+        out = nn.Conv(self.c_out, (3, 3), strides=self.stride, padding=1,
+                      use_bias=False, kernel_init=fixup_init(self.num_layers),
+                      name="conv1")(out)
+        out = nn.relu(ScalarAdd(name="add1b")(out))
+        out = ScalarAdd(name="add2a")(out)
+        out = nn.Conv(self.c_out, (3, 3), padding=1, use_bias=False,
+                      kernel_init=nn.initializers.zeros, name="conv2")(out)
+        out = ScalarAdd(name="add2b")(ScalarMul(name="mul")(out))
+        return nn.relu(out + shortcut)
+
+
+class PostActBlock(nn.Module):
+    """conv-BN-relu ×2 + shortcut (reference models/fixup_resnet18.py:138-166)."""
+
+    c_out: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        needs_proj = self.stride != 1 or x.shape[-1] != self.c_out
+        shortcut = x
+        if needs_proj:
+            shortcut = nn.Conv(self.c_out, (1, 1), strides=self.stride,
+                               use_bias=False, kernel_init=torch_conv_init,
+                               name="shortcut")(x)
+        out = nn.Conv(self.c_out, (3, 3), strides=self.stride, padding=1,
+                      use_bias=False, kernel_init=torch_conv_init, name="conv1")(x)
+        out = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                   epsilon=1e-5, name="bn1")(out))
+        out = nn.Conv(self.c_out, (3, 3), padding=1, use_bias=False,
+                      kernel_init=torch_conv_init, name="conv2")(out)
+        out = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                   epsilon=1e-5, name="bn2")(out))
+        return out + shortcut
+
+
+_STAGES = ((64, 1), (128, 2), (256, 2), (256, 2))
+
+
+def _head(x, num_classes, kernel_init, name_prefix=""):
+    x = jnp.concatenate([global_avg_pool(x), global_max_pool(x)], axis=-1)
+    return nn.Dense(num_classes, kernel_init=kernel_init,
+                    bias_init=nn.initializers.zeros, name="classifier")(x)
+
+
+class ResNet18(nn.Module):
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+    num_classes: int = 10
+    initial_channels: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        out = nn.relu(nn.Conv(64, (3, 3), padding=1, use_bias=False,
+                              kernel_init=torch_conv_init, name="prep")(x))
+        for s, (c, stride) in enumerate(_STAGES):
+            for b in range(self.num_blocks[s]):
+                out = PostActBlock(c, stride if b == 0 else 1,
+                                   name=f"stage{s}_block{b}")(out, train)
+        return _head(out, self.num_classes, torch_conv_init)
+
+
+class FixupResNet18(nn.Module):
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+    num_classes: int = 10
+    initial_channels: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train
+        num_layers = float(sum(self.num_blocks))
+        out = nn.relu(nn.Conv(64, (3, 3), padding=1, use_bias=False,
+                              kernel_init=fixup_init(1.0), name="prep")(x))
+        for s, (c, stride) in enumerate(_STAGES):
+            for b in range(self.num_blocks[s]):
+                out = FixupBlock(c, stride if b == 0 else 1, num_layers,
+                                 name=f"stage{s}_block{b}")(out)
+        return _head(out, self.num_classes, nn.initializers.zeros)

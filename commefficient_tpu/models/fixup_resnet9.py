@@ -1,0 +1,99 @@
+"""FixupResNet9 — normalization-free ResNet9 with Fixup initialization.
+
+Parity with reference models/fixup_resnet9.py:10-91, which composes
+``FixupBasicBlock``/``conv3x3`` from the external ``fixup`` package; that
+block is implemented here directly (no external dep): scalar biases around
+each conv, a scalar scale on the second conv, zero-init second conv and
+classifier, first-conv std √(2/fan_out)·L^(-1/2).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from flax import linen as nn
+
+from commefficient_tpu.models.layers import fixup_init, max_pool
+
+__all__ = ["FixupResNet9"]
+
+
+def _bias(mdl, name):
+    return mdl.param(name, nn.initializers.zeros, (1,))
+
+
+def _scale(mdl, name):
+    return mdl.param(name, nn.initializers.ones, (1,))
+
+
+class FixupBasicBlock(nn.Module):
+    """Two 3x3 convs with Fixup scalars + identity shortcut (equivalent of
+    fixup.cifar.models.fixup_resnet_cifar.FixupBasicBlock, used at reference
+    models/fixup_resnet9.py:6,20-22)."""
+
+    c: int
+    num_layers: float = 2.0
+
+    @nn.compact
+    def __call__(self, x):
+        b1a, b1b = _bias(self, "bias1a"), _bias(self, "bias1b")
+        b2a, b2b = _bias(self, "bias2a"), _bias(self, "bias2b")
+        scale = _scale(self, "scale")
+        out = nn.Conv(self.c, (3, 3), padding=1, use_bias=False,
+                      kernel_init=fixup_init(self.num_layers), name="conv1")(x + b1a)
+        out = nn.relu(out + b1b)
+        out = nn.Conv(self.c, (3, 3), padding=1, use_bias=False,
+                      kernel_init=nn.initializers.zeros, name="conv2")(out + b2a)
+        out = out * scale + b2b
+        return nn.relu(out + x)
+
+
+class FixupLayer(nn.Module):
+    """conv, bias, scale, relu, pool, then ``num_blocks`` FixupBasicBlocks
+    (reference models/fixup_resnet9.py:10-31)."""
+
+    c_out: int
+    num_blocks: int
+    pool: int = 2
+    num_layers: float = 2.0
+
+    @nn.compact
+    def __call__(self, x):
+        b1a, b1b = _bias(self, "bias1a"), _bias(self, "bias1b")
+        scale = _scale(self, "scale")
+        out = nn.Conv(self.c_out, (3, 3), padding=1, use_bias=False,
+                      kernel_init=fixup_init(1.0), name="conv")(x + b1a)
+        out = nn.relu(out * scale + b1b)
+        if self.pool:
+            out = max_pool(out, self.pool)
+        for i in range(self.num_blocks):
+            out = FixupBasicBlock(self.c_out, self.num_layers, name=f"block{i}")(out)
+        return out
+
+
+class FixupResNet9(nn.Module):
+    channels: Tuple[Tuple[str, int], ...] = (
+        ("prep", 64), ("layer1", 128), ("layer2", 256), ("layer3", 512))
+    pool: int = 2
+    num_classes: int = 10
+    initial_channels: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train  # no normalization state
+        ch = dict(self.channels)
+        num_layers = 2.0  # reference models/fixup_resnet9.py:36
+        b1a, b1b = _bias(self, "bias1a"), _bias(self, "bias1b")
+        scale = _scale(self, "scale")
+        out = nn.Conv(ch["prep"], (3, 3), padding=1, use_bias=False,
+                      kernel_init=fixup_init(1.0), name="conv1")(x + b1a)
+        out = nn.relu(out * scale + b1b)
+        out = FixupLayer(ch["layer1"], 1, self.pool, num_layers, name="layer1")(out)
+        out = FixupLayer(ch["layer2"], 0, self.pool, num_layers, name="layer2")(out)
+        out = FixupLayer(ch["layer3"], 1, self.pool, num_layers, name="layer3")(out)
+        out = max_pool(out, min(4, out.shape[1]))
+        out = out.reshape((out.shape[0], -1))
+        b2 = _bias(self, "bias2")
+        out = nn.Dense(self.num_classes, kernel_init=nn.initializers.zeros,
+                       bias_init=nn.initializers.zeros, name="linear")(out + b2)
+        return out

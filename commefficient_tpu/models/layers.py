@@ -1,0 +1,100 @@
+"""Shared layers and torch-parity initializers for the model zoo.
+
+Init parity notes (for loss-curve comparability with the reference, which uses
+torch defaults unless it overrides them):
+
+- torch ``nn.Conv2d``/``nn.Linear`` default = kaiming_uniform(a=√5), i.e.
+  Uniform(±1/√fan_in) → variance_scaling(1/3, fan_in, uniform).
+- Fixup models (reference models/fixup_resnet18.py:89-106) use
+  Normal(0, √(2/(out_ch·k·k))) scaled by num_layers^-0.5 →
+  variance_scaling(2/num_layers, fan_out, normal); zero init for second convs
+  and the classifier.
+- torchvision fork (reference models/resnets.py:176-180) uses kaiming_normal
+  fan_out → variance_scaling(2, fan_out, normal).
+
+All modules take NHWC inputs (TPU-native layout; the reference is NCHW).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.nn.initializers import variance_scaling
+
+torch_conv_init = variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+kaiming_normal_fan_out = variance_scaling(2.0, "fan_out", "normal")
+
+
+def fixup_init(num_layers: float):
+    return variance_scaling(2.0 / num_layers, "fan_out", "normal")
+
+
+def max_pool(x, window: int):
+    return nn.max_pool(x, (window, window), strides=(window, window))
+
+
+def global_avg_pool(x):
+    return jnp.mean(x, axis=(1, 2))
+
+
+def global_max_pool(x):
+    return jnp.max(x, axis=(1, 2))
+
+
+class ScalarAdd(nn.Module):
+    """Learned scalar bias (Fixup's ``Add``, reference fixup_resnet18.py:15-21)."""
+
+    @nn.compact
+    def __call__(self, x):
+        return x + self.param("bias", nn.initializers.zeros, (1,))
+
+
+class ScalarMul(nn.Module):
+    """Learned scalar scale (Fixup's ``Mul``, reference fixup_resnet18.py:8-13)."""
+
+    @nn.compact
+    def __call__(self, x):
+        return x * self.param("scale", nn.initializers.ones, (1,))
+
+
+class ConvBN(nn.Module):
+    """3x3 conv (+ optional BatchNorm) + ReLU + optional max-pool — the
+    reference's ``ConvBN`` cell (reference models/resnet9.py:32-50)."""
+
+    c_out: int
+    do_batchnorm: bool = False
+    pool: int = 0
+    bn_weight_init: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(
+            self.c_out,
+            (3, 3),
+            padding=1,
+            use_bias=False,
+            kernel_init=torch_conv_init,
+        )(x)
+        if self.do_batchnorm:
+            x = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                scale_init=nn.initializers.constant(self.bn_weight_init),
+            )(x)
+        x = nn.relu(x)
+        if self.pool:
+            x = max_pool(x, self.pool)
+        return x
+
+
+class LayerNorm2d(nn.Module):
+    """LayerNorm over (H, W, C) of an NHWC feature map — equivalent of the
+    reference's ``nn.LayerNorm((C, H, W))`` with explicit spatial shapes
+    (reference models/resnets.py:86-97)."""
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.LayerNorm(reduction_axes=(-3, -2, -1))(x)

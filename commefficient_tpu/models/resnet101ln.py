@@ -1,0 +1,15 @@
+"""ResNet101 with LayerNorm — parity with reference models/resnet101ln.py:7-13
+(``models.resnet101(num_classes=62, norm_layer=nn.LayerNorm)``, the FEMNIST
+variant)."""
+
+from __future__ import annotations
+
+from commefficient_tpu.models.resnets import resnet101
+
+__all__ = ["ResNet101LN"]
+
+
+def ResNet101LN(num_classes: int = 62, initial_channels: int = 1, **kw):
+    kw.pop("do_batchnorm", None)
+    return resnet101(num_classes=num_classes, norm="layer",
+                     initial_channels=initial_channels)

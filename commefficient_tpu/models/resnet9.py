@@ -1,0 +1,68 @@
+"""ResNet9 — cifar10-fast topology, TPU/flax re-design.
+
+Behavioral parity with reference models/resnet9.py:74-148: prep ConvBN →
+layer1(pool)+residual → layer2(pool) → layer3(pool)+residual → maxpool(4) →
+bias-free linear → ×``weight`` output scale. BatchNorm optional
+(``--batchnorm``); ``initial_channels=1`` for EMNIST
+(reference cv_train.py:353-354); finetune swaps the head for
+``new_num_classes`` outputs and freezes the rest (reference
+models/resnet9.py:105-113 — freezing is enforced by the aggregator's
+trainable mask, not by the module).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from commefficient_tpu.models.layers import ConvBN, max_pool, torch_conv_init
+
+__all__ = ["ResNet9"]
+
+DEFAULT_CHANNELS = (("prep", 64), ("layer1", 128), ("layer2", 256), ("layer3", 512))
+
+
+class Residual(nn.Module):
+    """x + relu(ConvBN(ConvBN(x))) (reference models/resnet9.py:61-68)."""
+
+    c: int
+    do_batchnorm: bool
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        out = ConvBN(self.c, self.do_batchnorm, name="res1")(x, train)
+        out = ConvBN(self.c, self.do_batchnorm, name="res2")(out, train)
+        return x + nn.relu(out)
+
+
+class ResNet9(nn.Module):
+    do_batchnorm: bool = False
+    channels: Tuple[Tuple[str, int], ...] = DEFAULT_CHANNELS
+    weight: float = 0.125
+    pool: int = 2
+    num_classes: int = 10
+    initial_channels: int = 3
+    new_num_classes: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        ch = dict(self.channels)
+        out = ConvBN(ch["prep"], self.do_batchnorm, name="prep")(x, train)
+        out = ConvBN(ch["layer1"], self.do_batchnorm, pool=self.pool, name="layer1")(out, train)
+        out = Residual(ch["layer1"], self.do_batchnorm, name="res1")(out, train)
+        out = ConvBN(ch["layer2"], self.do_batchnorm, pool=self.pool, name="layer2")(out, train)
+        out = ConvBN(ch["layer3"], self.do_batchnorm, pool=self.pool, name="layer3")(out, train)
+        out = Residual(ch["layer3"], self.do_batchnorm, name="res3")(out, train)
+        out = max_pool(out, min(4, out.shape[1]))
+        out = out.reshape((out.shape[0], -1))
+        n_out = self.new_num_classes or self.num_classes
+        out = nn.Dense(n_out, use_bias=False, kernel_init=torch_conv_init,
+                       name="linear")(out)
+        return out * self.weight
+
+    @staticmethod
+    def finetune_trainable(path: Tuple[str, ...]) -> bool:
+        """Head-only finetuning (reference models/resnet9.py:105-113)."""
+        return "linear" in path

@@ -1,0 +1,171 @@
+"""Deep ResNet family with configurable normalization (batch or layer norm).
+
+Flax re-design of the reference's torchvision fork (reference
+models/resnets.py:36-370), which it modified in two ways reproduced here:
+(a) ``norm_layer`` may be LayerNorm — the fork passes explicit ``(C, hw, hw)``
+shapes per block; our NHWC ``LayerNorm2d`` normalizes over the actual
+(H, W, C) so no shape bookkeeping is needed; (b) the stem conv takes
+``initial_channels`` (the fork hard-codes 1 input channel for EMNIST,
+reference models/resnets.py:155-156 — we default to 1 for parity but expose
+the knob). Supports BasicBlock and Bottleneck, groups/width for ResNeXt and
+wide variants.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flax import linen as nn
+
+from commefficient_tpu.models.layers import (
+    LayerNorm2d,
+    global_avg_pool,
+    kaiming_normal_fan_out,
+)
+
+__all__ = [
+    "ResNet",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+    "resnext50_32x4d",
+    "resnext101_32x8d",
+    "wide_resnet50_2",
+    "wide_resnet101_2",
+]
+
+
+class _Norm(nn.Module):
+    kind: str  # "batch" | "layer"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.kind == "batch":
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                epsilon=1e-5)(x)
+        return LayerNorm2d()(x)
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    norm: str = "batch"
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        identity = x
+        out = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1,
+                      use_bias=False, kernel_init=kaiming_normal_fan_out,
+                      name="conv1")(x)
+        out = nn.relu(_Norm(self.norm, name="bn1")(out, train))
+        out = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
+                      kernel_init=kaiming_normal_fan_out, name="conv2")(out)
+        out = _Norm(self.norm, name="bn2")(out, train)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            identity = nn.Conv(self.planes, (1, 1), strides=self.stride,
+                               use_bias=False, kernel_init=kaiming_normal_fan_out,
+                               name="down_conv")(x)
+            identity = _Norm(self.norm, name="down_norm")(identity, train)
+        return nn.relu(out + identity)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    norm: str = "batch"
+    groups: int = 1
+    base_width: int = 64
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        width = int(self.planes * (self.base_width / 64.0)) * self.groups
+        out_ch = self.planes * self.expansion
+        identity = x
+        out = nn.Conv(width, (1, 1), use_bias=False,
+                      kernel_init=kaiming_normal_fan_out, name="conv1")(x)
+        out = nn.relu(_Norm(self.norm, name="bn1")(out, train))
+        out = nn.Conv(width, (3, 3), strides=self.stride, padding=1,
+                      feature_group_count=self.groups, use_bias=False,
+                      kernel_init=kaiming_normal_fan_out, name="conv2")(out)
+        out = nn.relu(_Norm(self.norm, name="bn2")(out, train))
+        out = nn.Conv(out_ch, (1, 1), use_bias=False,
+                      kernel_init=kaiming_normal_fan_out, name="conv3")(out)
+        out = _Norm(self.norm, name="bn3")(out, train)
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            identity = nn.Conv(out_ch, (1, 1), strides=self.stride,
+                               use_bias=False, kernel_init=kaiming_normal_fan_out,
+                               name="down_conv")(x)
+            identity = _Norm(self.norm, name="down_norm")(identity, train)
+        return nn.relu(out + identity)
+
+
+class ResNet(nn.Module):
+    block: str = "bottleneck"  # "basic" | "bottleneck"
+    layers: Sequence[int] = (3, 4, 23, 3)
+    num_classes: int = 1000
+    norm: str = "batch"
+    groups: int = 1
+    width_per_group: int = 64
+    initial_channels: int = 1  # the fork's EMNIST edit (resnets.py:155-156)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        out = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
+                      kernel_init=kaiming_normal_fan_out, name="conv1")(x)
+        out = nn.relu(_Norm(self.norm, name="bn1")(out, train))
+        out = nn.max_pool(out, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_cls = BasicBlock if self.block == "basic" else Bottleneck
+        for stage, (planes, blocks) in enumerate(zip((64, 128, 256, 512), self.layers)):
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                if self.block == "basic":
+                    out = block_cls(planes, stride, self.norm,
+                                    name=f"layer{stage + 1}_{b}")(out, train)
+                else:
+                    out = block_cls(planes, stride, self.norm, self.groups,
+                                    self.width_per_group,
+                                    name=f"layer{stage + 1}_{b}")(out, train)
+        out = global_avg_pool(out)
+        return nn.Dense(self.num_classes, name="fc")(out)
+
+
+def resnet18(**kw):
+    return ResNet(block="basic", layers=(2, 2, 2, 2), **kw)
+
+
+def resnet34(**kw):
+    return ResNet(block="basic", layers=(3, 4, 6, 3), **kw)
+
+
+def resnet50(**kw):
+    return ResNet(block="bottleneck", layers=(3, 4, 6, 3), **kw)
+
+
+def resnet101(**kw):
+    return ResNet(block="bottleneck", layers=(3, 4, 23, 3), **kw)
+
+
+def resnet152(**kw):
+    return ResNet(block="bottleneck", layers=(3, 8, 36, 3), **kw)
+
+
+def resnext50_32x4d(**kw):
+    return ResNet(block="bottleneck", layers=(3, 4, 6, 3), groups=32,
+                  width_per_group=4, **kw)
+
+
+def resnext101_32x8d(**kw):
+    return ResNet(block="bottleneck", layers=(3, 4, 23, 3), groups=32,
+                  width_per_group=8, **kw)
+
+
+def wide_resnet50_2(**kw):
+    return ResNet(block="bottleneck", layers=(3, 4, 6, 3), width_per_group=128, **kw)
+
+
+def wide_resnet101_2(**kw):
+    return ResNet(block="bottleneck", layers=(3, 4, 23, 3), width_per_group=128, **kw)

@@ -1,0 +1,8 @@
+#!/bin/bash
+# CPU test harness: strips the axon TPU registration (which serializes python
+# startups through the TPU tunnel claim) and forces an 8-device virtual CPU
+# mesh. Usage: scripts/test.sh [pytest args]
+cd "$(dirname "$0")/.."
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m pytest "${@:-tests/ -x -q}"

@@ -1,0 +1,131 @@
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "40")
+os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_CLIENTS", "12")
+
+from commefficient_tpu.data_utils import (
+    FedCIFAR10,
+    FedEMNIST,
+    FedLoader,
+    FedSampler,
+    num_classes_of_dataset,
+    transforms,
+)
+
+
+@pytest.fixture(scope="module")
+def cifar_dir(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("cifar"))
+
+
+@pytest.fixture(scope="module")
+def train_ds(cifar_dir):
+    return FedCIFAR10(cifar_dir, "CIFAR10",
+                      transform=transforms.cifar10_test_transforms, train=True)
+
+
+class TestFedCIFAR10:
+    def test_natural_partition_one_class_per_client(self, train_ds):
+        assert train_ds.num_clients == 10
+        assert len(train_ds) == 400  # 10 classes * 40 synthetic
+        cid, img, target = train_ds[0]
+        # train target IS the client id (reference fed_cifar.py:77-84)
+        assert cid == target
+        assert img.shape == (32, 32, 3)
+
+    def test_flat_index_to_client(self, train_ds):
+        ipc = train_ds.images_per_client
+        # item just past the first client's range belongs to client 1
+        cid, _, t = train_ds[int(ipc[0])]
+        assert cid == 1
+
+    def test_val_sentinel(self, cifar_dir):
+        val = FedCIFAR10(cifar_dir, "CIFAR10",
+                         transform=transforms.cifar10_test_transforms,
+                         train=False)
+        cid, img, t = val[0]
+        assert cid == -1
+
+    def test_iid_resharding(self, cifar_dir):
+        ds = FedCIFAR10(cifar_dir, "CIFAR10", do_iid=True, num_clients=8,
+                        train=True)
+        assert ds.num_clients == 8
+        dpc = ds.data_per_client
+        assert dpc.sum() == len(ds)
+        assert dpc.max() - dpc.min() <= 1
+
+    def test_non_iid_subdivision(self, cifar_dir):
+        ds = FedCIFAR10(cifar_dir, "CIFAR10", num_clients=20, train=True)
+        dpc = ds.data_per_client
+        assert len(dpc) == 20
+        assert dpc.sum() == len(ds)
+
+
+class TestFedSampler:
+    def test_epoch_covers_everything_once(self, train_ds):
+        s = FedSampler(train_ds, num_workers=4, local_batch_size=8)
+        seen = []
+        for batch in s:
+            seen.extend(batch.tolist())
+        assert sorted(seen) == list(range(len(train_ds)))
+
+    def test_whole_client_batches(self, train_ds):
+        s = FedSampler(train_ds, num_workers=2, local_batch_size=-1)
+        sizes = [len(b) for b in s]
+        # every batch is 2 whole clients (40 each)
+        assert all(sz == 80 for sz in sizes[:-1])
+
+
+class TestFedLoader:
+    def test_train_batch_layout(self, train_ds):
+        dl = FedLoader(train_ds, num_workers=4, local_batch_size=8)
+        b = next(iter(dl))
+        assert b["inputs"].shape == (4, 8, 32, 32, 3)
+        assert b["targets"].shape == (4, 8)
+        assert b["mask"].shape == (4, 8)
+        assert b["client_ids"].shape == (4,)
+        assert b["worker_mask"].sum() == 4
+
+    def test_masks_cover_all_data(self, train_ds):
+        dl = FedLoader(train_ds, num_workers=4, local_batch_size=8)
+        total = sum(int(b["mask"].sum()) for b in dl)
+        assert total == len(train_ds)
+
+    def test_val_batches(self, cifar_dir):
+        val = FedCIFAR10(cifar_dir, "CIFAR10",
+                         transform=transforms.cifar10_test_transforms,
+                         train=False)
+        dl = FedLoader(val, val_batch_size=16)
+        batches = list(dl)
+        assert batches[0]["inputs"].shape == (16, 32, 32, 3)
+        total = sum(int(b["mask"].sum()) for b in batches)
+        assert total == len(val)
+
+
+class TestFedEMNIST:
+    def test_synthetic_clients(self, tmp_path):
+        ds = FedEMNIST(str(tmp_path), "EMNIST", train=True)
+        assert ds.num_clients == 12
+        cid, img, t = ds[0]
+        assert img.shape == (28, 28)
+        assert 0 <= t < 62
+
+
+class TestTransforms:
+    def test_cifar_train_shapes_and_norm(self):
+        img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+        out = transforms.cifar10_train_transforms(img)
+        assert out.shape == (32, 32, 3)
+        assert out.dtype == np.float32
+
+    def test_femnist_train(self):
+        img = np.random.rand(28, 28).astype(np.float32)
+        out = transforms.femnist_train_transforms(img)
+        assert out.shape == (28, 28, 1)
+
+    def test_registry(self):
+        assert num_classes_of_dataset("CIFAR10") == 10
+        assert num_classes_of_dataset("EMNIST") == 62

@@ -1,0 +1,115 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu import models
+
+
+def _init_and_apply(model, x, train=True):
+    variables = model.init(jax.random.key(0), x, train=False)
+    if "batch_stats" in variables:
+        out, _ = model.apply(variables, x, train=train,
+                             mutable=["batch_stats"])
+    else:
+        out = model.apply(variables, x, train=train)
+    return variables, out
+
+
+class TestResNet9:
+    def test_cifar_shapes(self):
+        m = models.ResNet9()
+        x = jnp.zeros((2, 32, 32, 3))
+        variables, out = _init_and_apply(m, x)
+        assert out.shape == (2, 10)
+
+    def test_param_count_matches_reference_scale(self):
+        """ResNet9 (no BN) should have ~6.57M params like the torch original."""
+        m = models.ResNet9()
+        variables = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+        n = sum(int(np.prod(p.shape)) for p in
+                jax.tree_util.tree_leaves(variables["params"]))
+        assert 6.4e6 < n < 6.7e6, n
+
+    def test_batchnorm_variant(self):
+        m = models.ResNet9(do_batchnorm=True)
+        x = jnp.zeros((2, 32, 32, 3))
+        variables = m.init(jax.random.key(0), x, train=False)
+        assert "batch_stats" in variables
+        out, updates = m.apply(variables, x, train=True,
+                               mutable=["batch_stats"])
+        assert out.shape == (2, 10)
+
+    def test_emnist_single_channel(self):
+        m = models.ResNet9(initial_channels=1, num_classes=62)
+        x = jnp.zeros((2, 32, 32, 1))
+        _, out = _init_and_apply(m, x)
+        assert out.shape == (2, 62)
+
+    def test_finetune_head(self):
+        m = models.ResNet9(new_num_classes=62)
+        x = jnp.zeros((1, 32, 32, 3))
+        _, out = _init_and_apply(m, x)
+        assert out.shape == (1, 62)
+        assert models.ResNet9.finetune_trainable(("linear", "kernel"))
+        assert not models.ResNet9.finetune_trainable(("prep", "Conv_0", "kernel"))
+
+
+class TestFixup:
+    def test_fixup_resnet9_zero_output_at_init(self):
+        """Fixup zero-inits the classifier → logits are exactly 0 at init."""
+        m = models.FixupResNet9()
+        x = jnp.ones((2, 32, 32, 3))
+        variables = m.init(jax.random.key(0), x)
+        out = m.apply(variables, x)
+        np.testing.assert_allclose(out, 0.0)
+
+    def test_fixup_resnet18(self):
+        m = models.FixupResNet18()
+        _, out = _init_and_apply(m, jnp.ones((2, 32, 32, 3)))
+        assert out.shape == (2, 10)
+
+    def test_resnet18(self):
+        m = models.ResNet18()
+        _, out = _init_and_apply(m, jnp.ones((2, 32, 32, 3)))
+        assert out.shape == (2, 10)
+
+    def test_fixup_bottleneck_stack(self):
+        # structural check at reduced depth (full FixupResNet50 compile on
+        # CPU is minutes-slow; marked slow below)
+        m = models.FixupResNet50(layers=(1, 1, 1, 1), num_classes=10)
+        _, out = _init_and_apply(m, jnp.ones((1, 32, 32, 3)))
+        assert out.shape == (1, 10)
+
+    @pytest.mark.slow
+    def test_fixup_resnet50_imagenet_shape(self):
+        m = models.FixupResNet50(num_classes=1000)
+        _, out = _init_and_apply(m, jnp.ones((1, 64, 64, 3)))
+        assert out.shape == (1, 1000)
+
+
+class TestResNetFamily:
+    def test_layernorm_bottleneck_stack(self):
+        m = models.ResNet(block="bottleneck", layers=(1, 1, 1, 1),
+                          num_classes=62, norm="layer", initial_channels=1)
+        x = jnp.ones((1, 28, 28, 1))
+        variables = m.init(jax.random.key(0), x, train=False)
+        out = m.apply(variables, x, train=False)
+        assert out.shape == (1, 62)
+        # LayerNorm → no batch_stats collection
+        assert "batch_stats" not in variables
+
+    @pytest.mark.slow
+    def test_resnet101ln_femnist(self):
+        m = models.ResNet101LN(num_classes=62)
+        x = jnp.ones((1, 28, 28, 1))
+        variables = m.init(jax.random.key(0), x, train=False)
+        out = m.apply(variables, x, train=False)
+        assert out.shape == (1, 62)
+
+    def test_registry_contains_reference_names(self):
+        names = [m for m in dir(models) if not m.startswith("__") and m[0].isupper()]
+        for required in ["ResNet9", "FixupResNet9", "FixupResNet50",
+                         "ResNet18", "FixupResNet18", "ResNet101LN"]:
+            assert required in names
