@@ -182,7 +182,7 @@ def build_round_step(
             mesh=mesh,
             in_specs=(rep, vec, vec, vec, rep, vec, rep, vec, vec),
             out_specs=(rep, vec, vec, rep, vec),
-            check_rep=False,
+            check_vma=False,
         )
     else:
         clients_sharded = clients_shard
