@@ -1,0 +1,33 @@
+from commefficient_tpu.federated.aggregator import (
+    FedModel,
+    FedOptimizer,
+    LambdaLR,
+)
+from commefficient_tpu.federated.rounds import (
+    ClientStates,
+    RoundConfig,
+    build_round_step,
+    init_client_states,
+)
+from commefficient_tpu.federated.server import (
+    ServerConfig,
+    ServerState,
+    init_server_state,
+    server_update,
+)
+from commefficient_tpu.federated.worker import WorkerConfig
+
+__all__ = [
+    "FedModel",
+    "FedOptimizer",
+    "LambdaLR",
+    "ClientStates",
+    "RoundConfig",
+    "build_round_step",
+    "init_client_states",
+    "ServerConfig",
+    "ServerState",
+    "init_server_state",
+    "server_update",
+    "WorkerConfig",
+]

@@ -1,0 +1,333 @@
+"""FedModel / FedOptimizer — the user-facing API shells.
+
+Call-surface parity with the reference (fed_aggregator.py:54-461): ``FedModel``
+is callable like a model — train rounds return
+``[loss_array, acc_array, download_bytes, upload_bytes]``, val calls return
+``[loss_array, acc_array]`` (reference fed_aggregator.py:334-335, 364) — plus
+``train(bool)``, ``finalize()``, ``state_dict()``, ``save_pretrained()``;
+``FedOptimizer`` exposes ``step()`` / ``get_lr()`` and is driven by a
+``LambdaLR``-style scheduler.
+
+What changed underneath (and why): the reference's module-level globals,
+spawned worker processes, queues and shared-memory tensors disappear — state
+lives in device arrays owned by FedModel, the round runs as the jitted
+client/server phases of ``federated.rounds``, and the cross-phase contract is
+the explicit ``RoundContext`` instead of globals (fed_aggregator.py:37-44).
+``finalize()`` is therefore a no-op kept for API parity (reference
+fed_aggregator.py:196-203 joins worker processes).
+
+Per-param-group LRs (Fixup's 0.1/0.1/1, reference cv_train.py:366-376 and
+fed_aggregator.py:411-427) are supported as (mask, base_lr) groups over the
+flat vector; a group with base_lr 0 freezes its coordinates, which is how
+finetuning freezes the backbone (the reference instead drops frozen params
+from the flat vector, reference cv_train.py:377-384 — a documented layout
+deviation: our grad_size includes frozen coordinates).
+
+Byte accounting parity (fed_aggregator.py:170-299): upload = 4 B × mode-size
+for each participating client; download regime (a) for single-epoch
+full-participation runs tracks an updated-since-init mask on device; regime
+(b) keeps a bounded deque of weight snapshots and charges each sampled client
+the count of coordinates changed since it last participated (deque capped at
+``COMMEFFICIENT_MAX_DEQUE`` snapshots — beyond the cap the estimate
+undershoots exactly as the reference's ``maxlen`` clamp does,
+fed_aggregator.py:264-271).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.federated.rounds import (
+    RoundConfig,
+    build_round_step,
+    init_client_states,
+)
+from commefficient_tpu.federated.server import ServerConfig, init_server_state
+from commefficient_tpu.federated.worker import WorkerConfig
+from commefficient_tpu.ops.flat import ravel_pytree
+from commefficient_tpu.ops.sketch import make_sketch
+
+DEQUE_MAXLEN_MULT = 10  # Poisson-staleness argument, fed_aggregator.py:186-191
+
+# reference fed_aggregator.py:68-72
+DEFAULT_NUM_CLIENTS = {"EMNIST": 3500, "PERSONA": 17568}
+
+
+def worker_config_from_args(args) -> WorkerConfig:
+    return WorkerConfig(
+        mode=args.mode,
+        error_type=args.error_type,
+        k=args.k,
+        num_workers=args.num_workers,
+        weight_decay=args.weight_decay,
+        local_momentum=args.local_momentum,
+        microbatch_size=args.microbatch_size,
+        max_grad_norm=args.max_grad_norm,
+        do_dp=args.do_dp,
+        dp_mode=args.dp_mode,
+        l2_norm_clip=args.l2_norm_clip,
+        noise_multiplier=args.noise_multiplier,
+        num_fedavg_epochs=args.num_fedavg_epochs,
+        fedavg_batch_size=args.fedavg_batch_size,
+        fedavg_lr_decay=args.fedavg_lr_decay,
+        do_topk_down=args.do_topk_down,
+    )
+
+
+def server_config_from_args(args, grad_size: int) -> ServerConfig:
+    return ServerConfig(
+        mode=args.mode,
+        error_type=args.error_type,
+        k=args.k,
+        grad_size=grad_size,
+        virtual_momentum=args.virtual_momentum,
+        local_momentum=args.local_momentum,
+        do_dp=args.do_dp,
+        dp_mode=args.dp_mode,
+        noise_multiplier=args.noise_multiplier,
+    )
+
+
+class FedModel:
+    def __init__(self, model, compute_loss_train, args, compute_loss_val=None,
+                 input_shape: Optional[Tuple[int, ...]] = None,
+                 num_clients: Optional[int] = None, mesh=None,
+                 init_params=None, model_state=None):
+        self.model = model
+        self.args = args
+        self.mesh = mesh
+        self.training = True
+
+        num_clients = num_clients or args.num_clients or \
+            DEFAULT_NUM_CLIENTS.get(args.dataset_name)
+        assert num_clients is not None, \
+            "num_clients must come from CLI, dataset, or defaults"
+        self.num_clients = int(num_clients)
+
+        # initialize template params
+        if init_params is None:
+            assert input_shape is not None
+            x = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
+            variables = model.init(jax.random.key(args.seed), x, train=False)
+            init_params = variables["params"]
+            model_state = variables.get("batch_stats", {})
+        self._model_state = model_state if model_state is not None else {}
+        flat, self.unravel = ravel_pytree(init_params)
+        self.grad_size = int(flat.size)
+        args.grad_size = self.grad_size  # mirrored mutation, fed_aggregator.py:88
+        self.ps_weights = flat
+
+        def ravel(tree):
+            return ravel_pytree(tree)[0]
+
+        wcfg = worker_config_from_args(args)
+        scfg = server_config_from_args(args, self.grad_size)
+        self.worker_config, self.server_config = wcfg, scfg
+        self.sketch = None
+        if args.mode == "sketch":
+            # args2sketch equivalent (reference fed_aggregator.py:464-467)
+            self.sketch = make_sketch(self.grad_size, args.num_cols,
+                                      args.num_rows, seed=args.seed,
+                                      num_blocks=args.num_blocks)
+        cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=self.grad_size,
+                          do_test=args.do_test)
+        from commefficient_tpu.federated.losses import make_cv_losses  # noqa: F401
+
+        self.steps = build_round_step(
+            compute_loss_train,
+            compute_loss_val or compute_loss_train,
+            self.unravel, ravel, cfg, sketch=self.sketch, mesh=mesh)
+        self.client_states = init_client_states(
+            self.num_clients, self.grad_size, wcfg, init_weights=flat)
+
+        self._round_ctx = None
+        self._rng = jax.random.key(args.seed + 1)
+
+        # ---- download-byte tracking (fed_aggregator.py:170-194) ----
+        self._simple_download = (args.num_epochs <= 1
+                                 and args.local_batch_size == -1)
+        if self._simple_download:
+            self._updated_since_init = jnp.zeros(self.grad_size, bool)
+            self._prev_ps = self.ps_weights
+        else:
+            participation = args.num_workers / self.num_clients
+            maxlen = int(DEQUE_MAXLEN_MULT / max(participation, 1e-9))
+            maxlen = min(maxlen,
+                         int(os.environ.get("COMMEFFICIENT_MAX_DEQUE", 50)))
+            self._ps_history = deque([], maxlen=max(maxlen, 1))
+            self._client_stale_iters = np.zeros(self.num_clients, np.int64)
+
+    # -- reference API surface -------------------------------------------
+
+    def train(self, training: bool):
+        self.training = training
+
+    def finalize(self):
+        """No worker processes to join (reference fed_aggregator.py:196-203)."""
+
+    def __call__(self, batch: dict):
+        if self.training:
+            return self._call_train(batch)
+        return self._call_val(batch)
+
+    def zero_grad(self):
+        pass  # gradients are per-call values in the functional design
+
+    # -- state access ------------------------------------------------------
+
+    @property
+    def params(self):
+        return self.unravel(self.ps_weights)
+
+    def state_dict(self):
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def save_pretrained(self, log_dir: str):
+        from commefficient_tpu.federated.checkpoint import save_checkpoint
+
+        save_checkpoint(os.path.join(log_dir, "model"), self.params,
+                        model_state=self._model_state)
+
+    # -- internals ---------------------------------------------------------
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _call_train(self, batch: dict):
+        ids = np.asarray(batch["client_ids"])
+        wmask = np.asarray(batch["worker_mask"])
+        participating = np.unique(ids[wmask > 0])
+
+        download, upload = self._account_bytes(participating)
+
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        lr = self._current_lr()
+        ctx, self._model_state, metrics = self.steps.client_step(
+            self.ps_weights, self.client_states, self._model_state, jbatch,
+            lr, self._next_rng())
+        self._round_ctx = ctx
+
+        loss, acc, count = (np.asarray(m) for m in metrics)
+        valid = wmask > 0
+        return [loss[valid], acc[valid], download, upload]
+
+    def _call_val(self, batch: dict):
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        metrics = self.steps.val_step(self.ps_weights, self._model_state,
+                                      jbatch)
+        loss, acc, count = (np.asarray(m) for m in metrics)
+        return [np.array([loss]), np.array([acc])]
+
+    def _current_lr(self):
+        return getattr(self, "_opt_lr", 1.0)
+
+    def _account_bytes(self, participating):
+        args = self.args
+        download = np.zeros(self.num_clients, np.float64)
+        upload = np.zeros(self.num_clients, np.float64)
+        upload_per = {
+            "uncompressed": self.grad_size,
+            "true_topk": self.grad_size,
+            "local_topk": args.k,
+            "sketch": args.num_rows * args.num_cols,
+            "fedavg": self.grad_size,
+        }[args.mode] * 4
+        upload[participating] = upload_per
+
+        if self._simple_download:
+            diff = self.ps_weights - self._prev_ps
+            self._updated_since_init = self._updated_since_init | (diff != 0)
+            self._prev_ps = self.ps_weights
+            download[participating] = 4.0 * float(
+                jnp.sum(self._updated_since_init))
+        else:
+            cur = np.asarray(self.ps_weights)
+            self._ps_history.append(cur)
+            maxlen = self._ps_history.maxlen
+            for c in participating:
+                stale = int(min(self._client_stale_iters[c], maxlen - 1))
+                prev = self._ps_history[-(stale + 1)]
+                download[c] = 4.0 * float(np.count_nonzero(cur != prev))
+            self._client_stale_iters[participating] = 0
+            self._client_stale_iters += 1
+        return download, upload
+
+
+class FedOptimizer:
+    """Server-side optimizer (reference fed_aggregator.py:383-461).
+
+    ``param_groups``: list of (mask, base_lr) over the flat vector; a single
+    group with mask None behaves like the reference's SGD(lr=1) wrapper.
+    """
+
+    def __init__(self, fed_model: FedModel, args,
+                 param_groups: Optional[Sequence[Tuple[Optional[np.ndarray],
+                                                       float]]] = None):
+        self.fed_model = fed_model
+        self.args = args
+        self.param_groups = param_groups or [(None, 1.0)]
+        self._lr_factor = 0.0
+        self.server_state = init_server_state(fed_model.server_config,
+                                              fed_model.sketch)
+        self._base_lr_vec = None
+        if len(self.param_groups) > 1 or self.param_groups[0][0] is not None:
+            vec = np.zeros(fed_model.grad_size, np.float32)
+            for mask, base in self.param_groups:
+                if mask is None:
+                    vec[:] = base
+                else:
+                    vec[np.asarray(mask)] = base
+            self._base_lr_vec = jnp.asarray(vec)
+
+    def get_lr(self):
+        # scalar if single default group, else per-coordinate vector
+        # (reference fed_aggregator.py:411-427)
+        if self._base_lr_vec is None:
+            return self._lr_factor
+        return self._base_lr_vec * self._lr_factor
+
+    def set_lr_factor(self, factor: float):
+        self._lr_factor = float(factor)
+        # publish to the model so fedavg workers see the current LR
+        # (the g_lr shared tensor, reference fed_aggregator.py:99-101, 441-444)
+        self.fed_model._opt_lr = self.get_lr()
+
+    def step(self):
+        fm = self.fed_model
+        assert fm._round_ctx is not None, "call model(batch) before step()"
+        lr = self.get_lr()
+        new_ps, self.server_state, fm.client_states = fm.steps.server_step(
+            fm.ps_weights, self.server_state, fm.client_states, fm._round_ctx,
+            lr, fm._next_rng())
+        fm.ps_weights = new_ps
+        fm._round_ctx = None
+
+    def zero_grad(self):
+        raise NotImplementedError("call zero_grad() on the model instead")
+
+
+class LambdaLR:
+    """Minimal LambdaLR equivalent driving FedOptimizer (the reference reuses
+    torch's scheduler against a dummy SGD, reference cv_train.py:393-404)."""
+
+    def __init__(self, optimizer: FedOptimizer, lr_lambda: Callable[[int], float]):
+        self.optimizer = optimizer
+        self.lr_lambda = lr_lambda
+        self._step_count = 0
+        optimizer.set_lr_factor(lr_lambda(0))
+
+    def step(self):
+        self._step_count += 1
+        self.optimizer.set_lr_factor(self.lr_lambda(self._step_count))
+
+    def get_last_lr(self) -> List[float]:
+        factor = self.lr_lambda(self._step_count)
+        return [factor * base for _, base in self.optimizer.param_groups]

@@ -1,0 +1,75 @@
+"""Checkpoint save/load.
+
+Capability parity with the reference's save-only checkpointing
+(reference cv_train.py:418-421 ``torch.save(state_dict)``; GPT-2
+``save_pretrained``, reference gpt2_train.py:146, fed_aggregator.py:208-211)
+plus a load path for ``--finetune`` (reference cv_train.py:377-384).
+
+Format: a single ``.npz`` whose keys are '/'-joined param paths — readable
+with plain numpy, no framework dependency.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _flatten(tree, prefix=()):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, prefix + (str(k),)))
+    else:
+        out["/".join(prefix)] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]):
+    tree: Dict[str, Any] = {}
+    for key, v in flat.items():
+        node = tree
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def save_checkpoint(path: str, params, model_state=None):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    flat = _flatten({"params": params,
+                     "model_state": model_state if model_state else {}})
+    np.savez(path if path.endswith(".npz") else path + ".npz", **flat)
+
+
+def load_checkpoint(path: str):
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    with np.load(path) as data:
+        flat = {k: data[k] for k in data.files}
+    tree = _unflatten(flat)
+    return tree.get("params", {}), tree.get("model_state", {})
+
+
+def load_matching(template_params, ckpt_params):
+    """Copy checkpoint arrays into the template wherever path+shape match —
+    the finetune path: backbone loads, the re-shaped head keeps its fresh
+    init (reference cv_train.py:377-384 + models/resnet9.py:105-113)."""
+    t_flat = _flatten(template_params)
+    c_flat = _flatten(ckpt_params)
+    loaded, skipped = 0, []
+    out = {}
+    for k, v in t_flat.items():
+        if k in c_flat and c_flat[k].shape == v.shape:
+            out[k] = c_flat[k]
+            loaded += 1
+        else:
+            out[k] = v
+            skipped.append(k)
+    return jax.tree_util.tree_map(
+        jnp.asarray, _unflatten(out)), loaded, skipped

@@ -1,9 +1,9 @@
-"""The federated round as one jitted SPMD program.
+"""The federated round as jitted SPMD programs.
 
 This module replaces the reference's entire L0 distributed substrate —
 process spawn + mp.Queue scatter + shared-memory state + NCCL reduce
-(reference fed_aggregator.py:94-164, 301-332; fed_worker.py:14-138) — with a
-single compiled step over a ``jax.sharding.Mesh``:
+(reference fed_aggregator.py:94-164, 301-332; fed_worker.py:14-138) — with
+compiled steps over a ``jax.sharding.Mesh``:
 
   - the round's W sampled clients are lanes of a ``vmap``, sharded W/n per
     device via ``shard_map`` over the ``clients`` mesh axis (the reference's
@@ -13,14 +13,23 @@ single compiled step over a ``jax.sharding.Mesh``:
     fed_aggregator.py:327-330) — is a ``lax.psum`` over ICI. Sketch tables
     are fixed-shape and linear, which is exactly why they psum cleanly;
   - per-client persistent state (velocities/errors, reference
-    fed_aggregator.py:116-129) lives in device-sharded ``(num_clients, d)``
+    fed_aggregator.py:116-129) lives in device-resident ``(num_clients, d)``
     arrays; participating rows are gathered before the shard_map and
     scatter-updated afterwards with an add-of-deltas (safe w.r.t. padded
     duplicate slots);
-  - the server update runs replicated on the fresh round gradient, and
+  - the server update runs replicated on the round gradient, and
     ``ps_weights`` never leaves HBM (deliberate improvement over the
     reference's host-resident PS weights, fed_worker.py:41 /
     fed_aggregator.py:455).
+
+Two entry granularities are built from the same pieces:
+
+  - ``client_step`` / ``server_step`` — the reference's two-phase API
+    (``model(batch)`` computes and combines gradients; ``opt.step()`` applies
+    the server rule, reference cv_train.py:221-229), used by
+    FedModel/FedOptimizer;
+  - ``train_step`` — the fused single-dispatch round used by benchmarks and
+    the multichip dry-run.
 
 Train metrics come back per client slot; the host aggregates. ``worker_mask``
 zeroes contributions of padded slots (rounds where fewer than W clients
@@ -36,7 +45,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from commefficient_tpu.federated.server import (
@@ -62,6 +71,19 @@ class ClientStates(NamedTuple):
     velocities: Optional[jax.Array]  # (num_clients, d) iff local_momentum > 0
     errors: Optional[jax.Array]      # (num_clients, d) iff error_type == local
     weights: Optional[jax.Array]     # (num_clients, d) iff do_topk_down
+
+
+class RoundContext(NamedTuple):
+    """Client-phase outputs the server phase needs (the functional stand-in
+    for the reference's cross-phase module globals, fed_aggregator.py:37-44)."""
+
+    gradient: jax.Array
+    ids: jax.Array
+    vel_rows: jax.Array
+    err_rows: jax.Array
+    stale_rows: jax.Array
+    new_vel: jax.Array
+    new_err: jax.Array
 
 
 def init_client_states(num_clients: int, grad_size: int, wcfg: WorkerConfig,
@@ -90,6 +112,13 @@ class RoundConfig:
     do_test: bool = False
 
 
+class FederatedSteps(NamedTuple):
+    train_step: Callable   # fused round
+    client_step: Callable  # phase 1: gradients + client state rows
+    server_step: Callable  # phase 2: server rule + state scatter
+    val_step: Callable
+
+
 def build_round_step(
     compute_loss_train: Callable,
     compute_loss_val: Callable,
@@ -99,14 +128,7 @@ def build_round_step(
     sketch: Optional[CountSketch] = None,
     mesh: Optional[Mesh] = None,
     axis: str = "clients",
-):
-    """Returns (train_step, val_step), both jitted.
-
-    train_step(ps_weights, server_state, client_states, model_state, batch,
-               lr, rng) -> (ps_weights, server_state, client_states,
-                            model_state, metrics)
-    val_step(ps_weights, model_state, batch) -> metrics
-    """
+) -> FederatedSteps:
     wcfg, scfg = cfg.worker, cfg.server
 
     def one_client(ps_weights, vel_row, err_row, stale_row, model_state,
@@ -192,8 +214,10 @@ def build_round_step(
             return jnp.zeros((width, 1), jnp.float32)  # inert placeholder
         return state_arr[ids]
 
-    def train_step(ps_weights, server_state: ServerState,
-                   client_states: ClientStates, model_state, batch, lr, rng):
+    # ---- phase 1: client gradients -------------------------------------
+
+    def client_step(ps_weights, client_states: ClientStates, model_state,
+                    batch, lr, rng):
         ids = batch["client_ids"]
         W = ids.shape[0]
         worker_mask = batch["worker_mask"]
@@ -213,12 +237,23 @@ def build_round_step(
         total_count = jnp.maximum(batch["mask"].sum(), 1.0)
         gradient = total / total_count
 
-        # server step — fedavg applies lr on-worker (fed_aggregator.py:451)
-        rng, sub = jax.random.split(rng)
+        ctx = RoundContext(gradient, ids, vel_rows, err_rows, stale_rows,
+                           new_vel, new_err)
+        return ctx, new_model_state, metrics
+
+    # ---- phase 2: server update + state scatter ------------------------
+
+    def server_step(ps_weights, server_state: ServerState,
+                    client_states: ClientStates, ctx: RoundContext, lr, rng):
+        # fedavg applies lr on-worker; server sees lr=1
+        # (reference fed_aggregator.py:441-451)
         eff_lr = 1.0 if wcfg.mode == "fedavg" else lr
-        update, new_server_state = server_update(gradient, server_state, scfg,
-                                                 eff_lr, sketch=sketch, rng=sub)
+        update, new_server_state = server_update(ctx.gradient, server_state,
+                                                 scfg, eff_lr, sketch=sketch,
+                                                 rng=rng)
         new_ps = ps_weights - update
+
+        ids = ctx.ids
 
         # scatter per-client state back via deltas (duplicate padded ids add 0)
         def scatter(state_arr, old_rows, new_rows):
@@ -227,10 +262,9 @@ def build_round_step(
             return state_arr.at[ids].add(new_rows - old_rows)
 
         cs = ClientStates(
-            velocities=scatter(client_states.velocities, vel_rows, new_vel
-                               if client_states.velocities is not None else None),
-            errors=scatter(client_states.errors, err_rows, new_err
-                           if client_states.errors is not None else None),
+            velocities=scatter(client_states.velocities, ctx.vel_rows,
+                               ctx.new_vel),
+            errors=scatter(client_states.errors, ctx.err_rows, ctx.new_err),
             weights=client_states.weights,
         )
         # true_topk momentum factor masking of local velocities at the global
@@ -245,16 +279,33 @@ def build_round_step(
         if wcfg.do_topk_down and cs.weights is not None:
             used = jax.vmap(lambda s: get_new_worker_weights(ps_weights, s,
                                                              wcfg.k, True))(
-                stale_rows)
-            cs = cs._replace(weights=cs.weights.at[ids].add(used - stale_rows))
+                ctx.stale_rows)
+            cs = cs._replace(weights=cs.weights.at[ids].add(used -
+                                                            ctx.stale_rows))
+        return new_ps, new_server_state, cs
 
+    # ---- fused round (bench / dry-run path) ----------------------------
+
+    def train_step(ps_weights, server_state, client_states, model_state,
+                   batch, lr, rng):
+        rng, sub = jax.random.split(rng)
+        ctx, new_model_state, metrics = client_step(ps_weights, client_states,
+                                                    model_state, batch, lr,
+                                                    rng)
+        new_ps, new_server_state, cs = server_step(ps_weights, server_state,
+                                                   client_states, ctx, lr,
+                                                   sub)
         return new_ps, new_server_state, cs, new_model_state, metrics
 
     def val_step(ps_weights, model_state, batch):
-        params_flat = ps_weights
         _, metrics, _, _ = forward_grad(
-            compute_loss_val, params_flat, unravel, ravel, model_state, batch,
+            compute_loss_val, ps_weights, unravel, ravel, model_state, batch,
             jax.random.key(0), wcfg, sketch, compute_grad=False)
         return metrics
 
-    return (jax.jit(train_step), jax.jit(val_step))
+    return FederatedSteps(
+        train_step=jax.jit(train_step),
+        client_step=jax.jit(client_step),
+        server_step=jax.jit(server_step),
+        val_step=jax.jit(val_step),
+    )

@@ -1,0 +1,61 @@
+"""End-to-end cv_train smoke tests on synthetic CIFAR10 — the TPU build's
+equivalent of the reference's ``--test`` smoke runs (SURVEY.md §4)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "24")
+os.environ.setdefault("COMMEFFICIENT_TINY_MODEL", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import cv_train  # noqa: E402
+
+
+def _run(tmp_path, extra):
+    argv = [
+        "--dataset_name", "CIFAR10",
+        "--dataset_dir", str(tmp_path / "data"),
+        "--num_epochs", "1",
+        "--num_workers", "2",
+        "--local_batch_size", "4",
+        "--valid_batch_size", "8",
+        "--iid",
+        "--num_clients", "4",
+        "--lr_scale", "0.01",
+        "--pivot_epoch", "0.5",
+        "--seed", "0",
+    ] + extra
+    return cv_train.main(argv)
+
+
+class TestEndToEnd:
+    def test_uncompressed_round_runs_and_learns_something(self, tmp_path):
+        summary = _run(tmp_path, ["--mode", "uncompressed",
+                                  "--local_momentum", "0"])
+        assert np.isfinite(summary["train_loss"])
+        assert np.isfinite(summary["test_acc"])
+
+    def test_sketch_mode_e2e(self, tmp_path):
+        summary = _run(tmp_path, [
+            "--mode", "sketch", "--error_type", "virtual",
+            "--local_momentum", "0",
+            "--k", "500", "--num_cols", "2048", "--num_rows", "3",
+            "--num_blocks", "2"])
+        assert np.isfinite(summary["train_loss"])
+
+    def test_true_topk_e2e(self, tmp_path):
+        summary = _run(tmp_path, ["--mode", "true_topk", "--error_type",
+                                  "virtual", "--local_momentum", "0",
+                                  "--k", "500"])
+        assert np.isfinite(summary["train_loss"])
+
+    def test_fedavg_e2e(self, tmp_path):
+        summary = _run(tmp_path, ["--mode", "fedavg", "--local_batch_size",
+                                  "-1", "--local_momentum", "0",
+                                  "--error_type", "none",
+                                  "--num_fedavg_epochs", "1"])
+        assert np.isfinite(summary["train_loss"])

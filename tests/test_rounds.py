@@ -55,9 +55,10 @@ def _setup(mode="uncompressed", error_type="none", num_workers=8, k=2,
     sketch = make_sketch(D, 16, 3, seed=0, num_blocks=1) if mode == "sketch" \
         else None
     cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=D)
-    train_step, val_step = build_round_step(
+    steps = build_round_step(
         _linear_loss, _linear_loss, unravel, ravel, cfg, sketch=sketch,
         mesh=mesh)
+    train_step, val_step = steps.train_step, steps.val_step
     server_state = init_server_state(scfg, sketch)
     client_states = init_client_states(16, D, wcfg, init_weights=flat)
     return flat, train_step, val_step, server_state, client_states
