@@ -1,0 +1,118 @@
+"""Benchmark: CIFAR10 federated rounds/sec on one chip.
+
+Runs the fused federated train step (ResNet9, 8 simulated clients per round,
+count-sketch compression 5x500k/k=50k — the FetchSGD headline CIFAR10 config,
+reference utils.py:142-162) on synthetic CIFAR-shaped data and reports
+steady-state rounds/sec. Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` is measured against BASELINE_ROUNDS_PER_SEC below — the
+reference publishes no numbers (BASELINE.md), so the constant encodes an
+A100-class estimate for the same config: 8 sequential ResNet9 fwd+bwd on
+batches of 8 plus CUDA CSVec sketching at ~180 ms/round ≈ 5.5 rounds/s.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_ROUNDS_PER_SEC = 5.5
+
+NUM_WORKERS = 8
+LOCAL_BS = 8
+WARMUP = 3
+ITERS = 20
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu import models
+    from commefficient_tpu.federated.losses import make_cv_losses
+    from commefficient_tpu.federated.rounds import (
+        RoundConfig,
+        build_round_step,
+        init_client_states,
+    )
+    from commefficient_tpu.federated.server import (
+        ServerConfig,
+        init_server_state,
+    )
+    from commefficient_tpu.federated.worker import WorkerConfig
+    from commefficient_tpu.ops.flat import ravel_pytree
+    from commefficient_tpu.ops.sketch import make_sketch
+
+    model = models.ResNet9()
+    x0 = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.key(0), x0, train=False)["params"]
+    flat, unravel = ravel_pytree(params)
+    d = int(flat.size)
+
+    def ravel(tree):
+        return ravel_pytree(tree)[0]
+
+    wcfg = WorkerConfig(mode="sketch", error_type="virtual", k=50_000,
+                        num_workers=NUM_WORKERS, weight_decay=5e-4)
+    scfg = ServerConfig(mode="sketch", error_type="virtual", k=50_000,
+                        grad_size=d, virtual_momentum=0.9)
+    sketch = make_sketch(d, c=500_000, r=5, seed=42, num_blocks=20)
+    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d)
+    loss_train, loss_val = make_cv_losses(model)
+    steps = build_round_step(loss_train, loss_val, unravel, ravel, cfg,
+                             sketch=sketch, mesh=None)
+
+    num_clients = 10
+    server_state = init_server_state(scfg, sketch)
+    client_states = init_client_states(num_clients, d, wcfg)
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "inputs": jnp.asarray(
+            rng.randn(NUM_WORKERS, LOCAL_BS, 32, 32, 3), jnp.float32),
+        "targets": jnp.asarray(
+            rng.randint(0, 10, (NUM_WORKERS, LOCAL_BS))),
+        "mask": jnp.ones((NUM_WORKERS, LOCAL_BS), jnp.float32),
+        "client_ids": jnp.asarray(
+            np.arange(NUM_WORKERS) % num_clients, jnp.int32),
+        "worker_mask": jnp.ones(NUM_WORKERS, jnp.float32),
+    }
+    return steps, flat, server_state, client_states, batch
+
+
+def main():
+    import jax
+
+    steps, ps, server_state, client_states, batch = build()
+    rng = jax.random.key(0)
+
+    state = (ps, server_state, client_states, {})
+    for _ in range(WARMUP):
+        out = steps.train_step(state[0], state[1], state[2], state[3], batch,
+                               0.1, rng)
+        state = out[:4]
+    jax.block_until_ready(state[0])
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = steps.train_step(state[0], state[1], state[2], state[3], batch,
+                               0.1, rng)
+        state = out[:4]
+    jax.block_until_ready(state[0])
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = ITERS / dt
+    print(json.dumps({
+        "metric": "CIFAR10 fed rounds/sec/chip (ResNet9, 8 workers, sketch 5x500k k=50k)",
+        "value": round(rounds_per_sec, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": round(rounds_per_sec / BASELINE_ROUNDS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
