@@ -8,7 +8,17 @@ from commefficient_tpu.data_utils.fed_dataset import FedDataset
 from commefficient_tpu.data_utils.fed_cifar import FedCIFAR10, FedCIFAR100
 from commefficient_tpu.data_utils.fed_emnist import FedEMNIST
 from commefficient_tpu.data_utils.fed_imagenet import FedImageNet
+from commefficient_tpu.data_utils.fed_persona import (
+    FedPERSONA,
+    make_personachat_collate_fn,
+    personachat_collate_fn,
+)
 from commefficient_tpu.data_utils.fed_sampler import FedSampler
+from commefficient_tpu.data_utils.tokenization import (
+    ATTR_TO_SPECIAL_TOKEN,
+    ByteTokenizer,
+    get_tokenizer,
+)
 from commefficient_tpu.data_utils.loader import FedLoader, cv_collate
 from commefficient_tpu.data_utils import transforms
 
@@ -31,6 +41,12 @@ __all__ = [
     "FedCIFAR100",
     "FedEMNIST",
     "FedImageNet",
+    "FedPERSONA",
+    "personachat_collate_fn",
+    "make_personachat_collate_fn",
+    "ByteTokenizer",
+    "get_tokenizer",
+    "ATTR_TO_SPECIAL_TOKEN",
     "FedSampler",
     "FedLoader",
     "cv_collate",

@@ -115,7 +115,7 @@ class FedLoader:
         for start in range(0, N, B):
             idxs = range(start, min(start + B, N))
             cols = self._fetch(idxs)
-            n = len(cols["targets"])
+            n = len(next(iter(cols.values())))
             mask = np.zeros(B, np.float32)
             mask[:n] = 1.0
             batch = {

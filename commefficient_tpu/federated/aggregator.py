@@ -215,16 +215,16 @@ class FedModel:
             lr, self._next_rng())
         self._round_ctx = ctx
 
-        loss, acc, count = (np.asarray(m) for m in metrics)
+        *ms, count = (np.asarray(m) for m in metrics)
         valid = wmask > 0
-        return [loss[valid], acc[valid], download, upload]
+        return [m[valid] for m in ms] + [download, upload]
 
     def _call_val(self, batch: dict):
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
         metrics = self.steps.val_step(self.ps_weights, self._model_state,
                                       jbatch)
-        loss, acc, count = (np.asarray(m) for m in metrics)
-        return [np.array([loss]), np.array([acc])]
+        *ms, count = (np.asarray(m) for m in metrics)
+        return [np.array([m]) for m in ms]
 
     def _current_lr(self):
         return getattr(self, "_opt_lr", 1.0)

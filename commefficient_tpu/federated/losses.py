@@ -49,3 +49,62 @@ def make_cv_losses(model, has_batch_stats: bool = False):
         return loss_sum, (acc_sum,), count, new_state
 
     return compute, compute
+
+
+def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
+    """GPT-2 double-heads losses (reference gpt2_train.py:55-99).
+
+    Train: ``lm_coef·lm_loss + mc_coef·mc_loss`` per example; no extra
+    metrics (the reference returns a bare (loss,) tuple). Val: (nll, mc
+    accuracy); perplexity is exp(mean nll) computed by the harness
+    (reference gpt2_train.py:253). Deviation: per-example token-mean nll
+    averaged over examples, where the reference means over all non-ignored
+    tokens of the batch — identical when sequences have equal valid-token
+    counts, and the per-example form is what masked client-weighted
+    aggregation needs.
+    """
+
+    def _lm_nll_per_example(lm_logits, lm_labels):
+        # shift: predict token t+1 from position t (gpt2_train.py:63-67)
+        logits = lm_logits[..., :-1, :]
+        labels = lm_labels[..., 1:]
+        valid = labels != -1
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        tok_nll = tok_nll * valid
+        # sum over candidates & positions, normalize by valid token count
+        per_ex = tok_nll.sum(axis=(-2, -1)) / jnp.maximum(
+            valid.sum(axis=(-2, -1)), 1)
+        return per_ex
+
+    def _mc_ce_acc(mc_logits, mc_labels):
+        logp = jax.nn.log_softmax(mc_logits, axis=-1)
+        ce = -jnp.take_along_axis(logp, mc_labels[..., None], axis=-1)[..., 0]
+        acc = (jnp.argmax(mc_logits, axis=-1) == mc_labels).astype(jnp.float32)
+        return ce, acc
+
+    def compute_train(params, model_state, batch, rng, train):
+        lm_logits, mc_logits = model.apply(
+            {"params": params}, batch["input_ids"],
+            token_type_ids=batch["token_type_ids"],
+            mc_token_ids=batch["mc_token_ids"], train=train,
+            rngs={"dropout": rng} if train else None)
+        lm_nll = _lm_nll_per_example(lm_logits, batch["lm_labels"])
+        mc_ce, _ = _mc_ce_acc(mc_logits, batch["mc_labels"])
+        mask = batch["mask"]
+        loss_sum = jnp.sum((lm_coef * lm_nll + mc_coef * mc_ce) * mask)
+        return loss_sum, (), jnp.sum(mask), model_state
+
+    def compute_val(params, model_state, batch, rng, train):
+        lm_logits, mc_logits = model.apply(
+            {"params": params}, batch["input_ids"],
+            token_type_ids=batch["token_type_ids"],
+            mc_token_ids=batch["mc_token_ids"], train=False)
+        lm_nll = _lm_nll_per_example(lm_logits, batch["lm_labels"])
+        _, acc = _mc_ce_acc(mc_logits, batch["mc_labels"])
+        mask = batch["mask"]
+        return (jnp.sum(lm_nll * mask), (jnp.sum(acc * mask),),
+                jnp.sum(mask), model_state)
+
+    return compute_train, compute_val

@@ -6,6 +6,7 @@ from commefficient_tpu.models.fixup_resnet9 import FixupResNet9
 from commefficient_tpu.models.fixup_resnet18 import ResNet18, FixupResNet18
 from commefficient_tpu.models.fixup_resnet import FixupResNet50
 from commefficient_tpu.models.resnet101ln import ResNet101LN
+from commefficient_tpu.models.gpt2 import GPT2DoubleHeads
 from commefficient_tpu.models.resnets import (
     ResNet,
     resnet18,
@@ -26,6 +27,7 @@ __all__ = [
     "FixupResNet18",
     "FixupResNet50",
     "ResNet101LN",
+    "GPT2DoubleHeads",
     "ResNet",
     "resnet18",
     "resnet34",

@@ -1,0 +1,199 @@
+"""GPT-2 with double heads (LM + multiple-choice), flax.
+
+Capability parity with ``GPT2DoubleHeadsModel`` from the external
+pytorch_transformers package the reference depends on (reference
+gpt2_train.py:4-6, 262-273): token/position embeddings (token_type_ids embed
+through the token table, as GPT-2 does), pre-LN transformer blocks with
+causal attention, weight-tied LM head, and a multiple-choice head reading the
+hidden state at ``mc_token_ids``. ``resize_token_embeddings`` equivalent:
+``resize_token_embeddings(params, new_size)`` pads the embedding table (the
+special-token surgery of reference gpt2_train.py:101-111).
+
+TPU notes: attention uses a single fused qkv projection (MXU-friendly),
+bfloat16-able activations, static causal mask via ``jnp.tril`` folded into
+the softmax, and the (batch, candidates, seq) layout is flattened to one
+batched axis before the transformer so the MXU sees large matmuls.
+
+Loading real pretrained weights requires local HF files (zero-egress
+environment) — ``load_hf_gpt2`` converts them when present, else models
+train from scratch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["GPT2DoubleHeads", "GPT2Config", "resize_token_embeddings",
+           "load_hf_gpt2"]
+
+
+class GPT2Config:
+    """gpt2-small geometry by default."""
+
+    def __init__(self, vocab_size=50257, n_positions=1024, n_embd=768,
+                 n_layer=12, n_head=12, dropout=0.1):
+        self.vocab_size = vocab_size
+        self.n_positions = n_positions
+        self.n_embd = n_embd
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.dropout = dropout
+
+
+class Block(nn.Module):
+    n_embd: int
+    n_head: int
+    dropout: float
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        h = nn.LayerNorm(epsilon=1e-5, name="ln_1")(x)
+        B, T, C = h.shape
+        qkv = nn.Dense(3 * C, name="attn_qkv",
+                       kernel_init=nn.initializers.normal(0.02))(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, T, self.n_head, C // self.n_head)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(C // self.n_head)
+        att = jnp.where(mask, att, jnp.finfo(att.dtype).min)
+        att = jax.nn.softmax(att, axis=-1)
+        att = nn.Dropout(self.dropout)(att, deterministic=deterministic)
+        out = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
+        out = nn.Dense(C, name="attn_proj",
+                       kernel_init=nn.initializers.normal(0.02))(out)
+        x = x + nn.Dropout(self.dropout)(out, deterministic=deterministic)
+
+        h = nn.LayerNorm(epsilon=1e-5, name="ln_2")(x)
+        h = nn.Dense(4 * C, name="mlp_fc",
+                     kernel_init=nn.initializers.normal(0.02))(h)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(C, name="mlp_proj",
+                     kernel_init=nn.initializers.normal(0.02))(h)
+        return x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
+
+
+class GPT2DoubleHeads(nn.Module):
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.1
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, mc_token_ids=None,
+                 train: bool = False):
+        """input_ids: (..., T) int32; token_type_ids same shape;
+        mc_token_ids: (...,) index of the classification token per sequence.
+
+        Returns (lm_logits (..., T, vocab), mc_logits (...,)).
+        """
+        orig_shape = input_ids.shape
+        T = orig_shape[-1]
+        flat_ids = input_ids.reshape(-1, T)
+        B = flat_ids.shape[0]
+
+        wte = nn.Embed(self.vocab_size, self.n_embd,
+                       embedding_init=nn.initializers.normal(0.02),
+                       name="wte")
+        wpe = nn.Embed(self.n_positions, self.n_embd,
+                       embedding_init=nn.initializers.normal(0.01),
+                       name="wpe")
+        x = wte(flat_ids) + wpe(jnp.arange(T))[None]
+        if token_type_ids is not None:
+            x = x + wte(token_type_ids.reshape(-1, T))
+        x = nn.Dropout(self.dropout)(x, deterministic=not train)
+
+        mask = jnp.tril(jnp.ones((T, T), bool))[None, None]
+        for i in range(self.n_layer):
+            x = Block(self.n_embd, self.n_head, self.dropout,
+                      name=f"h{i}")(x, mask, deterministic=not train)
+        x = nn.LayerNorm(epsilon=1e-5, name="ln_f")(x)
+
+        lm_logits = wte.attend(x)  # weight-tied LM head
+
+        mc_logits = None
+        if mc_token_ids is not None:
+            flat_mc = mc_token_ids.reshape(-1)
+            cls_h = x[jnp.arange(B), flat_mc]  # (B, C)
+            # SequenceSummary head: linear to a single logit
+            mc_logits = nn.Dense(1, name="mc_head",
+                                 kernel_init=nn.initializers.normal(0.02))(
+                cls_h)[..., 0]
+            mc_logits = mc_logits.reshape(orig_shape[:-1])
+
+        lm_logits = lm_logits.reshape(orig_shape + (self.vocab_size,))
+        return lm_logits, mc_logits
+
+
+def resize_token_embeddings(params, new_vocab_size: int, rng=None):
+    """Grow wte to ``new_vocab_size`` rows, preserving existing rows — the
+    embedding-resize after adding special tokens (reference
+    gpt2_train.py:101-111). New rows are N(0, 0.02) like fresh embeddings."""
+    wte = params["wte"]["embedding"]
+    old, dim = wte.shape
+    if new_vocab_size <= old:
+        return params
+    rng = rng if rng is not None else jax.random.key(0)
+    extra = 0.02 * jax.random.normal(rng, (new_vocab_size - old, dim),
+                                     wte.dtype)
+    new_wte = jnp.concatenate([wte, extra], axis=0)
+    out = dict(params)
+    out["wte"] = {"embedding": new_wte}
+    return out
+
+
+def load_hf_gpt2(params_template, checkpoint_dir: str):
+    """Convert locally cached HF GPT-2 torch weights into our layout.
+    Returns None when no local checkpoint exists (zero-egress default)."""
+    import os
+
+    candidates = [os.path.join(checkpoint_dir, f)
+                  for f in ("pytorch_model.bin", "model.safetensors")]
+    path = next((p for p in candidates if os.path.exists(p)), None)
+    if path is None:
+        return None
+    import torch
+
+    state = torch.load(path, map_location="cpu") if path.endswith(".bin") \
+        else None
+    if state is None:
+        return None
+    out = jax.tree_util.tree_map(np.asarray, params_template)
+
+    def put(dst_keys, arr):
+        node = out
+        for k in dst_keys[:-1]:
+            node = node[k]
+        node[dst_keys[-1]] = np.asarray(arr)
+
+    put(("wte", "embedding"), state["transformer.wte.weight"])
+    put(("wpe", "embedding"), state["transformer.wpe.weight"])
+    n_layer = sum(1 for k in out if k.startswith("h"))
+    for i in range(n_layer):
+        p = f"transformer.h.{i}."
+        blk = out[f"h{i}"]
+        blk["ln_1"]["scale"] = np.asarray(state[p + "ln_1.weight"])
+        blk["ln_1"]["bias"] = np.asarray(state[p + "ln_1.bias"])
+        blk["attn_qkv"]["kernel"] = np.asarray(state[p + "attn.c_attn.weight"])
+        blk["attn_qkv"]["bias"] = np.asarray(state[p + "attn.c_attn.bias"])
+        blk["attn_proj"]["kernel"] = np.asarray(state[p + "attn.c_proj.weight"])
+        blk["attn_proj"]["bias"] = np.asarray(state[p + "attn.c_proj.bias"])
+        blk["ln_2"]["scale"] = np.asarray(state[p + "ln_2.weight"])
+        blk["ln_2"]["bias"] = np.asarray(state[p + "ln_2.bias"])
+        blk["mlp_fc"]["kernel"] = np.asarray(state[p + "mlp.c_fc.weight"])
+        blk["mlp_fc"]["bias"] = np.asarray(state[p + "mlp.c_fc.bias"])
+        blk["mlp_proj"]["kernel"] = np.asarray(state[p + "mlp.c_proj.weight"])
+        blk["mlp_proj"]["bias"] = np.asarray(state[p + "mlp.c_proj.bias"])
+    out["ln_f"]["scale"] = np.asarray(state["transformer.ln_f.weight"])
+    out["ln_f"]["bias"] = np.asarray(state["transformer.ln_f.bias"])
+    return jax.tree_util.tree_map(jnp.asarray, out)

@@ -1,0 +1,231 @@
+"""GPT-2 PersonaChat federated training entrypoint.
+
+Loop parity with reference gpt2_train.py:115-365: special-token surgery with
+embedding resize, per-batch TableLogger rows, download tracking in epoch 1
+only, final ``save_pretrained`` + validation pass reporting NLL / MC accuracy
+/ perplexity. The model is the flax ``GPT2DoubleHeads``
+(commefficient_tpu/models/gpt2.py); pretrained HF weights load when present
+locally, else training starts from scratch (zero-egress environment).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.config import parse_args
+from commefficient_tpu.data_utils import FedLoader
+from commefficient_tpu.data_utils.fed_persona import (
+    FedPERSONA,
+    make_personachat_collate_fn,
+)
+from commefficient_tpu.data_utils.tokenization import (
+    ATTR_TO_SPECIAL_TOKEN,
+    get_tokenizer,
+)
+from commefficient_tpu.federated import FedModel, FedOptimizer, LambdaLR
+from commefficient_tpu.federated.losses import make_gpt2_losses
+from commefficient_tpu.models.gpt2 import (
+    GPT2DoubleHeads,
+    load_hf_gpt2,
+    resize_token_embeddings,
+)
+from commefficient_tpu.utils import (
+    PiecewiseLinear,
+    TableLogger,
+    Timer,
+    make_logdir,
+)
+from cv_train import union
+
+MAX_SEQ_LEN = int(os.environ.get("COMMEFFICIENT_GPT2_SEQ_LEN", 256))
+
+
+def get_data_loaders(args, tokenizer):
+    train_dataset = FedPERSONA(
+        tokenizer, args.num_candidates, args.max_history,
+        args.personality_permutations,
+        args.dataset_dir, args.dataset_name, None, args.do_iid,
+        args.num_clients, train=True, download=True,
+        max_seq_len=MAX_SEQ_LEN)
+    val_dataset = FedPERSONA(
+        tokenizer, -1, args.max_history, 1,
+        args.dataset_dir, args.dataset_name, None, train=False,
+        download=False, max_seq_len=MAX_SEQ_LEN)
+    # val candidates vary; collate pads to the train candidate count for
+    # static shapes
+    n_cand_val = max(args.num_candidates, 3)
+    train_loader = FedLoader(
+        train_dataset, args.num_workers, args.local_batch_size,
+        collate_fn=_wrap(make_personachat_collate_fn(MAX_SEQ_LEN,
+                                                     args.num_candidates)))
+    val_loader = FedLoader(
+        val_dataset,
+        val_batch_size=args.valid_batch_size * args.num_workers,
+        collate_fn=_wrap(make_personachat_collate_fn(MAX_SEQ_LEN,
+                                                     n_cand_val)))
+    return train_loader, val_loader
+
+
+def _wrap(collate):
+    # FedLoader hands items as tuples of the post-client-id columns
+    return lambda items: collate(items)
+
+
+def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
+                epoch=None, epoch_fraction=1, logger=None, writer=None):
+    model.train(training)
+    if training:
+        spe = loader.steps_per_epoch()
+        num_clients = loader.dataset.num_clients
+        client_download = np.zeros(num_clients)
+        client_upload = np.zeros(num_clients)
+        losses = []
+        for batch_idx, batch in enumerate(loader):
+            if batch_idx > 2 and args.do_test and batch_idx < spe - 10:
+                continue
+            if batch_idx > spe * epoch_fraction:
+                break
+            lr_scheduler.step()
+            loss, download, upload = model(batch)
+            client_download += download
+            client_upload += upload
+            opt.step()
+            loss = float(np.mean(loss))
+            losses.append(loss)
+            train_time = timer()
+            batch_stats = {
+                "train_time": train_time,
+                "train_loss": loss,
+                "total_time": timer.total_time,
+                "down (MiB)": round(download.sum() / (1024 * 1024)),
+                "up (MiB)": round(upload.sum() / (1024 * 1024)),
+            }
+            lr = lr_scheduler.get_last_lr()[0]
+            if logger is not None:
+                logger.append(union({"batch_idx": batch_idx + 1, "lr": lr},
+                                    batch_stats))
+        return np.mean(losses), client_download, client_upload
+
+    nlls, accs = [], []
+    spe = len(loader)
+    for batch_idx, batch in enumerate(loader):
+        if batch_idx > 5 and args.do_test and batch_idx < spe - 5:
+            continue
+        nll, acc = model(batch)
+        nlls.append(float(np.mean(nll)))
+        accs.append(float(np.mean(acc)))
+    return np.mean(nlls), np.mean(accs), np.exp(np.mean(nlls))
+
+
+def test_gpt2(model, val_loader, args, logger=None, timer=None, writer=None):
+    timer = timer or Timer()
+    nll, acc, ppl = run_batches(model, None, None, val_loader, args, timer,
+                                training=False, logger=TableLogger())
+    stats = {"val_nll": nll, "val_acc": acc, "val_ppl": ppl,
+             "val_time": timer(), "total_time": timer.total_time}
+    (logger or TableLogger()).append(stats)
+    return stats
+
+
+def train_gpt2(model, opt, scheduler, train_loader, val_loader, args,
+               log_dir, writer=None, logger=None, timer=None):
+    timer = timer or Timer()
+    total_download = 0.0
+    total_upload = 0.0
+    for epoch in range(math.ceil(args.num_epochs)):
+        if epoch == math.ceil(args.num_epochs) - 1:
+            epoch_fraction = args.num_epochs - epoch
+        else:
+            epoch_fraction = 1
+        _, download, upload = run_batches(
+            model, opt, scheduler, train_loader, args, timer, training=True,
+            epoch=epoch, epoch_fraction=epoch_fraction, logger=logger,
+            writer=writer)
+        if epoch == 0:
+            # download tracking valid in epoch 1 only (reference
+            # gpt2_train.py:132-145)
+            total_download += download.sum() / (1024 * 1024)
+            total_upload += upload.sum() / (1024 * 1024)
+    print(f"Total Download (MiB): {total_download:0.2f} (only epoch 1)")
+    print(f"Total Upload (MiB): {total_upload:0.2f} (only epoch 1)")
+    n = train_loader.dataset.num_clients
+    print(f"Avg Download Per Client: {total_download / n:0.2f} (only epoch 1)")
+    print(f"Avg Upload Per Client: {total_upload / n:0.2f} (only epoch 1)")
+    model.save_pretrained(log_dir)
+    return test_gpt2(model, val_loader, args, timer=timer, writer=writer)
+
+
+def train(argv=None):
+    args = parse_args(default_lr=4e-2, argv=argv)
+    if not args.dataset_name:
+        args.dataset_name = "PERSONA"
+    print(args)
+    timer = Timer()
+
+    tokenizer = get_tokenizer(args.model_checkpoint)
+    tokenizer.add_special_tokens(ATTR_TO_SPECIAL_TOKEN)
+    args.len_tokenizer = len(tokenizer)
+
+    # model geometry: tiny when smoke-testing or using the byte fallback
+    if args.do_test or os.environ.get("COMMEFFICIENT_TINY_MODEL"):
+        model = GPT2DoubleHeads(vocab_size=max(512, args.len_tokenizer),
+                                n_positions=MAX_SEQ_LEN, n_embd=64,
+                                n_layer=2, n_head=2)
+    else:
+        model = GPT2DoubleHeads(vocab_size=max(50257 + 5,
+                                               args.len_tokenizer),
+                                n_positions=1024)
+
+    compute_loss_train, compute_loss_val = make_gpt2_losses(
+        model, args.lm_coef, args.mc_coef)
+
+    log_dir = make_logdir(args)
+    os.makedirs(log_dir, exist_ok=True)
+    tokenizer.save_pretrained(log_dir)
+
+    train_loader, val_loader = get_data_loaders(args, tokenizer)
+
+    # try local pretrained weights (reference loads from the hub,
+    # gpt2_train.py:262-273)
+    x0 = {
+        "input_ids": jnp.zeros((1, args.num_candidates, MAX_SEQ_LEN),
+                               jnp.int32),
+    }
+    variables = model.init(jax.random.key(args.seed), x0["input_ids"],
+                           token_type_ids=x0["input_ids"],
+                           mc_token_ids=jnp.zeros((1, args.num_candidates),
+                                                  jnp.int32), train=False)
+    init_params = variables["params"]
+    pretrained = load_hf_gpt2(init_params, args.model_checkpoint)
+    if pretrained is not None:
+        init_params = resize_token_embeddings(pretrained, args.len_tokenizer)
+        print("loaded local pretrained GPT-2 weights")
+
+    args.num_results_train = 1
+    args.num_results_val = 2
+    fed_model = FedModel(model, compute_loss_train, args, compute_loss_val,
+                         num_clients=train_loader.dataset.num_clients,
+                         init_params=init_params)
+    opt = FedOptimizer(fed_model, args)
+    spe = train_loader.steps_per_epoch()
+    print("Steps per epoch", spe)
+    lr_schedule = PiecewiseLinear([0, args.num_epochs * spe],
+                                  [args.lr_scale, 0.0])
+    scheduler = LambdaLR(opt, lr_lambda=lambda s: lr_schedule(s))
+
+    if args.do_finetune:
+        return test_gpt2(fed_model, val_loader, args, logger=TableLogger(),
+                         timer=timer)
+    stats = train_gpt2(fed_model, opt, scheduler, train_loader, val_loader,
+                       args, log_dir, logger=TableLogger(), timer=timer)
+    fed_model.finalize()
+    return stats
+
+
+if __name__ == "__main__":
+    train()

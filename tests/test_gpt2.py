@@ -1,0 +1,143 @@
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
+os.environ.setdefault("COMMEFFICIENT_TINY_MODEL", "1")
+os.environ.setdefault("COMMEFFICIENT_GPT2_SEQ_LEN", "64")
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.data_utils.fed_persona import (
+    FedPERSONA,
+    build_input_from_segments,
+    make_personachat_collate_fn,
+)
+from commefficient_tpu.data_utils.tokenization import (
+    ATTR_TO_SPECIAL_TOKEN,
+    ByteTokenizer,
+)
+from commefficient_tpu.models.gpt2 import (
+    GPT2DoubleHeads,
+    resize_token_embeddings,
+)
+
+
+@pytest.fixture(scope="module")
+def tokenizer():
+    tok = ByteTokenizer()
+    tok.add_special_tokens(ATTR_TO_SPECIAL_TOKEN)
+    return tok
+
+
+class TestModel:
+    def test_shapes(self):
+        m = GPT2DoubleHeads(vocab_size=300, n_positions=32, n_embd=32,
+                            n_layer=2, n_head=2)
+        ids = jnp.zeros((2, 3, 16), jnp.int32)
+        mc = jnp.zeros((2, 3), jnp.int32)
+        v = m.init(jax.random.key(0), ids, token_type_ids=ids,
+                   mc_token_ids=mc, train=False)
+        lm, mcl = m.apply(v, ids, token_type_ids=ids, mc_token_ids=mc,
+                          train=False)
+        assert lm.shape == (2, 3, 16, 300)
+        assert mcl.shape == (2, 3)
+
+    def test_causality(self):
+        """Changing a later token must not affect earlier LM logits."""
+        m = GPT2DoubleHeads(vocab_size=64, n_positions=16, n_embd=16,
+                            n_layer=1, n_head=2, dropout=0.0)
+        ids1 = jnp.asarray(np.random.randint(0, 64, (1, 1, 8)))
+        ids2 = ids1.at[0, 0, 7].set((ids1[0, 0, 7] + 1) % 64)
+        v = m.init(jax.random.key(0), ids1, train=False)
+        lm1, _ = m.apply(v, ids1, train=False)
+        lm2, _ = m.apply(v, ids2, train=False)
+        np.testing.assert_allclose(lm1[0, 0, :7], lm2[0, 0, :7], atol=1e-5)
+
+    def test_resize_embeddings(self):
+        m = GPT2DoubleHeads(vocab_size=64, n_positions=16, n_embd=16,
+                            n_layer=1, n_head=2)
+        ids = jnp.zeros((1, 1, 8), jnp.int32)
+        v = m.init(jax.random.key(0), ids, train=False)
+        params2 = resize_token_embeddings(v["params"], 70)
+        assert params2["wte"]["embedding"].shape == (70, 16)
+        np.testing.assert_array_equal(
+            params2["wte"]["embedding"][:64], v["params"]["wte"]["embedding"])
+
+
+class TestBuildInput:
+    def test_structure(self, tokenizer):
+        persona = [[65, 66], [67]]
+        history = [[10, 11], [12]]
+        reply = [20, 21]
+        inst = build_input_from_segments(persona, history, reply, tokenizer,
+                                         lm_labels=True)
+        bos, eos, s1, s2 = tokenizer.convert_tokens_to_ids(
+            ["<bos>", "<eos>", "<speaker1>", "<speaker2>"])
+        assert inst["input_ids"][0] == bos
+        assert inst["input_ids"][-1] == eos
+        assert inst["mc_token_ids"] == len(inst["input_ids"]) - 1
+        assert len(inst["lm_labels"]) == len(inst["input_ids"])
+        # labels only on the reply (after its speaker tag)
+        n_label = sum(1 for l in inst["lm_labels"] if l != -1)
+        assert n_label == len(reply) + 1  # reply tokens (minus first) + eos +1
+
+    def test_no_lm_labels_for_wrong_candidates(self, tokenizer):
+        inst = build_input_from_segments([[65]], [[10]], [20], tokenizer,
+                                         lm_labels=False)
+        assert all(l == -1 for l in inst["lm_labels"])
+
+
+class TestFedPERSONA:
+    def test_synthetic_partition(self, tmp_path, tokenizer):
+        ds = FedPERSONA(tokenizer, 2, 2, 1, str(tmp_path), "PERSONA",
+                        train=True, max_seq_len=64)
+        assert ds.num_clients == 8
+        cid, *model_input = ds[0]
+        assert 0 <= cid < 8
+        input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = \
+            model_input
+        assert len(input_ids) == 2  # num_candidates
+        assert mc_labels == 1  # last candidate correct
+
+    def test_val_sentinel(self, tmp_path, tokenizer):
+        FedPERSONA(tokenizer, 2, 2, 1, str(tmp_path), "PERSONA", train=True,
+                   max_seq_len=64)
+        val = FedPERSONA(tokenizer, -1, 2, 1, str(tmp_path), "PERSONA",
+                         train=False, max_seq_len=64)
+        cid, *_ = val[0]
+        assert cid == -1
+
+    def test_collate_static_shapes(self, tmp_path, tokenizer):
+        ds = FedPERSONA(tokenizer, 2, 2, 1, str(tmp_path), "PERSONA",
+                        train=True, max_seq_len=64)
+        collate = make_personachat_collate_fn(64, 2)
+        items = [tuple(ds[i][1:]) for i in range(3)]
+        cols = collate(items)
+        assert cols["input_ids"].shape == (3, 2, 64)
+        assert cols["lm_labels"].shape == (3, 2, 64)
+        assert cols["mc_token_ids"].shape == (3, 2)
+        assert cols["mc_labels"].shape == (3,)
+
+
+class TestEndToEnd:
+    def test_gpt2_train_smoke(self, tmp_path):
+        import gpt2_train
+
+        stats = gpt2_train.train(argv=[
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "1",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "uncompressed",
+            "--local_momentum", "0",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+        ])
+        assert np.isfinite(stats["val_nll"])
+        assert np.isfinite(stats["val_ppl"])
