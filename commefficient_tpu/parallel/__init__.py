@@ -1,0 +1,35 @@
+"""Parallelism toolkit: mesh construction, sequence/context parallelism.
+
+- ``mesh``    — named-mesh builders and sharding helpers (clients/seq axes,
+  multihost hybrid DCN×ICI meshes);
+- ``ring``    — ring attention (ppermute KV rotation, exact, O(T/n) memory);
+- ``ulysses`` — all-to-all head-scatter sequence parallelism.
+
+The federated client axis itself is driven by federated/rounds.py; this
+package holds the reusable mesh plumbing plus the long-context machinery.
+"""
+
+from commefficient_tpu.parallel.mesh import (
+    CLIENTS_AXIS,
+    SEQ_AXIS,
+    client_sharding,
+    make_mesh,
+    replicated_sharding,
+)
+from commefficient_tpu.parallel.ring import make_ring_attention, ring_attention
+from commefficient_tpu.parallel.ulysses import (
+    make_ulysses_attention,
+    ulysses_attention,
+)
+
+__all__ = [
+    "CLIENTS_AXIS",
+    "SEQ_AXIS",
+    "client_sharding",
+    "make_mesh",
+    "replicated_sharding",
+    "make_ring_attention",
+    "ring_attention",
+    "make_ulysses_attention",
+    "ulysses_attention",
+]

@@ -1,0 +1,109 @@
+"""Device-mesh construction and sharding helpers.
+
+This is the TPU-native replacement for the reference's distributed substrate
+configuration (reference fed_aggregator.py:131-164: device counting, PS/worker
+GPU assignment, NCCL process-group init on 127.0.0.1). Where the reference
+wires processes together by rank over localhost NCCL, we build a
+``jax.sharding.Mesh`` over the available TPU devices and let XLA place
+collectives on ICI (intra-slice) and DCN (cross-host) automatically.
+
+Axes used by the framework:
+
+- ``clients`` — the federated data-parallel axis: the round's sampled clients
+  are sharded across it (federated/rounds.py). This is the analogue of the
+  reference's worker processes.
+- ``seq`` — optional sequence/context-parallel axis for long-context models
+  (parallel/ring.py ring attention, parallel/ulysses.py all-to-all head
+  scatter). The reference has no equivalent (its only sequence-scaling lever
+  is microbatching, SURVEY.md §5); this axis is the TPU-first extension point.
+
+Multi-host: with more than one JAX process, ``make_mesh`` builds a hybrid
+mesh via ``mesh_utils.create_hybrid_device_mesh`` so that the *last* mesh
+axes ride ICI within a slice and the leading axis spans DCN across hosts —
+keeping the hot psum/ppermute traffic on ICI.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "make_mesh",
+    "client_sharding",
+    "replicated_sharding",
+    "CLIENTS_AXIS",
+    "SEQ_AXIS",
+]
+
+CLIENTS_AXIS = "clients"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,
+              devices=None) -> Mesh:
+    """Build a named mesh.
+
+    ``axis_sizes`` is a sequence of ``(name, size)``; a size of -1 means
+    "all remaining devices" (at most one axis may be -1). Default: one
+    ``clients`` axis over every device. When the axis product is smaller
+    than the device count, a submesh over the first ``prod(sizes)`` devices
+    is built and a warning notes the idle devices.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = [(CLIENTS_AXIS, n)]
+
+    names = [a for a, _ in axis_sizes]
+    sizes = [s for _, s in axis_sizes]
+    n_wild = sum(1 for s in sizes if s == -1)
+    if n_wild > 1:
+        raise ValueError("at most one axis size may be -1")
+    fixed = int(np.prod([s for s in sizes if s != -1])) if sizes else 1
+    if n_wild:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by {fixed}")
+        sizes = [n // fixed if s == -1 else s for s in sizes]
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs "
+                         f"{total} devices, have {n}")
+    if total < n:
+        warnings.warn(f"mesh {dict(zip(names, sizes))} uses {total} of {n} "
+                      f"devices; {n - total} devices idle", stacklevel=2)
+    devices = devices[:total]
+
+    n_proc = jax.process_count()
+    if n_proc > 1 and total == len(jax.devices()):
+        # hybrid DCN×ICI mesh: leading axis split across hosts so the hot
+        # psum/ppermute traffic stays on ICI
+        if sizes[0] % n_proc:
+            raise ValueError(
+                f"multihost mesh: leading axis {names[0]}={sizes[0]} must be "
+                f"divisible by process_count={n_proc}")
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(sizes[0] // n_proc, *sizes[1:]),
+            dcn_mesh_shape=(n_proc,) + (1,) * (len(sizes) - 1),
+        )
+        return Mesh(dev_array, tuple(names))
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def client_sharding(mesh: Mesh, axis: str = CLIENTS_AXIS) -> NamedSharding:
+    """Sharding for per-client state arrays ``(num_clients, ...)`` — row-
+    sharded over the clients axis (the reference kept these in host shared
+    memory, fed_aggregator.py:116-129; we keep them in HBM, sharded)."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (ps_weights, server state)."""
+    return NamedSharding(mesh, P())

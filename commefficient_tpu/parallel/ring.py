@@ -1,0 +1,111 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Long-context support is a first-class capability of this framework. The
+reference's only sequence-scaling lever is microbatched gradient accumulation
+(reference fed_worker.py:256-270; SURVEY.md §5 "long-context: absent"); on
+TPU the idiomatic scaling mechanism is to shard the *sequence* axis across
+devices and rotate key/value blocks around the ring with ``lax.ppermute`` so
+each device only ever holds ``T/n`` of the sequence — memory per device is
+O(T/n) while attention stays exact (blockwise online-softmax accumulation,
+flash-attention style).
+
+Collective pattern: n-1 ``ppermute`` steps of the local KV block around the
+mesh axis, overlapping each hop with the local QK^T/PV block compute. On TPU
+hardware the permute rides ICI neighbor links, which is exactly the topology
+ring attention wants.
+
+Everything here is differentiable (``ppermute`` has a transpose rule) and
+jit/shard_map-safe: static shapes, ``lax.scan`` over ring steps.
+
+``ring_attention`` is the inside-shard_map primitive; ``make_ring_attention``
+wraps it in a ``shard_map`` over a mesh for direct use on sequence-sharded
+(B, T, H, D) arrays.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+__all__ = ["ring_attention", "make_ring_attention"]
+
+_NEG = -0.7 * jnp.finfo(jnp.float32).max  # large-negative mask value, nan-free
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   scale: float | None = None):
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Must be called inside ``shard_map``. ``q, k, v``: (B, T_local, H, D)
+    with the global sequence of length ``T_local * axis_size`` laid out in
+    axis order (device i holds positions [i*T_local, (i+1)*T_local)).
+
+    Returns (B, T_local, H, D) — the local slice of the attention output.
+    """
+    B, Tq, H, D = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    scale = (D ** -0.5) if scale is None else scale
+
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = my_idx * Tq + jnp.arange(Tq)  # global query positions
+
+    def accumulate(acc, kb, vb, ring_step):
+        o, l, m = acc
+        # device holding block j at ring_step t originally owned block
+        # (my_idx - t) mod n — the KV blocks arrive in decreasing order
+        kv_idx = (my_idx - ring_step) % n
+        k_pos = kv_idx * kb.shape[1] + jnp.arange(kb.shape[1])
+
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, kb.astype(jnp.float32))
+        if causal:
+            allowed = k_pos[None, :] <= q_pos[:, None]  # (Tq, Tk)
+            s = jnp.where(allowed[None, None], s, _NEG)
+
+        m_new = jnp.maximum(m, s.max(axis=-1))          # (B, H, Tq)
+        p = jnp.exp(s - m_new[..., None])               # masked → exp(−huge)=0
+        corr = jnp.exp(m - m_new)                       # first step: exp(−huge)=0
+        l = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vb.astype(jnp.float32))
+        o = o * corr.transpose(0, 2, 1)[..., None] + pv
+        return o, l, m_new
+
+    def step(carry, ring_step):
+        acc, kb, vb = carry
+        acc = accumulate(acc, kb, vb, ring_step)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (acc, kb, vb), None
+
+    o0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    m0 = jnp.full((B, H, Tq), _NEG, jnp.float32)
+    # n−1 (compute, permute) hops in the scan, then the last arriving block is
+    # consumed without a wasted final ppermute (collectives in a scan carry
+    # can't be DCE'd by XLA)
+    (acc, kb, vb), _ = jax.lax.scan(
+        step, ((o0, l0, m0), k, v), jnp.arange(n - 1))
+    o, l, _ = accumulate(acc, kb, vb, n - 1)
+
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (non-causal edge) stay 0
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis: str = "seq", causal: bool = True):
+    """shard_map wrapper: takes globally-shaped (B, T, H, D) arrays sharded
+    (or shardable) on ``axis`` along T, returns the attention output with the
+    same sharding."""
+    spec = P(None, axis, None, None)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def attn(q, k, v):
+        return ring_attention(q, k, v, axis_name=axis, causal=causal)
+
+    return attn

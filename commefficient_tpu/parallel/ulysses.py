@@ -1,0 +1,71 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter.
+
+The complementary long-context strategy to ring attention (parallel/ring.py):
+instead of rotating KV blocks, one ``all_to_all`` re-shards the activations
+from sequence-sharded (B, T/n, H, D) to head-sharded (B, T, H/n, D), runs
+*full-sequence* attention on each device's head group, and a second
+``all_to_all`` restores sequence sharding. Two collectives total per
+attention call — cheaper than the ring's n−1 hops when the per-device head
+count is ≥ 1 and T fits in HBM; the ring wins when T/n is the binding
+constraint. Both are exact.
+
+No reference equivalent (SURVEY.md §5: sequence parallelism absent there);
+this is the TPU-first capability extension. Requires H % axis_size == 0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+__all__ = ["ulysses_attention", "make_ulysses_attention"]
+
+
+def _dense_attention(q, k, v, causal: bool, scale):
+    B, T, H, D = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      scale: float | None = None):
+    """Inside-shard_map primitive. ``q, k, v``: (B, T_local, H, D), sequence
+    sharded on ``axis_name``; H must be divisible by the axis size."""
+    B, Tl, H, D = q.shape
+    n = jax.lax.psum(1, axis_name)
+    scale = (D ** -0.5) if scale is None else scale
+
+    def seq2head(x):
+        # (B, T/n, H, D) → (B, T, H/n, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def head2seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    out = _dense_attention(qg, kg, vg, causal, scale)
+    return head2seq(out)
+
+
+def make_ulysses_attention(mesh: Mesh, axis: str = "seq",
+                           causal: bool = True):
+    spec = P(None, axis, None, None)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def attn(q, k, v):
+        return ulysses_attention(q, k, v, axis_name=axis, causal=causal)
+
+    return attn

@@ -7,7 +7,6 @@ import sys
 import numpy as np
 import pytest
 
-os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "24")
 os.environ.setdefault("COMMEFFICIENT_TINY_MODEL", "1")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -15,7 +14,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import cv_train  # noqa: E402
 
 
-def _run(tmp_path, extra):
+def _run(tmp_path, monkeypatch, extra):
+    # set at call time, not import time — see comment in test_data.py
+    monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "24")
     argv = [
         "--dataset_name", "CIFAR10",
         "--dataset_dir", str(tmp_path / "data"),
@@ -33,28 +34,28 @@ def _run(tmp_path, extra):
 
 
 class TestEndToEnd:
-    def test_uncompressed_round_runs_and_learns_something(self, tmp_path):
-        summary = _run(tmp_path, ["--mode", "uncompressed",
+    def test_uncompressed_round_runs_and_learns_something(self, tmp_path, monkeypatch):
+        summary = _run(tmp_path, monkeypatch, ["--mode", "uncompressed",
                                   "--local_momentum", "0"])
         assert np.isfinite(summary["train_loss"])
         assert np.isfinite(summary["test_acc"])
 
-    def test_sketch_mode_e2e(self, tmp_path):
-        summary = _run(tmp_path, [
+    def test_sketch_mode_e2e(self, tmp_path, monkeypatch):
+        summary = _run(tmp_path, monkeypatch, [
             "--mode", "sketch", "--error_type", "virtual",
             "--local_momentum", "0",
             "--k", "500", "--num_cols", "2048", "--num_rows", "3",
             "--num_blocks", "2"])
         assert np.isfinite(summary["train_loss"])
 
-    def test_true_topk_e2e(self, tmp_path):
-        summary = _run(tmp_path, ["--mode", "true_topk", "--error_type",
+    def test_true_topk_e2e(self, tmp_path, monkeypatch):
+        summary = _run(tmp_path, monkeypatch, ["--mode", "true_topk", "--error_type",
                                   "virtual", "--local_momentum", "0",
                                   "--k", "500"])
         assert np.isfinite(summary["train_loss"])
 
-    def test_fedavg_e2e(self, tmp_path):
-        summary = _run(tmp_path, ["--mode", "fedavg", "--local_batch_size",
+    def test_fedavg_e2e(self, tmp_path, monkeypatch):
+        summary = _run(tmp_path, monkeypatch, ["--mode", "fedavg", "--local_batch_size",
                                   "-1", "--local_momentum", "0",
                                   "--error_type", "none",
                                   "--num_fedavg_epochs", "1"])

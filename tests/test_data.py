@@ -3,9 +3,6 @@ import os
 import numpy as np
 import pytest
 
-os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "40")
-os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_CLIENTS", "12")
-
 from commefficient_tpu.data_utils import (
     FedCIFAR10,
     FedEMNIST,
@@ -18,7 +15,13 @@ from commefficient_tpu.data_utils import (
 
 @pytest.fixture(scope="module")
 def cifar_dir(tmp_path_factory):
-    return str(tmp_path_factory.mktemp("cifar"))
+    # env is read at prepare_datasets time (first construction in this dir);
+    # set it here rather than at import time — pytest imports every test
+    # module before running, so import-time settings race across modules
+    mp = pytest.MonkeyPatch()
+    mp.setenv("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "40")
+    yield str(tmp_path_factory.mktemp("cifar"))
+    mp.undo()
 
 
 @pytest.fixture(scope="module")
@@ -106,7 +109,8 @@ class TestFedLoader:
 
 
 class TestFedEMNIST:
-    def test_synthetic_clients(self, tmp_path):
+    def test_synthetic_clients(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "12")
         ds = FedEMNIST(str(tmp_path), "EMNIST", train=True)
         assert ds.num_clients == 12
         cid, img, t = ds[0]

@@ -3,7 +3,6 @@ import os
 import numpy as np
 import pytest
 
-os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
 os.environ.setdefault("COMMEFFICIENT_TINY_MODEL", "1")
 os.environ.setdefault("COMMEFFICIENT_GPT2_SEQ_LEN", "64")
 
@@ -23,6 +22,16 @@ from commefficient_tpu.models.gpt2 import (
     GPT2DoubleHeads,
     resize_token_embeddings,
 )
+
+
+@pytest.fixture(scope="module", autouse=True)
+def synthetic_clients():
+    # set at use time, not import time (see test_data.py); module-scoped so
+    # every FedPERSONA construction in this file gets the small client count
+    mp = pytest.MonkeyPatch()
+    mp.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
+    yield
+    mp.undo()
 
 
 @pytest.fixture(scope="module")
