@@ -237,7 +237,11 @@ class FedModel:
             "uncompressed": self.grad_size,
             "true_topk": self.grad_size,
             "local_topk": args.k,
-            "sketch": args.num_rows * args.num_cols,
+            # the lane-aligned table actually transmitted (c padded to a
+            # multiple of 128) — honest accounting of the real communication
+            "sketch": (int(np.prod(self.sketch.table_shape))
+                       if self.sketch is not None
+                       else args.num_rows * args.num_cols),
             "fedavg": self.grad_size,
         }[args.mode] * 4
         upload[participating] = upload_per

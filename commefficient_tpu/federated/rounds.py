@@ -143,7 +143,7 @@ def build_round_step(
         if cfg.do_test:
             # smoke mode: skip fwd/bwd, all-ones transmit
             # (reference fed_worker.py:117-122)
-            shape = (sketch.r, sketch.c) if wcfg.mode == "sketch" else \
+            shape = sketch.table_shape if wcfg.mode == "sketch" else \
                 (cfg.grad_size,)
             transmit = jnp.ones(shape, jnp.float32)
             metrics = (jnp.ones(()), jnp.ones(()), batch_row["mask"].sum())

@@ -1,4 +1,4 @@
-"""Count-sketch compression (the CSVec replacement), pure JAX.
+"""Count-sketch compression (the CSVec replacement), TPU-first.
 
 Re-implements the capability surface of the external ``csvec`` package the
 reference depends on (used at reference fed_aggregator.py:5,464-467,584-611 and
@@ -11,24 +11,46 @@ fed_worker.py:10,313-320):
 - recover the top-k heavy hitters via median-of-rows estimation
   (``CSVec.unSketch(k)``    → ``unsketch``)
 - L2-norm estimate of the sketched vector (``CSVec.l2estimate``)
-- block decomposition bounding peak memory (``numBlocks`` → ``num_blocks``)
 
-Design deviation (deliberate, documented): CSVec draws bucket/sign hashes from
-polynomial hash families mod the Mersenne prime 2**61-1 in int64 — int64
-multiplies that are emulated and slow on TPU. We instead derive both hashes
-from the murmur3 32-bit finalizer (xor-shift/multiply avalanche) keyed per row
-and per seed: pure uint32 VPU arithmetic, empirically indistinguishable
-collision behavior for sketching, and identical API semantics. Hash identity
-is fully determined by ``(seed, r, c, d)``, so two sketches built with the
-same geometry are mergeable, which is what FetchSGD's linearity argument
-requires.
+Hash-family design (deliberate, documented deviation). CSVec draws bucket
+hashes from polynomial families mod 2**61-1 — int64 math that is emulated on
+TPU — and accumulates with a scatter, which XLA serializes. Both are wrong for
+the hardware. We instead use a **chunked-cyclic family**: the coordinate space
+is split into ``T = ceil(d / c_pad)`` contiguous chunks of the (lane-aligned)
+table width; chunk ``t`` maps into row ``j`` by a full cyclic shift,
 
-All compute paths are chunked over the coordinate axis (``num_blocks`` chunks)
-so the transient hash tensors stay bounded for GPT-2-scale d≈1.2e8, and are
-jit/vmap/shard_map-safe (static shapes, no data-dependent control flow).
+    bucket_j(i) = (pos(i) + m[j, t]) mod c_pad ,       pos(i) = i mod c_pad
+
+with ``m[j, t]`` drawn uniformly from ``[0, c_pad)`` by a seeded host-side
+RNG. Sign hashes are per-(row, coordinate) murmur3-finalizer bits. Properties:
+
+- *linear & mergeable*: geometry is fully determined by ``(seed, r, c, d)``;
+- *within-chunk collision-free*: a cyclic shift is a permutation, so two
+  coordinates in the same chunk never collide — strictly better than
+  2-universal hashing for those pairs;
+- *cross-chunk*: two coordinates in different chunks collide in a row iff the
+  two chunks' shifts differ by exactly their position offset — probability
+  ``1/c_pad`` per row, independent across rows: identical to ideal
+  count-sketch collision behavior;
+- *scatter-free*: a cyclic roll by ``m = 128·q + w`` decomposes into a lane
+  rotation by ``w`` — applied as a ``(S,128) @ (128,128)`` permutation-matrix
+  matmul that runs on the MXU, with the sublane carry handled by a select of
+  two sublane-shifted operands — followed by a sublane roll by ``q``
+  (sublane-granular ``dynamic_slice``). No scatter, no gather, no int64.
+
+The accumulate path also ships as a fused Pallas kernel (``_sketch_vec_pallas``)
+that keeps each table row resident in VMEM across all T chunks (grid
+``(r, T)`` with output revisiting), computing sign hashes and the permutation
+matrix on the fly from ``broadcasted_iota`` — only the gradient is read from
+HBM. ``sketch_vec`` dispatches to it on TPU.
+
+All paths are jit/vmap/shard_map-safe: static shapes, no data-dependent
+control flow, chunk loop is a ``lax.scan``.
 """
 
 from __future__ import annotations
+
+import functools
 
 import numpy as np
 
@@ -36,106 +58,247 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-_M1 = np.uint32(0x85EBCA6B)
-_M2 = np.uint32(0xC2B2AE35)
+_LANES = 128
+_M1 = np.int32(np.uint32(0x85EBCA6B).astype(np.int64) - (1 << 32))
+_M2 = np.int32(np.uint32(0xC2B2AE35).astype(np.int64) - (1 << 32))
 
 
 def _mix32(x: jax.Array) -> jax.Array:
-    """murmur3 fmix32 avalanche over uint32."""
-    x = x ^ (x >> 16)
+    """murmur3 fmix32 avalanche over int32 bit patterns (wrapping mul +
+    logical shifts — identical bits to the uint32 formulation, but lowers to
+    plain VPU int32 ops inside Pallas kernels)."""
+    srl = jax.lax.shift_right_logical
+    x = x ^ srl(x, 16)
     x = x * _M1
-    x = x ^ (x >> 13)
+    x = x ^ srl(x, 13)
     x = x * _M2
-    x = x ^ (x >> 16)
+    x = x ^ srl(x, 16)
     return x
+
+
+def _signs_for(idx: jax.Array, key: jax.Array) -> jax.Array:
+    """±1 float32 sign hash for int32 coordinate indices."""
+    h = _mix32(idx ^ key)
+    return (h & 1).astype(jnp.float32) * 2.0 - 1.0
+
+
+def _lane_rotate(x2d: jax.Array, w: jax.Array) -> jax.Array:
+    """Rotate the flattened ``(S, 128)`` array right by ``w ∈ [0, 128)`` flat
+    positions: lane rotation with sublane carry.
+
+    ``y[a, j] = x[a, j-w]`` for ``j >= w`` and ``x[(a-1) mod S, j-w+128]``
+    otherwise. The lane permutation is a 128×128 0/1 matrix built from iota
+    and applied on the MXU; exact in float32 (rows of the product select
+    single elements).
+    """
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 1)
+    rot = ((lane + w) % _LANES == col).astype(jnp.float32)
+    x0 = jnp.dot(x2d, rot, preferred_element_type=jnp.float32)
+    x1 = jnp.dot(jnp.concatenate([x2d[-1:], x2d[:-1]], axis=0), rot,
+                 preferred_element_type=jnp.float32)
+    j = jax.lax.broadcasted_iota(jnp.int32, x2d.shape, 1)
+    return jnp.where(j >= w, x0, x1)
+
+
+def _roll2d(x2d: jax.Array, q: jax.Array, w: jax.Array) -> jax.Array:
+    """Cyclic roll of the flattened ``(S, 128)`` array by ``128·q + w``."""
+    z = _lane_rotate(x2d, w)
+    return jnp.roll(z, q, axis=0)
 
 
 @struct.dataclass
 class CountSketch:
     """Hash geometry for a count-sketch. A pytree; static ints are aux data."""
 
-    row_keys: jax.Array  # (r,) uint32 — per-row hash keys derived from seed
-    sign_keys: jax.Array  # (r,) uint32
+    shift_q: jax.Array   # (r, T) int32 — sublane part of the forward shift
+    shift_w: jax.Array   # (r, T) int32 — lane part of the forward shift
+    inv_q: jax.Array     # (r, T) int32 — sublane part of the inverse shift
+    inv_w: jax.Array     # (r, T) int32 — lane part of the inverse shift
+    sign_keys: jax.Array  # (r,) int32 — per-row sign-hash keys
     d: int = struct.field(pytree_node=False)
-    c: int = struct.field(pytree_node=False)
+    c: int = struct.field(pytree_node=False)       # user-requested columns
+    c_pad: int = struct.field(pytree_node=False)   # lane-aligned columns
     r: int = struct.field(pytree_node=False)
+    T: int = struct.field(pytree_node=False)       # number of chunks
     num_blocks: int = struct.field(pytree_node=False)
 
     @property
     def table_shape(self):
-        return (self.r, self.c)
+        return (self.r, self.c_pad)
+
+    @property
+    def sublanes(self):
+        return self.c_pad // _LANES
 
 
-def make_sketch(d: int, c: int, r: int, seed: int = 42, num_blocks: int = 20) -> CountSketch:
+def make_sketch(d: int, c: int, r: int, seed: int = 42,
+                num_blocks: int = 20) -> CountSketch:
     """Build sketch geometry (mirrors ``args2sketch``, reference
-    fed_aggregator.py:464-467). Host-side, deterministic in ``seed``."""
+    fed_aggregator.py:464-467). Host-side, deterministic in ``seed``.
+
+    ``num_blocks`` is accepted for CLI parity (reference utils.py:145); the
+    chunked-cyclic layout already bounds transient memory to O(r·c_pad), so it
+    is recorded but not needed for correctness.
+    """
+    c_pad = -(-int(c) // _LANES) * _LANES
+    T = max(1, -(-int(d) // c_pad))
     rng = np.random.RandomState(seed)
-    keys = rng.randint(1, 2**32 - 1, size=(2, r), dtype=np.uint64).astype(np.uint32)
-    num_blocks = max(1, min(num_blocks, d))
+    m = rng.randint(0, c_pad, size=(r, T))
+    inv = (-m) % c_pad
+    keys = rng.randint(1, 2**31 - 1, size=(r,))
     return CountSketch(
-        row_keys=jnp.asarray(keys[0]),
-        sign_keys=jnp.asarray(keys[1]),
+        shift_q=jnp.asarray(m // _LANES, jnp.int32),
+        shift_w=jnp.asarray(m % _LANES, jnp.int32),
+        inv_q=jnp.asarray(inv // _LANES, jnp.int32),
+        inv_w=jnp.asarray(inv % _LANES, jnp.int32),
+        sign_keys=jnp.asarray(keys, jnp.int32),
         d=int(d),
         c=int(c),
+        c_pad=int(c_pad),
         r=int(r),
+        T=int(T),
         num_blocks=int(num_blocks),
     )
 
 
-def _chunking(cs: CountSketch):
-    chunk = -(-cs.d // cs.num_blocks)  # ceil
-    padded = chunk * cs.num_blocks
-    return chunk, padded
+def _chunks3(cs: CountSketch, v: jax.Array) -> jax.Array:
+    """Pad ``(d,)`` → ``(T, S, 128)`` chunk/sublane/lane layout."""
+    v_p = jnp.pad(v.astype(jnp.float32), (0, cs.T * cs.c_pad - cs.d))
+    return v_p.reshape(cs.T, cs.sublanes, _LANES)
 
 
-def _buckets_signs(cs: CountSketch, idx: jax.Array):
-    """Hashes for coordinate indices ``idx`` (uint32 ``(n,)``).
+def _chunk_signs(cs: CountSketch, t_base: jax.Array) -> jax.Array:
+    """Sign hashes for one chunk, all rows — ``(r, S, 128)``."""
+    S = cs.sublanes
+    idx = t_base + (
+        jax.lax.broadcasted_iota(jnp.int32, (S, _LANES), 0) * _LANES
+        + jax.lax.broadcasted_iota(jnp.int32, (S, _LANES), 1))
+    return jax.vmap(lambda k: _signs_for(idx, k))(cs.sign_keys)
 
-    Returns buckets ``(r, n)`` int32 in [0, c) and signs ``(r, n)`` float32 ±1.
-    """
-    h = _mix32(idx[None, :] ^ cs.row_keys[:, None])
-    buckets = (h % np.uint32(cs.c)).astype(jnp.int32)
-    s = _mix32(idx[None, :] ^ cs.sign_keys[:, None])
-    signs = ((s & np.uint32(1)).astype(jnp.float32) * 2.0) - 1.0
-    return buckets, signs
+
+def _median_small(rows):
+    """Elementwise median of a static-length list via a min/max sorting
+    network — avoids ``sort`` lowerings that Pallas TPU lacks, and is used by
+    both the pure and kernel paths so results match bit-for-bit."""
+    arr = list(rows)
+    n = len(arr)
+    for i in range(n):
+        for j in range(n - 1 - i):
+            lo = jnp.minimum(arr[j], arr[j + 1])
+            hi = jnp.maximum(arr[j], arr[j + 1])
+            arr[j], arr[j + 1] = lo, hi
+    if n % 2:
+        return arr[n // 2]
+    return 0.5 * (arr[n // 2 - 1] + arr[n // 2])
+
+
+# --------------------------------------------------------------------------
+# accumulate: dense (d,) -> (r, c_pad) table
+# --------------------------------------------------------------------------
+
+def _sketch_vec_jax(cs: CountSketch, v: jax.Array) -> jax.Array:
+    v3 = _chunks3(cs, v)
+    S = cs.sublanes
+
+    def body(table, xs):
+        chunk, q_r, w_r, t_base = xs
+        sv = chunk[None, :, :] * _chunk_signs(cs, t_base)          # (r, S, 128)
+        rolled = jax.vmap(_roll2d)(sv, q_r, w_r)
+        return table + rolled, None
+
+    t_bases = jnp.arange(cs.T, dtype=jnp.int32) * (S * _LANES)
+    init = jnp.zeros((cs.r, S, _LANES), jnp.float32)
+    table, _ = jax.lax.scan(
+        body, init, (v3, cs.shift_q.T, cs.shift_w.T, t_bases))
+    return table.reshape(cs.r, cs.c_pad)
+
+
+@functools.partial(jax.jit, static_argnames=("S", "T", "interpret"))
+def _sketch_vec_pallas(v3, shift_q, shift_w, sign_keys, *, S, T,
+                       interpret=False):
+    """Fused accumulate kernel. Grid ``(r, T)``: each table row stays resident
+    in VMEM while the T gradient chunks stream through; sign hashes and the
+    lane-rotation matrix come from iotas (only the gradient is read from
+    HBM)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = shift_q.shape[0]
+    chunk_elems = S * _LANES
+
+    def kernel(q_ref, w_ref, key_ref, v_ref, out_ref, dbl):
+        row = pl.program_id(0)
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        idx = t * chunk_elems + (
+            jax.lax.broadcasted_iota(jnp.int32, (S, _LANES), 0) * _LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (S, _LANES), 1))
+        sv = v_ref[0] * _signs_for(idx, key_ref[row])
+        z = _lane_rotate(sv, w_ref[row, t])
+        dbl[:S] = z
+        dbl[S:] = z
+        q = q_ref[row, t]
+        out_ref[0] += dbl[pl.ds(S - q, S), :]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(r, T),
+        in_specs=[
+            pl.BlockSpec((1, S, _LANES), lambda row, t, *_: (t, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, S, _LANES), lambda row, t, *_: (row, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((2 * S, _LANES), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, S, _LANES), jnp.float32),
+        interpret=interpret,
+    )(shift_q, shift_w, sign_keys, v3)
+    return out
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
 
 
 def sketch_vec(cs: CountSketch, v: jax.Array) -> jax.Array:
-    """Accumulate a dense ``(d,)`` vector into an ``(r, c)`` table.
+    """Accumulate a dense ``(d,)`` vector into an ``(r, c_pad)`` table.
 
     Equivalent of ``CSVec.accumulateVec`` + ``.table`` (reference
     fed_worker.py:313-320). Linear in ``v``.
     """
-    chunk, padded = _chunking(cs)
-    v_p = jnp.pad(v.astype(jnp.float32), (0, padded - cs.d))
+    if _use_pallas():
+        v3 = _chunks3(cs, v)
+        out = _sketch_vec_pallas(v3, cs.shift_q, cs.shift_w, cs.sign_keys,
+                                 S=cs.sublanes, T=cs.T)
+        return out.reshape(cs.r, cs.c_pad)
+    return _sketch_vec_jax(cs, v)
 
-    def body(i, table):
-        start = i * chunk
-        idx = (start + jnp.arange(chunk, dtype=jnp.uint32)).astype(jnp.uint32)
-        vals = jax.lax.dynamic_slice(v_p, (start,), (chunk,))
-        buckets, signs = _buckets_signs(cs, idx)
-        contrib = jax.vmap(
-            lambda b, sv: jnp.zeros((cs.c,), jnp.float32).at[b].add(sv)
-        )(buckets, signs * vals[None, :])
-        return table + contrib
 
-    init = jnp.zeros((cs.r, cs.c), jnp.float32)
-    return jax.lax.fori_loop(0, cs.num_blocks, body, init)
-
+# --------------------------------------------------------------------------
+# query: (r, c_pad) table -> (d,) estimates
+# --------------------------------------------------------------------------
 
 def estimates(cs: CountSketch, table: jax.Array) -> jax.Array:
     """Median-of-rows unbiased estimate of every coordinate — ``(d,)``."""
-    chunk, padded = _chunking(cs)
+    S = cs.sublanes
+    table3 = table.reshape(cs.r, S, _LANES)
 
-    def body(start, _):
-        idx = (start + jnp.arange(chunk, dtype=jnp.uint32)).astype(jnp.uint32)
-        buckets, signs = _buckets_signs(cs, idx)
-        vals = jnp.take_along_axis(table, buckets, axis=1) * signs  # (r, chunk)
-        return start + chunk, jnp.median(vals, axis=0)
+    def body(_, xs):
+        q_r, w_r, t_base = xs
+        rolled = jax.vmap(_roll2d)(table3, q_r, w_r)                # (r, S, 128)
+        est = rolled * _chunk_signs(cs, t_base)
+        return None, _median_small([est[i] for i in range(cs.r)])
 
-    starts = jnp.uint32(0)
-    _, est = jax.lax.scan(body, starts, None, length=cs.num_blocks)
-    return est.reshape(padded)[: cs.d]
+    t_bases = jnp.arange(cs.T, dtype=jnp.int32) * (S * _LANES)
+    _, out = jax.lax.scan(body, None, (cs.inv_q.T, cs.inv_w.T, t_bases))
+    return out.reshape(cs.T * cs.c_pad)[: cs.d]
 
 
 def unsketch(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:
@@ -150,4 +313,5 @@ def unsketch(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:
 def l2estimate(table: jax.Array) -> jax.Array:
     """Median-of-rows estimate of the sketched vector's L2 norm
     (``CSVec.l2estimate``, used via reference utils.py:305-313)."""
-    return jnp.sqrt(jnp.median(jnp.sum(jnp.square(table), axis=1)))
+    sq = jnp.sum(jnp.square(table), axis=1)
+    return jnp.sqrt(_median_small([sq[i] for i in range(sq.shape[0])]))

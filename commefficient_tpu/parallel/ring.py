@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from commefficient_tpu.parallel.mesh import SEQ_AXIS
+
 __all__ = ["ring_attention", "make_ring_attention"]
 
 _NEG = -0.7 * jnp.finfo(jnp.float32).max  # large-negative mask value, nan-free
@@ -97,7 +99,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, axis: str = "seq", causal: bool = True):
+def make_ring_attention(mesh: Mesh, axis: str = SEQ_AXIS, causal: bool = True):
     """shard_map wrapper: takes globally-shaped (B, T, H, D) arrays sharded
     (or shardable) on ``axis`` along T, returns the attention output with the
     same sharding."""

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from commefficient_tpu.parallel.mesh import SEQ_AXIS
+
 __all__ = ["ulysses_attention", "make_ulysses_attention"]
 
 
@@ -59,7 +61,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
     return head2seq(out)
 
 
-def make_ulysses_attention(mesh: Mesh, axis: str = "seq",
+def make_ulysses_attention(mesh: Mesh, axis: str = SEQ_AXIS,
                            causal: bool = True):
     spec = P(None, axis, None, None)
 

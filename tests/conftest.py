@@ -3,10 +3,19 @@
 Mirrors the SURVEY.md §4 implication: the collective path is covered without
 TPU hardware via ``--xla_force_host_platform_device_count``. Must run before
 jax is imported anywhere.
+
+Two environment hazards are neutralized here:
+- a site hook may pre-register an accelerator platform and force
+  ``jax_platforms`` at interpreter startup; ``jax.config.update`` after import
+  wins, keeping the suite hermetic on CPU;
+- the image has zero egress, so any HuggingFace hub lookup blocks in a retry
+  loop — offline mode turns those into immediate errors the code gates on.
 """
 
 import os
 
+os.environ["HF_HUB_OFFLINE"] = "1"
+os.environ["TRANSFORMERS_OFFLINE"] = "1"
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -16,4 +25,5 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
