@@ -19,7 +19,11 @@ from commefficient_tpu.data_utils.tokenization import (
     ByteTokenizer,
     get_tokenizer,
 )
-from commefficient_tpu.data_utils.loader import FedLoader, cv_collate
+from commefficient_tpu.data_utils.loader import (
+    FedLoader,
+    PrefetchLoader,
+    cv_collate,
+)
 from commefficient_tpu.data_utils import transforms
 
 fed_datasets = {
@@ -49,6 +53,7 @@ __all__ = [
     "ATTR_TO_SPECIAL_TOKEN",
     "FedSampler",
     "FedLoader",
+    "PrefetchLoader",
     "cv_collate",
     "transforms",
     "fed_datasets",

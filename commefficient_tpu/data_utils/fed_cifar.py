@@ -85,8 +85,16 @@ class FedCIFAR10(FedDataset):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         if self.type == "train":
-            self.client_datasets = [np.load(self.client_fn(i))
-                                    for i in range(len(self.images_per_client))]
+            # one contiguous store; client_datasets are views into it so the
+            # per-item and native batch paths share a single buffer
+            self._store = np.ascontiguousarray(np.concatenate(
+                [np.load(self.client_fn(i))
+                 for i in range(len(self.images_per_client))], axis=0))
+            bounds = np.cumsum(self.images_per_client)[:-1]
+            self.client_datasets = np.split(self._store, bounds, axis=0)
+            self._store_targets = np.repeat(
+                np.arange(len(self.images_per_client), dtype=np.int64),
+                self.images_per_client)
         else:
             with np.load(self.test_fn()) as t:
                 self.test_images = t["test_images"]
@@ -114,6 +122,15 @@ class FedCIFAR10(FedDataset):
     def _get_train_item(self, client_id, idx_within_client):
         # train target IS the client id (reference fed_cifar.py:77-84)
         return self.client_datasets[client_id][idx_within_client], client_id
+
+    def native_train_access(self):
+        # store rows are the natural concatenation → target = natural client
+        # (the class), matching _get_train_item
+        return {"store": self._store, "targets": self._store_targets}
+
+    def native_val_access(self):
+        return {"store": self.test_images,
+                "targets": np.asarray(self.test_targets, np.int64)}
 
     def _get_val_item(self, idx):
         return self.test_images[idx], int(self.test_targets[idx])

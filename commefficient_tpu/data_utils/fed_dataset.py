@@ -114,6 +114,25 @@ class FedDataset:
             image = self.transform(image)
         return client_id, image, target
 
+    # -- native fast-path support -----------------------------------------
+
+    def store_rows(self, idxs):
+        """Vectorized flat-index → raw-store-row map (store rows are the
+        natural concatenation order; iid is a permutation on top)."""
+        idxs = np.asarray(idxs, np.int64)
+        if self.type == "train" and self.do_iid:
+            return np.asarray(self.iid_shuffle)[idxs]
+        return idxs
+
+    def native_train_access(self):
+        """Subclasses with a contiguous in-memory train store return
+        ``{"store": (N,H,W,C) array, "targets": (N,) int64}`` (rows in
+        natural order); None disables the loader's native fast path."""
+        return None
+
+    def native_val_access(self):
+        return None
+
     # -- subclass hooks ----------------------------------------------------
 
     def prepare_datasets(self, download=False):

@@ -25,12 +25,29 @@ __all__ = ["FedEMNIST"]
 
 
 def _read_leaf_dir(data_dir):
+    """Parse all LEAF shard jsons in ``data_dir`` → {user: {"x": (n, feat)
+    float32, "y": (n,) int64}}. Uses the native C++ parser (the orjson
+    replacement, commefficient_tpu.native.leaf_parse) when available, falling
+    back to the stdlib ``json`` module per file."""
+    from commefficient_tpu import native
+
     data = {}
     if not os.path.isdir(data_dir):
         return data
     for f in sorted(os.listdir(data_dir)):
-        if f.endswith(".json"):
-            with open(os.path.join(data_dir, f), "rb") as inf:
+        if not f.endswith(".json"):
+            continue
+        path = os.path.join(data_dir, f)
+        parsed = native.leaf_parse(path)
+        if parsed is not None:
+            users, x, y, offsets = parsed
+            # keyed by username, last-wins — same merge semantics as the
+            # json fallback's dict.update
+            for u, name in enumerate(users):
+                lo, hi = int(offsets[u]), int(offsets[u + 1])
+                data[name] = {"x": x[lo:hi], "y": y[lo:hi]}
+        else:
+            with open(path, "rb") as inf:
                 cdata = json.loads(inf.read())
             data.update(cdata["user_data"])
     return data
@@ -73,6 +90,11 @@ class FedEMNIST(FedDataset):
             with np.load(self.test_fn()) as d:
                 self.test_images = d["x"]
                 self.test_targets = d["y"]
+
+    def native_val_access(self):
+        # float32 (N, 28, 28) store → the loader's fused normalize path
+        return {"store": self.test_images,
+                "targets": np.asarray(self.test_targets, np.int64)}
 
     def prepare_datasets(self, download=False):
         train_data = _read_leaf_dir(os.path.join(self.dataset_dir, "train"))

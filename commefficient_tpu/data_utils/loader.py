@@ -21,13 +21,31 @@ nothing — replacing the reference's skip/assert handling of ragged tails.
 Val batches are flat: {inputs: (B, ...), targets: (B,), mask: (B,)} with the
 client_id −1 sentinel implied (no per-client state on the val path,
 reference fed_aggregator.py:337-364).
+
+Fast path: when the dataset exposes a contiguous store
+(``native_train_access``) and the transform is expressible as the fused
+native pad/crop/flip/normalize kernel (``transform.native_spec``), whole
+rounds are assembled by one multithreaded C++ call
+(commefficient_tpu.native.image_batch) instead of a per-item Python loop.
+Augmentation randomness is drawn with ``np.random`` in the exact per-item
+order of the Python transform stack, so both paths produce identical batches
+under the same seed (covered by tests/test_native.py).
+
+``PrefetchLoader`` wraps any loader with a bounded background-thread queue —
+the C++ assembly releases the GIL, so host batch prep overlaps device
+compute (the role of the reference's DataLoader worker processes).
 """
 
 from __future__ import annotations
 
+import queue
+import threading
+
 import numpy as np
 
-__all__ = ["FedLoader", "cv_collate"]
+from commefficient_tpu import native
+
+__all__ = ["FedLoader", "PrefetchLoader", "cv_collate"]
 
 
 def cv_collate(items):
@@ -39,17 +57,34 @@ def cv_collate(items):
 
 class FedLoader:
     def __init__(self, dataset, num_workers=1, local_batch_size=8,
-                 collate_fn=cv_collate, val_batch_size=None):
+                 collate_fn=cv_collate, val_batch_size=None, use_native=None):
         self.dataset = dataset
         self.num_workers = num_workers
         self.local_batch_size = local_batch_size
         self.collate_fn = collate_fn
         self.val_batch_size = val_batch_size or 64
         self.train = dataset.type == "train"
+        # cheap structural check first — native.available() may trigger the
+        # one-time g++ build, pointless when the fast path can't apply
+        if use_native is None:
+            use_native = self._native_ok() and native.available()
+        self.use_native = bool(use_native) and self._native_ok()
         if self.train:
             from commefficient_tpu.data_utils.fed_sampler import FedSampler
 
             self.sampler = FedSampler(dataset, num_workers, local_batch_size)
+
+    def _native_ok(self) -> bool:
+        # the fused path emits cv-style {inputs, targets} batches; a custom
+        # collate_fn must win over it
+        if self.collate_fn is not cv_collate:
+            return False
+        spec = getattr(self.dataset.transform, "native_spec", None)
+        if spec is None:
+            return False
+        access = (self.dataset.native_train_access() if self.train
+                  else self.dataset.native_val_access())
+        return access is not None
 
     @property
     def batch_pad(self) -> int:
@@ -78,9 +113,17 @@ class FedLoader:
 
     def __iter__(self):
         if self.train:
-            yield from self._train_iter()
+            if self.use_native:
+                yield from self._train_iter_native()
+            else:
+                yield from self._train_iter()
         else:
-            yield from self._val_iter()
+            if self.use_native:
+                yield from self._val_iter_native()
+            else:
+                yield from self._val_iter()
+
+    # -- python per-item paths --------------------------------------------
 
     def _train_iter(self):
         W, B = self.num_workers, self.batch_pad
@@ -126,3 +169,150 @@ class FedLoader:
             }
             batch["mask"] = mask
             yield batch
+
+    # -- native fused paths ------------------------------------------------
+
+    def _assemble_native(self, flat_idx, spec, access):
+        """flat_idx: (M,) int64 flat dataset indices, −1 = padding. Returns
+        (inputs (M,size,size,C) f32, targets (M,) int64)."""
+        M = flat_idx.shape[0]
+        rows = np.full(M, -1, np.int64)
+        ok = flat_idx >= 0
+        rows[ok] = self.dataset.store_rows(flat_idx[ok])
+        if spec["train"]:
+            # same np.random draw order as RandomCrop (h then w) +
+            # RandomHorizontalFlip, per item
+            crop_h = np.zeros(M, np.int32)
+            crop_w = np.zeros(M, np.int32)
+            flip = np.zeros(M, np.uint8)
+            hi = 2 * spec["pad"] + 1
+            for m in range(M):
+                if not ok[m]:
+                    continue
+                crop_h[m] = np.random.randint(0, hi)
+                crop_w[m] = np.random.randint(0, hi)
+                flip[m] = np.random.rand() < 0.5
+        else:
+            crop_h = crop_w = flip = None
+        inputs = native.image_batch(
+            access["store"], rows, crop_h, crop_w, flip,
+            spec["pad"], spec["size"], spec["mean"], spec["std"])
+        targets = np.zeros(M, np.int64)
+        targets[ok] = access["targets"][rows[ok]]
+        return inputs, targets
+
+    def _train_iter_native(self):
+        W, B = self.num_workers, self.batch_pad
+        spec = self.dataset.transform.native_spec
+        access = self.dataset.native_train_access()
+        for workers, idx_lists in self.sampler.iter_structured():
+            n = len(workers)
+            client_ids = np.zeros(W, np.int32)
+            client_ids[:n] = workers
+            worker_mask = np.zeros(W, np.float32)
+            worker_mask[:n] = 1.0
+            mask = np.zeros((W, B), np.float32)
+            flat_idx = np.full((W, B), -1, np.int64)
+            for w, idxs in enumerate(idx_lists):
+                b = len(idxs)
+                mask[w, :b] = 1.0
+                flat_idx[w, :b] = np.asarray(idxs, np.int64)
+            inputs, targets = self._assemble_native(flat_idx.reshape(-1),
+                                                    spec, access)
+            yield {
+                "inputs": inputs.reshape((W, B) + inputs.shape[1:]),
+                "targets": targets.reshape(W, B),
+                "client_ids": client_ids,
+                "worker_mask": worker_mask,
+                "mask": mask,
+            }
+
+    def _val_iter_native(self):
+        N = len(self.dataset)
+        B = self.val_batch_size
+        spec = self.dataset.transform.native_spec
+        access = self.dataset.native_val_access()
+        for start in range(0, N, B):
+            n = min(B, N - start)
+            flat_idx = np.full(B, -1, np.int64)
+            flat_idx[:n] = np.arange(start, start + n)
+            mask = np.zeros(B, np.float32)
+            mask[:n] = 1.0
+            # val store rows are the flat val indices themselves
+            rows = flat_idx
+            inputs = native.image_batch(
+                access["store"], rows, None, None, None,
+                0, spec["size"], spec["mean"], spec["std"])
+            targets = np.zeros(B, np.int64)
+            targets[:n] = access["targets"][start:start + n]
+            yield {"inputs": inputs, "targets": targets, "mask": mask}
+
+
+class PrefetchLoader:
+    """Background-thread prefetch with a bounded queue.
+
+    The role of the reference's DataLoader worker processes
+    (train_dataloader_workers, reference utils.py:178-182): overlap host-side
+    batch assembly with device compute. One thread suffices because the heavy
+    work happens inside GIL-released native calls.
+    """
+
+    _END = object()
+
+    def __init__(self, loader, depth: int = 2):
+        self.loader = loader
+        self.depth = depth
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __getattr__(self, name):
+        return getattr(self.loader, name)
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        err = []
+        stop = threading.Event()
+
+        def worker():
+            try:
+                for batch in self.loader:
+                    while not stop.is_set():
+                        try:
+                            q.put(batch, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # re-raised on the consumer side
+                err.append(e)
+            finally:
+                while True:  # sentinel must land even if the queue is full
+                    try:
+                        q.put(self._END, timeout=0.1)
+                        break
+                    except queue.Full:
+                        if stop.is_set():
+                            break
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._END:
+                    break
+                yield item
+        finally:
+            # consumer stopped early (break / GeneratorExit): unblock and
+            # reap the producer instead of leaking it
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join()
+            if err:
+                raise err[0]

@@ -200,6 +200,20 @@ cifar100_train_transforms = Compose([
 ])
 cifar100_test_transforms = Compose([to_float, Normalize(cifar100_mean, cifar100_std)])
 
+# native_spec marks stacks expressible as the fused native
+# pad/crop/flip/normalize kernel (commefficient_tpu.native.image_batch); the
+# loader's fast path keys on it. ``rng_draws``: ("crop", "flip") per item, in
+# the exact np.random draw order of the Python stack above — the fast path
+# replays the same draws so both paths produce identical batches.
+cifar10_train_transforms.native_spec = dict(
+    pad=4, size=32, mean=cifar10_mean, std=cifar10_std, train=True)
+cifar10_test_transforms.native_spec = dict(
+    pad=0, size=32, mean=cifar10_mean, std=cifar10_std, train=False)
+cifar100_train_transforms.native_spec = dict(
+    pad=4, size=32, mean=cifar100_mean, std=cifar100_std, train=True)
+cifar100_test_transforms.native_spec = dict(
+    pad=0, size=32, mean=cifar100_mean, std=cifar100_std, train=False)
+
 femnist_train_transforms = Compose([
     to_float,
     RandomCrop(28, padding=2, mode="constant", fill=1.0),
@@ -208,6 +222,8 @@ femnist_train_transforms = Compose([
     Normalize(femnist_mean, femnist_std),
 ])
 femnist_test_transforms = Compose([to_float, Normalize(femnist_mean, femnist_std)])
+femnist_test_transforms.native_spec = dict(
+    pad=0, size=28, mean=femnist_mean, std=femnist_std, train=False)
 
 imagenet_train_transforms = Compose([
     to_float,

@@ -1,0 +1,207 @@
+"""ctypes bindings for the native data plane (``native/feddata.cpp``).
+
+The reference leans on native code for its data layer — torchvision/PIL image
+ops, torch DataLoader's C++ worker pool, and the Rust ``orjson`` parser for
+LEAF FEMNIST shards (reference data_utils/fed_emnist.py:1, SURVEY.md §2.2).
+This module is the TPU-host equivalent: a small C++ library built lazily with
+``g++`` at first use (no pybind11 in the image — plain C ABI + ctypes), with
+every entry point falling back to pure numpy when the toolchain or the build
+is unavailable (``COMMEFFICIENT_NO_NATIVE=1`` forces the fallback).
+
+ctypes releases the GIL for the duration of each call, so the C++ thread pool
+and the ``PrefetchLoader`` thread overlap host batch assembly with device
+compute.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = [
+    "available",
+    "image_batch",
+    "leaf_parse",
+]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "feddata.cpp")
+_CACHE_DIR = os.environ.get(
+    "COMMEFFICIENT_NATIVE_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "commefficient_tpu"))
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build_and_load():
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    so_path = os.path.join(_CACHE_DIR, f"libfeddata-{tag}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        tmp = so_path + f".tmp{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=300)
+        os.replace(tmp, so_path)
+    lib = ctypes.CDLL(so_path)
+
+    i8p = ctypes.c_char_p
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    ll = ctypes.c_longlong
+    i = ctypes.c_int
+
+    lib.fd_image_batch.restype = None
+    lib.fd_image_batch.argtypes = [
+        ctypes.c_void_p, i, ll, i, i, i, i64p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ll, i, i, f32p, f32p, f32p, i]
+    lib.fd_leaf_open.restype = ll
+    lib.fd_leaf_open.argtypes = [i8p]
+    lib.fd_leaf_counts.restype = None
+    lib.fd_leaf_counts.argtypes = [ll, ctypes.POINTER(ll), ctypes.POINTER(ll),
+                                   ctypes.POINTER(ll), ctypes.POINTER(ll)]
+    lib.fd_leaf_names.restype = None
+    lib.fd_leaf_names.argtypes = [ll, ctypes.c_char_p]
+    lib.fd_leaf_fill.restype = None
+    lib.fd_leaf_fill.argtypes = [ll, f32p, i64p, i64p]
+    lib.fd_leaf_close.restype = None
+    lib.fd_leaf_close.argtypes = [ll]
+    return lib
+
+
+def _get_lib():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("COMMEFFICIENT_NO_NATIVE") == "1":
+            return None
+        try:
+            _lib = _build_and_load()
+        except Exception as e:
+            import sys
+
+            print(f"commefficient_tpu.native: build unavailable ({e!r}); "
+                  "using numpy fallbacks", file=sys.stderr)
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+def _nthreads() -> int:
+    return int(os.environ.get("COMMEFFICIENT_NATIVE_THREADS", 0))
+
+
+def image_batch(src, indices, crop_h, crop_w, flip, pad, size, mean, std):
+    """Fused pad/crop/flip/normalize batch assembly.
+
+    src: (N, H, W, C) uint8 or float32. indices: (M,) int64, −1 → zero slot.
+    Returns (M, size, size, C) float32. Falls back to numpy when the native
+    library is unavailable.
+    """
+    src = np.ascontiguousarray(src)
+    if src.ndim == 3:
+        src = src[..., None]
+    N, H, W, C = src.shape
+    indices = np.ascontiguousarray(indices, np.int64)
+    M = indices.shape[0]
+    mean = np.ascontiguousarray(np.broadcast_to(mean, (C,)), np.float32)
+    std = np.ascontiguousarray(np.broadcast_to(std, (C,)), np.float32)
+
+    lib = _get_lib()
+    if lib is not None and src.dtype in (np.uint8, np.float32):
+        out = np.empty((M, size, size, C), np.float32)
+        ch = np.ascontiguousarray(crop_h, np.int32) if crop_h is not None else None
+        cw = np.ascontiguousarray(crop_w, np.int32) if crop_w is not None else None
+        fl = np.ascontiguousarray(flip, np.uint8) if flip is not None else None
+        lib.fd_image_batch(
+            src.ctypes.data_as(ctypes.c_void_p), int(src.dtype == np.uint8),
+            N, H, W, C, indices,
+            ch.ctypes.data_as(ctypes.c_void_p) if ch is not None else None,
+            cw.ctypes.data_as(ctypes.c_void_p) if cw is not None else None,
+            fl.ctypes.data_as(ctypes.c_void_p) if fl is not None else None,
+            M, int(pad), int(size), mean, std, out, _nthreads())
+        return out
+    return _image_batch_np(src, indices, crop_h, crop_w, flip, pad, size,
+                           mean, std)
+
+
+def _image_batch_np(src, indices, crop_h, crop_w, flip, pad, size, mean, std):
+    N, H, W, C = src.shape
+    M = indices.shape[0]
+    out = np.zeros((M, size, size, C), np.float32)
+    for m in range(M):
+        idx = int(indices[m])
+        if idx < 0:
+            continue
+        img = src[idx]
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 255.0
+        else:
+            img = img.astype(np.float32)
+        if pad:
+            img = np.pad(img, ((pad, pad), (pad, pad), (0, 0)), mode="reflect")
+        h = int(crop_h[m]) if crop_h is not None else 0
+        w = int(crop_w[m]) if crop_w is not None else 0
+        img = img[h:h + size, w:w + size]
+        if flip is not None and flip[m]:
+            img = img[:, ::-1]
+        out[m] = (img - mean) / std
+    return out
+
+
+def leaf_parse(path):
+    """Parse one LEAF shard json natively.
+
+    Returns (users, x, y, offsets): users list[str] in file order, x
+    (total, feat) float32, y (total,) int64, offsets (n_users+1,) int64 —
+    or None when the native parser is unavailable or rejects the file
+    (caller falls back to ``json``).
+    """
+    lib = _get_lib()
+    if lib is None:
+        return None
+    h = lib.fd_leaf_open(path.encode())
+    if h < 0:
+        return None
+    try:
+        n_users = ctypes.c_longlong()
+        total = ctypes.c_longlong()
+        feat = ctypes.c_longlong()
+        name_bytes = ctypes.c_longlong()
+        lib.fd_leaf_counts(h, ctypes.byref(n_users), ctypes.byref(total),
+                           ctypes.byref(feat), ctypes.byref(name_bytes))
+        if n_users.value <= 0:
+            return None
+        namebuf = ctypes.create_string_buffer(max(1, name_bytes.value))
+        lib.fd_leaf_names(h, namebuf)
+        users = namebuf.raw[: name_bytes.value].decode("utf-8",
+                                                       "replace").split("\n")
+        x = np.empty((total.value, feat.value), np.float32)
+        y = np.empty((total.value,), np.int64)
+        offsets = np.empty((n_users.value + 1,), np.int64)
+        lib.fd_leaf_fill(h, x.reshape(-1), y, offsets)
+        if len(users) != n_users.value:
+            return None
+        return users, x, y, offsets
+    finally:
+        lib.fd_leaf_close(h)

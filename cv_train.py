@@ -25,6 +25,7 @@ from commefficient_tpu.data_utils import (
     FedEMNIST,
     FedImageNet,
     FedLoader,
+    PrefetchLoader,
     num_classes_of_dataset,
     transforms,
 )
@@ -77,6 +78,12 @@ def get_data_loaders(args):
     test_loader = FedLoader(test_dataset,
                             val_batch_size=args.valid_batch_size
                             * args.num_workers)
+    # background prefetch (the reference's DataLoader worker knob,
+    # utils.py:178-182); assembly runs in GIL-released native calls
+    if args.train_dataloader_workers > 0:
+        train_loader = PrefetchLoader(train_loader)
+    if args.val_dataloader_workers > 0:
+        test_loader = PrefetchLoader(test_loader)
     return train_loader, test_loader
 
 

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from commefficient_tpu.config import parse_args
-from commefficient_tpu.data_utils import FedLoader
+from commefficient_tpu.data_utils import FedLoader, PrefetchLoader
 from commefficient_tpu.data_utils.fed_persona import (
     FedPERSONA,
     make_personachat_collate_fn,
@@ -68,6 +68,10 @@ def get_data_loaders(args, tokenizer):
         val_batch_size=args.valid_batch_size * args.num_workers,
         collate_fn=_wrap(make_personachat_collate_fn(MAX_SEQ_LEN,
                                                      n_cand_val)))
+    if args.train_dataloader_workers > 0:
+        train_loader = PrefetchLoader(train_loader)
+    if args.val_dataloader_workers > 0:
+        val_loader = PrefetchLoader(val_loader)
     return train_loader, val_loader
 
 

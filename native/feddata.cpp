@@ -1,0 +1,419 @@
+// feddata — native data plane for commefficient_tpu.
+//
+// TPU-native equivalent of the reference's native data-layer dependencies
+// (SURVEY.md §2.2): torchvision/PIL's C image ops + torch DataLoader's C++
+// worker core (batch assembly), and the Rust `orjson` LEAF-FEMNIST JSON parse
+// (reference data_utils/fed_emnist.py:1). Exposed through a plain C ABI and
+// loaded from Python with ctypes (no pybind11 in the image).
+//
+// Everything here runs with the GIL released (ctypes drops it for the call
+// duration), so a Python-thread prefetcher gets real overlap with device
+// compute on the host side.
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread feddata.cpp -o libfeddata.so
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// threading: static partition of [0, n) over up to `nthreads` std::threads
+// ---------------------------------------------------------------------------
+
+template <typename F>
+void parallel_for(long long n, int nthreads, long long work_per_item,
+                  F&& body) {
+  if (n <= 0) return;
+  unsigned hw = std::thread::hardware_concurrency();
+  int t = nthreads > 0 ? nthreads : (hw ? (int)hw : 1);
+  if ((long long)t > n) t = (int)n;
+  // clamp by work volume: ~256K elements of work per thread minimum, so
+  // tiny batches don't pay thread spawn/join overhead
+  const long long grain = 1 << 18;
+  long long total = n * std::max((long long)1, work_per_item);
+  if ((long long)t > total / grain) t = (int)std::max((long long)1, total / grain);
+  if (t <= 1) {
+    for (long long i = 0; i < n; ++i) body(i);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(t);
+  long long chunk = (n + t - 1) / t;
+  for (int w = 0; w < t; ++w) {
+    long long lo = w * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back([lo, hi, &body] {
+      for (long long i = lo; i < hi; ++i) body(i);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+// numpy-'reflect' index (no edge repeat): fold t into [0, n)
+inline int reflect_idx(int t, int n) {
+  if (n == 1) return 0;
+  while (t < 0 || t >= n) t = (t < 0) ? -t : 2 * n - 2 - t;
+  return t;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// fd_image_batch — fused pad/crop/flip/to-float/normalize batch assembly.
+//
+// src:     (N, H, W, C) uint8 (src_is_u8=1) or float32, contiguous
+// indices: (M,) int64 rows into src; idx < 0 → all-zero output slot
+// crop_h/crop_w: (M,) int32 top-left of the crop in the padded image
+// flip:    (M,) uint8 nonzero → horizontal flip
+// pad:     reflect padding applied on each side before cropping (0 = none)
+// size:    output spatial size (crop window)
+// mean/std:(C,) float32 channel normalization (applied after /255 for u8)
+// out:     (M, size, size, C) float32
+// ---------------------------------------------------------------------------
+void fd_image_batch(const void* src, int src_is_u8, long long N, int H, int W,
+                    int C, const long long* indices, const int* crop_h,
+                    const int* crop_w, const unsigned char* flip, long long M,
+                    int pad, int size, const float* mean, const float* stddev,
+                    float* out, int nthreads) {
+  (void)N;
+  const long long row = (long long)H * W * C;
+  const long long orow = (long long)size * size * C;
+  std::vector<float> inv_std(C), meanv(C);
+  for (int c = 0; c < C; ++c) {
+    inv_std[c] = 1.0f / stddev[c];
+    meanv[c] = mean[c];
+  }
+  const float u8scale = 1.0f / 255.0f;
+
+  parallel_for(M, nthreads, orow, [&](long long m) {
+    float* dst = out + m * orow;
+    long long idx = indices[m];
+    if (idx < 0) {
+      std::memset(dst, 0, sizeof(float) * orow);
+      return;
+    }
+    const uint8_t* s8 = src_is_u8 ? (const uint8_t*)src + idx * row : nullptr;
+    const float* sf = src_is_u8 ? nullptr : (const float*)src + idx * row;
+    const int ch = crop_h ? crop_h[m] : 0;
+    const int cw = crop_w ? crop_w[m] : 0;
+    const bool fl = flip && flip[m];
+    for (int i = 0; i < size; ++i) {
+      const int sy = reflect_idx(ch + i - pad, H);
+      const long long yoff = (long long)sy * W * C;
+      for (int j = 0; j < size; ++j) {
+        const int oj = fl ? (size - 1 - j) : j;
+        const int sx = reflect_idx(cw + j - pad, W);
+        const long long soff = yoff + (long long)sx * C;
+        float* d = dst + ((long long)i * size + oj) * C;
+        if (src_is_u8) {
+          for (int c = 0; c < C; ++c)
+            d[c] = ((float)s8[soff + c] * u8scale - meanv[c]) * inv_std[c];
+        } else {
+          for (int c = 0; c < C; ++c)
+            d[c] = (sf[soff + c] - meanv[c]) * inv_std[c];
+        }
+      }
+    }
+  });
+}
+
+// ---------------------------------------------------------------------------
+// LEAF FEMNIST JSON parsing (the orjson replacement).
+//
+// Restricted-schema parser for LEAF shard files:
+//   {"users": [...], "num_samples": [...],
+//    "user_data": {"<u>": {"x": [[f, ...], ...], "y": [i, ...]}, ...}}
+// Two-call protocol: fd_leaf_open parses and returns a handle (−1 on any
+// parse error — caller falls back to a Python json parse), fd_leaf_counts
+// reports sizes, fd_leaf_fill copies into caller-allocated numpy buffers.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct LeafData {
+  std::vector<float> x;                 // total_items * feat_dim
+  std::vector<long long> y;             // total_items
+  std::vector<long long> offsets;       // n_users + 1
+  std::string names;                    // '\n'-joined user names, in order
+  long long feat_dim = 0;
+};
+
+struct Parser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool lit(char c) {
+    ws();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    ok = false;
+    return false;
+  }
+  bool peek(char c) {
+    ws();
+    return p < end && *p == c;
+  }
+  // parse a JSON string (handling escapes) into out
+  bool str(std::string* out) {
+    if (!lit('"')) return false;
+    out->clear();
+    while (p < end && *p != '"') {
+      char c = *p++;
+      if (c == '\\' && p < end) {
+        char e = *p++;
+        switch (e) {
+          case 'n': c = '\n'; break;
+          case 't': c = '\t'; break;
+          case 'r': c = '\r'; break;
+          case 'b': c = '\b'; break;
+          case 'f': c = '\f'; break;
+          case 'u': {
+            // LEAF user names are hex-ish ASCII; decode BMP escapes naively
+            if (end - p < 4) { ok = false; return false; }
+            int code = 0;
+            for (int k = 0; k < 4; ++k) {
+              char h = *p++;
+              code <<= 4;
+              if (h >= '0' && h <= '9') code |= h - '0';
+              else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+              else { ok = false; return false; }
+            }
+            c = (char)(code & 0x7f);
+            break;
+          }
+          default: c = e;
+        }
+      }
+      out->push_back(c);
+    }
+    return lit('"');
+  }
+  double num() {
+    ws();
+    char* endp = nullptr;
+    double v = std::strtod(p, &endp);
+    if (endp == p) {
+      ok = false;
+      return 0.0;
+    }
+    p = endp;
+    return v;
+  }
+  // skip any JSON value
+  void skip() {
+    ws();
+    if (p >= end) { ok = false; return; }
+    char c = *p;
+    if (c == '{') {
+      ++p;
+      ws();
+      if (peek('}')) { lit('}'); return; }
+      while (ok) {
+        std::string k;
+        if (!str(&k)) return;
+        if (!lit(':')) return;
+        skip();
+        if (peek(',')) { lit(','); continue; }
+        lit('}');
+        return;
+      }
+    } else if (c == '[') {
+      ++p;
+      ws();
+      if (peek(']')) { lit(']'); return; }
+      while (ok) {
+        skip();
+        if (peek(',')) { lit(','); continue; }
+        lit(']');
+        return;
+      }
+    } else if (c == '"') {
+      std::string s;
+      str(&s);
+    } else if (std::strncmp(p, "true", 4) == 0) {
+      p += 4;
+    } else if (std::strncmp(p, "false", 5) == 0) {
+      p += 5;
+    } else if (std::strncmp(p, "null", 4) == 0) {
+      p += 4;
+    } else {
+      num();
+    }
+  }
+};
+
+std::mutex g_leaf_mu;
+std::map<long long, LeafData*> g_leaf;
+std::atomic<long long> g_leaf_next{1};
+
+}  // namespace
+
+long long fd_leaf_open(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::fseek(f, 0, SEEK_END);
+  long long sz = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf;
+  buf.resize(sz);
+  if (sz > 0 && std::fread(&buf[0], 1, sz, f) != (size_t)sz) {
+    std::fclose(f);
+    return -1;
+  }
+  std::fclose(f);
+
+  auto data = new LeafData();
+  data->offsets.push_back(0);
+  Parser ps{buf.data(), buf.data() + buf.size()};
+
+  if (!ps.lit('{')) { delete data; return -1; }
+  bool first = true;
+  while (ps.ok) {
+    if (!first && ps.peek(',')) ps.lit(',');
+    if (ps.peek('}')) { ps.lit('}'); break; }
+    first = false;
+    std::string key;
+    if (!ps.str(&key) || !ps.lit(':')) break;
+    if (key != "user_data") {
+      ps.skip();
+      continue;
+    }
+    // user_data: {"name": {"x": [[...]...], "y": [...]}, ...}
+    if (!ps.lit('{')) break;
+    if (ps.peek('}')) { ps.lit('}'); continue; }
+    while (ps.ok) {
+      std::string user;
+      if (!ps.str(&user) || !ps.lit(':')) break;
+      if (!ps.lit('{')) break;
+      long long n_items_x = 0, n_items_y = 0;
+      while (ps.ok) {
+        std::string field;
+        if (!ps.str(&field) || !ps.lit(':')) break;
+        if (field == "x") {
+          if (!ps.lit('[')) break;
+          if (ps.peek(']')) { ps.lit(']'); }
+          else {
+            while (ps.ok) {
+              if (!ps.lit('[')) break;
+              long long dim = 0;
+              if (ps.peek(']')) { ps.lit(']'); }
+              else {
+                while (ps.ok) {
+                  data->x.push_back((float)ps.num());
+                  ++dim;
+                  if (ps.peek(',')) { ps.lit(','); continue; }
+                  ps.lit(']');
+                  break;
+                }
+              }
+              if (data->feat_dim == 0) data->feat_dim = dim;
+              else if (dim != data->feat_dim) { ps.ok = false; break; }
+              ++n_items_x;
+              if (ps.peek(',')) { ps.lit(','); continue; }
+              ps.lit(']');
+              break;
+            }
+          }
+        } else if (field == "y") {
+          if (!ps.lit('[')) break;
+          if (ps.peek(']')) { ps.lit(']'); }
+          else {
+            while (ps.ok) {
+              data->y.push_back((long long)ps.num());
+              ++n_items_y;
+              if (ps.peek(',')) { ps.lit(','); continue; }
+              ps.lit(']');
+              break;
+            }
+          }
+        } else {
+          ps.skip();
+        }
+        if (ps.peek(',')) { ps.lit(','); continue; }
+        ps.lit('}');
+        break;
+      }
+      if (!ps.ok || n_items_x != n_items_y) { ps.ok = false; break; }
+      if (user.find('\n') != std::string::npos) { ps.ok = false; break; }
+      if (!data->names.empty()) data->names.push_back('\n');
+      data->names += user;
+      data->offsets.push_back(data->offsets.back() + n_items_x);
+      if (ps.peek(',')) { ps.lit(','); continue; }
+      ps.lit('}');
+      break;
+    }
+  }
+
+  if (!ps.ok || data->offsets.size() <= 1) {
+    delete data;
+    return -1;
+  }
+  long long h = g_leaf_next++;
+  std::lock_guard<std::mutex> lk(g_leaf_mu);
+  g_leaf[h] = data;
+  return h;
+}
+
+void fd_leaf_counts(long long h, long long* n_users, long long* total_items,
+                    long long* feat_dim, long long* name_bytes) {
+  std::lock_guard<std::mutex> lk(g_leaf_mu);
+  auto it = g_leaf.find(h);
+  if (it == g_leaf.end()) {
+    *n_users = *total_items = *feat_dim = *name_bytes = 0;
+    return;
+  }
+  *n_users = (long long)it->second->offsets.size() - 1;
+  *total_items = (long long)it->second->y.size();
+  *feat_dim = it->second->feat_dim;
+  *name_bytes = (long long)it->second->names.size();
+}
+
+// copies the '\n'-joined user names (no trailing NUL) into buf
+void fd_leaf_names(long long h, char* buf) {
+  std::lock_guard<std::mutex> lk(g_leaf_mu);
+  auto it = g_leaf.find(h);
+  if (it == g_leaf.end()) return;
+  std::memcpy(buf, it->second->names.data(), it->second->names.size());
+}
+
+void fd_leaf_fill(long long h, float* x_out, long long* y_out,
+                  long long* offsets_out) {
+  std::lock_guard<std::mutex> lk(g_leaf_mu);
+  auto it = g_leaf.find(h);
+  if (it == g_leaf.end()) return;
+  LeafData* d = it->second;
+  std::memcpy(x_out, d->x.data(), d->x.size() * sizeof(float));
+  std::memcpy(y_out, d->y.data(), d->y.size() * sizeof(long long));
+  std::memcpy(offsets_out, d->offsets.data(),
+              d->offsets.size() * sizeof(long long));
+}
+
+void fd_leaf_close(long long h) {
+  std::lock_guard<std::mutex> lk(g_leaf_mu);
+  auto it = g_leaf.find(h);
+  if (it != g_leaf.end()) {
+    delete it->second;
+    g_leaf.erase(it);
+  }
+}
+
+}  // extern "C"

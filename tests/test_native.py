@@ -1,0 +1,175 @@
+"""Native data-plane tests: C++ kernel parity vs the pure-Python paths.
+
+The loader parity tests are the important ones — both paths must produce
+bit-identical batches under the same np.random seed, so switching the native
+plane on/off can never change training results.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from commefficient_tpu import native
+from commefficient_tpu.data_utils import FedCIFAR10, FedLoader, PrefetchLoader
+from commefficient_tpu.data_utils.transforms import (
+    cifar10_test_transforms,
+    cifar10_train_transforms,
+)
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native lib unavailable (no g++?)")
+
+
+@pytest.fixture(scope="module")
+def cifar_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cifar_native")
+    os.environ["COMMEFFICIENT_SYNTHETIC_PER_CLASS"] = "20"
+    try:
+        FedCIFAR10(str(d), "CIFAR10", train=True)  # triggers prepare
+    finally:
+        del os.environ["COMMEFFICIENT_SYNTHETIC_PER_CLASS"]
+    return str(d)
+
+
+@needs_native
+class TestImageBatch:
+    def test_matches_numpy_reference(self):
+        rng = np.random.RandomState(0)
+        src = rng.randint(0, 256, (20, 32, 32, 3)).astype(np.uint8)
+        idx = np.array([3, 5, -1, 7], np.int64)
+        ch = np.array([0, 4, 2, 8], np.int32)
+        cw = np.array([8, 0, 3, 4], np.int32)
+        fl = np.array([1, 0, 1, 0], np.uint8)
+        mean = np.array([0.49, 0.48, 0.44], np.float32)
+        std = np.array([0.24, 0.24, 0.26], np.float32)
+        out = native.image_batch(src, idx, ch, cw, fl, 4, 32, mean, std)
+        ref = native._image_batch_np(src, idx, ch, cw, fl, 4, 32, mean, std)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+        assert np.all(out[2] == 0)  # idx −1 → zero slot
+
+    def test_matches_python_transform_stack(self):
+        """With replayed crop/flip params, the fused kernel equals the
+        Compose([to_float, RandomCrop, Flip, Normalize]) stack."""
+        rng = np.random.RandomState(1)
+        src = rng.randint(0, 256, (4, 32, 32, 3)).astype(np.uint8)
+        spec = cifar10_train_transforms.native_spec
+        np.random.seed(123)
+        expected = []
+        for i in range(4):
+            expected.append(cifar10_train_transforms(src[i]))
+        np.random.seed(123)
+        ch, cw, fl = [], [], []
+        for _ in range(4):
+            ch.append(np.random.randint(0, 9))
+            cw.append(np.random.randint(0, 9))
+            fl.append(np.random.rand() < 0.5)
+        out = native.image_batch(
+            src, np.arange(4, dtype=np.int64),
+            np.asarray(ch, np.int32), np.asarray(cw, np.int32),
+            np.asarray(fl, np.uint8), spec["pad"], spec["size"],
+            spec["mean"], spec["std"])
+        np.testing.assert_allclose(out, np.stack(expected), atol=1e-5)
+
+    def test_float_src_no_pad(self):
+        rng = np.random.RandomState(2)
+        src = rng.rand(6, 28, 28).astype(np.float32)
+        out = native.image_batch(src, np.array([1, 4], np.int64), None, None,
+                                 None, 0, 28, np.float32(0.5), np.float32(0.2))
+        ref = (src[[1, 4]][..., None] - 0.5) / 0.2
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+@needs_native
+class TestLeafParse:
+    def test_matches_json(self, tmp_path):
+        leaf = {
+            "users": ["u0", "u1"],
+            "num_samples": [2, 3],
+            "user_data": {
+                "u0": {"x": [[0.1] * 4, [0.2] * 4], "y": [1, 5]},
+                "u1": {"x": [[0.3] * 4, [0.4] * 4, [0.5] * 4], "y": [2, 0, 61]},
+            },
+        }
+        p = tmp_path / "shard.json"
+        p.write_text(json.dumps(leaf))
+        users, x, y, offsets = native.leaf_parse(str(p))
+        assert users == ["u0", "u1"]
+        assert offsets.tolist() == [0, 2, 5]
+        assert y.tolist() == [1, 5, 2, 0, 61]
+        np.testing.assert_allclose(x[3], 0.4, atol=1e-6)
+
+    def test_rejects_garbage(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text("{not json at all")
+        assert native.leaf_parse(str(p)) is None
+
+
+@needs_native
+class TestLoaderParity:
+    def test_train_batches_identical(self, cifar_dir):
+        def run(use_native):
+            np.random.seed(7)
+            ds = FedCIFAR10(cifar_dir, "CIFAR10", train=True, do_iid=True,
+                            num_clients=4, transform=cifar10_train_transforms,
+                            seed=3)
+            loader = FedLoader(ds, num_workers=2, local_batch_size=4,
+                               use_native=use_native)
+            np.random.seed(11)
+            return [next(iter(loader)) for _ in range(1)][0]
+
+        a = run(False)
+        b = run(True)
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_allclose(
+                np.asarray(a[k], np.float32), np.asarray(b[k], np.float32),
+                atol=1e-5, err_msg=k)
+
+    def test_val_batches_identical(self, cifar_dir):
+        def run(use_native):
+            ds = FedCIFAR10(cifar_dir, "CIFAR10", train=False,
+                            transform=cifar10_test_transforms)
+            loader = FedLoader(ds, val_batch_size=7, use_native=use_native)
+            return list(loader)
+
+        for a, b in zip(run(False), run(True)):
+            for k in a:
+                np.testing.assert_allclose(
+                    np.asarray(a[k], np.float32),
+                    np.asarray(b[k], np.float32), atol=1e-5, err_msg=k)
+
+    def test_prefetch_loader_same_batches(self, cifar_dir):
+        np.random.seed(5)
+        ds = FedCIFAR10(cifar_dir, "CIFAR10", train=False,
+                        transform=cifar10_test_transforms)
+        loader = FedLoader(ds, val_batch_size=16)
+        direct = list(loader)
+        prefetched = list(PrefetchLoader(loader, depth=2))
+        assert len(direct) == len(prefetched)
+        for a, b in zip(direct, prefetched):
+            np.testing.assert_array_equal(a["inputs"], b["inputs"])
+
+    def test_prefetch_early_exit_reaps_producer(self, cifar_dir):
+        import threading
+
+        ds = FedCIFAR10(cifar_dir, "CIFAR10", train=False,
+                        transform=cifar10_test_transforms)
+        loader = FedLoader(ds, val_batch_size=4)
+        before = threading.active_count()
+        for _ in PrefetchLoader(loader, depth=1):
+            break  # consumer stops early; producer must not leak
+        assert threading.active_count() <= before
+
+    def test_prefetch_propagates_errors(self):
+        class Boom:
+            def __iter__(self):
+                yield {"x": 1}
+                raise RuntimeError("boom")
+
+            def __len__(self):
+                return 2
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(PrefetchLoader(Boom()))
