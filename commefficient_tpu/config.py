@@ -44,6 +44,11 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--test", action="store_true", dest="do_test")
     parser.add_argument("--mode", choices=MODES, default="sketch")
     parser.add_argument("--tensorboard", dest="use_tensorboard", action="store_true")
+    # jax.profiler trace window (replaces the reference's commented cProfile
+    # scaffolding, fed_aggregator.py:32-52)
+    parser.add_argument("--profile", action="store_true", dest="do_profile")
+    parser.add_argument("--profile_dir", type=str, default="profiles")
+    parser.add_argument("--profile_steps", type=int, default=3)
     parser.add_argument("--seed", type=int, default=21)
 
     # data/model args

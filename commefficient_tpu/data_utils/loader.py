@@ -66,9 +66,9 @@ class FedLoader:
         self.train = dataset.type == "train"
         # cheap structural check first — native.available() may trigger the
         # one-time g++ build, pointless when the fast path can't apply
-        if use_native is None:
-            use_native = self._native_ok() and native.available()
-        self.use_native = bool(use_native) and self._native_ok()
+        ok = self._native_ok()
+        self.use_native = ok and (native.available() if use_native is None
+                                  else bool(use_native))
         if self.train:
             from commefficient_tpu.data_utils.fed_sampler import FedSampler
 
@@ -267,6 +267,8 @@ class PrefetchLoader:
         return len(self.loader)
 
     def __getattr__(self, name):
+        if name == "loader":  # unpickling: avoid infinite recursion
+            raise AttributeError(name)
         return getattr(self.loader, name)
 
     def __iter__(self):

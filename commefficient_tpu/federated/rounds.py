@@ -303,9 +303,19 @@ def build_round_step(
             jax.random.key(0), wcfg, sketch, compute_grad=False)
         return metrics
 
+    # Donation keeps the dominant state — the (num_clients, d) per-client
+    # velocity/error/weight arrays — in place across rounds instead of
+    # copying on every scatter-update. Only client_states (and ps_weights in
+    # the fused step) are donated: they are uniquely owned by the caller and
+    # rebound immediately. server_state / ctx are NOT donated — XLA may alias
+    # identical outputs (e.g. two all-zero state tensors) to one buffer, and
+    # donating two aliases of the same buffer is an execute-time error;
+    # ps_weights in server_step is also kept because the aggregator's
+    # download accounting holds references to past weight snapshots
+    # (fed_aggregator.py:178-194 semantics).
     return FederatedSteps(
-        train_step=jax.jit(train_step),
+        train_step=jax.jit(train_step, donate_argnums=(0, 2)),
         client_step=jax.jit(client_step),
-        server_step=jax.jit(server_step),
+        server_step=jax.jit(server_step, donate_argnums=(2,)),
         val_step=jax.jit(val_step),
     )

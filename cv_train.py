@@ -36,6 +36,7 @@ from commefficient_tpu.federated.checkpoint import (
     save_checkpoint,
 )
 from commefficient_tpu.federated.losses import make_cv_losses
+from commefficient_tpu.profiling import StepProfiler
 from commefficient_tpu.ops.flat import ravel_pytree
 from commefficient_tpu.utils import (
     PiecewiseLinear,
@@ -94,25 +95,32 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
     model.train(training)
     losses, accs = [], []
     if training:
+        prof = StepProfiler(args.profile_dir, num_steps=args.profile_steps,
+                            enabled=args.do_profile)
         num_clients = loader.dataset.num_clients
         client_download = np.zeros(num_clients)
         client_upload = np.zeros(num_clients)
         spe = loader.steps_per_epoch()
-        for i, batch in enumerate(loader):
-            if i > spe * epoch_fraction:
-                break
-            lr_scheduler.step()
-            loss, acc, download, upload = model(batch)
-            if np.any(np.isnan(loss)):
-                print(f"LOSS OF {np.mean(loss)} IS NAN, TERMINATING TRAINING")
-                return np.nan, np.nan, np.nan, np.nan
-            client_download += download
-            client_upload += upload
-            opt.step()
-            losses.extend(loss.tolist())
-            accs.extend(acc.tolist())
-            if args.do_test:
-                break
+        try:
+            for i, batch in enumerate(loader):
+                if i > spe * epoch_fraction:
+                    break
+                prof.step(i)
+                lr_scheduler.step()
+                loss, acc, download, upload = model(batch)
+                if np.any(np.isnan(loss)):
+                    print(f"LOSS OF {np.mean(loss)} IS NAN, "
+                          "TERMINATING TRAINING")
+                    return np.nan, np.nan, np.nan, np.nan
+                client_download += download
+                client_upload += upload
+                opt.step()
+                losses.extend(loss.tolist())
+                accs.extend(acc.tolist())
+                if args.do_test:
+                    break
+        finally:
+            prof.close()
         return (np.mean(losses), np.mean(accs), client_download,
                 client_upload)
     for batch in loader:

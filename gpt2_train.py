@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from commefficient_tpu.config import parse_args
 from commefficient_tpu.data_utils import FedLoader, PrefetchLoader
+from commefficient_tpu.profiling import StepProfiler
 from commefficient_tpu.data_utils.fed_persona import (
     FedPERSONA,
     make_personachat_collate_fn,
@@ -84,35 +85,42 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
                 epoch=None, epoch_fraction=1, logger=None, writer=None):
     model.train(training)
     if training:
+        prof = StepProfiler(args.profile_dir, num_steps=args.profile_steps,
+                            enabled=args.do_profile)
         spe = loader.steps_per_epoch()
         num_clients = loader.dataset.num_clients
         client_download = np.zeros(num_clients)
         client_upload = np.zeros(num_clients)
         losses = []
-        for batch_idx, batch in enumerate(loader):
-            if batch_idx > 2 and args.do_test and batch_idx < spe - 10:
-                continue
-            if batch_idx > spe * epoch_fraction:
-                break
-            lr_scheduler.step()
-            loss, download, upload = model(batch)
-            client_download += download
-            client_upload += upload
-            opt.step()
-            loss = float(np.mean(loss))
-            losses.append(loss)
-            train_time = timer()
-            batch_stats = {
-                "train_time": train_time,
-                "train_loss": loss,
-                "total_time": timer.total_time,
-                "down (MiB)": round(download.sum() / (1024 * 1024)),
-                "up (MiB)": round(upload.sum() / (1024 * 1024)),
-            }
-            lr = lr_scheduler.get_last_lr()[0]
-            if logger is not None:
-                logger.append(union({"batch_idx": batch_idx + 1, "lr": lr},
-                                    batch_stats))
+        try:
+            for batch_idx, batch in enumerate(loader):
+                if batch_idx > 2 and args.do_test and batch_idx < spe - 10:
+                    continue
+                if batch_idx > spe * epoch_fraction:
+                    break
+                prof.step(batch_idx)
+                lr_scheduler.step()
+                loss, download, upload = model(batch)
+                client_download += download
+                client_upload += upload
+                opt.step()
+                loss = float(np.mean(loss))
+                losses.append(loss)
+                train_time = timer()
+                batch_stats = {
+                    "train_time": train_time,
+                    "train_loss": loss,
+                    "total_time": timer.total_time,
+                    "down (MiB)": round(download.sum() / (1024 * 1024)),
+                    "up (MiB)": round(upload.sum() / (1024 * 1024)),
+                }
+                lr = lr_scheduler.get_last_lr()[0]
+                if logger is not None:
+                    logger.append(
+                        union({"batch_idx": batch_idx + 1, "lr": lr},
+                              batch_stats))
+        finally:
+            prof.close()
         return np.mean(losses), client_download, client_upload
 
     nlls, accs = [], []

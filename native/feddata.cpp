@@ -186,7 +186,9 @@ struct Parser {
           case 'b': c = '\b'; break;
           case 'f': c = '\f'; break;
           case 'u': {
-            // LEAF user names are hex-ish ASCII; decode BMP escapes naively
+            // decode ASCII escapes; reject non-ASCII code points so the
+            // caller falls back to the Python json parser (which handles
+            // full unicode) instead of silently corrupting usernames
             if (end - p < 4) { ok = false; return false; }
             int code = 0;
             for (int k = 0; k < 4; ++k) {
@@ -197,7 +199,8 @@ struct Parser {
               else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
               else { ok = false; return false; }
             }
-            c = (char)(code & 0x7f);
+            if (code > 0x7f) { ok = false; return false; }
+            c = (char)code;
             break;
           }
           default: c = e;

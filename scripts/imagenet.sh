@@ -1,0 +1,34 @@
+#!/bin/bash
+# ImageNet federated recipe — parity with the reference's only tuned config
+# (reference imagenet.sh:1-21): FixupResNet50, 7 workers / 7 clients iid,
+# uncompressed mode, virtual momentum 0.9, weight decay 1e-4, batch size 64
+# per client, 24 epochs with the LR peaking at epoch 5.
+#
+# The reference's 8-GPU split (7 workers + PS) becomes a single SPMD program
+# over however many TPU cores are attached; --num_workers is clients sampled
+# per round, exactly as in the reference CLI (utils.py:165-175).
+#
+# Usage: scripts/imagenet.sh <imagenet_dir> [extra flags...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+DATASET_DIR="${1:?usage: scripts/imagenet.sh <imagenet_dir> [extra flags]}"
+shift || true
+
+exec python cv_train.py \
+  --dataset_name ImageNet \
+  --dataset_dir "$DATASET_DIR" \
+  --model FixupResNet50 \
+  --mode uncompressed \
+  --error_type none \
+  --iid \
+  --num_clients 7 \
+  --num_workers 7 \
+  --local_batch_size 64 \
+  --valid_batch_size 64 \
+  --local_momentum 0 \
+  --virtual_momentum 0.9 \
+  --weight_decay 1e-4 \
+  --num_epochs 24 \
+  --pivot_epoch 5 \
+  --lr_scale 0.4 \
+  "$@"
