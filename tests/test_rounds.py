@@ -39,7 +39,7 @@ def _linear_loss(params, model_state, batch, rng, train):
 
 
 def _setup(mode="uncompressed", error_type="none", num_workers=8, k=2,
-           mesh=None, **kw):
+           mesh=None, virtual_momentum=0.0, **kw):
     params = {"w": jnp.zeros(D)}
     flat, unravel = ravel_pytree(params)
 
@@ -49,8 +49,7 @@ def _setup(mode="uncompressed", error_type="none", num_workers=8, k=2,
     wcfg = WorkerConfig(mode=mode, error_type=error_type, k=k,
                         num_workers=num_workers, **kw)
     scfg = ServerConfig(mode=mode, error_type=error_type, k=k, grad_size=D,
-                        virtual_momentum=kw.get("virtual_momentum", 0.0)
-                        if "virtual_momentum" in kw else 0.0,
+                        virtual_momentum=virtual_momentum,
                         local_momentum=kw.get("local_momentum", 0.0))
     sketch = make_sketch(D, 16, 3, seed=0, num_blocks=1) if mode == "sketch" \
         else None
@@ -111,6 +110,40 @@ class TestUncompressedGolden:
                                 jax.random.key(0))
         expected = -0.1 * _expected_sgd_grad(batch2)
         np.testing.assert_allclose(np.asarray(new_ps), expected, rtol=1e-5)
+
+
+class TestSketchGoldenTrajectory:
+    def test_three_rounds_match_numpy_fetchsgd(self):
+        """Multi-round FetchSGD golden trajectory (reference
+        unit_test.py:79-181 methodology, strengthened): with T == 1 the
+        chunked-cyclic sketch is bijective, so the sketch-space momentum /
+        error-feedback / masking algebra must match an exact dense numpy
+        simulation coordinate-for-coordinate."""
+        rho, k, lr = 0.9, 2, 0.1
+        flat, train_step, _, ss, cs = _setup(
+            mode="sketch", error_type="virtual", k=k, virtual_momentum=rho)
+        w = np.zeros(D)
+        vel = np.zeros(D)
+        err = np.zeros(D)
+        ps = flat
+        for rnd in range(3):
+            batch = _batch(seed=rnd)
+            ps, ss, cs, _, _ = train_step(ps, ss, cs, {}, batch, lr,
+                                          jax.random.key(rnd))
+            # dense FetchSGD simulation (server.py _sketched, exact sketch)
+            g = _expected_sgd_grad(batch, w)
+            vel = g + rho * vel
+            err = err + vel
+            order = np.argsort(-np.abs(err))[:k]
+            update = np.zeros(D)
+            update[order] = err[order]
+            w = w - lr * update
+            nz = update != 0
+            err[nz] = 0.0
+            vel[nz] = 0.0
+            np.testing.assert_allclose(np.asarray(ps), w, rtol=1e-4,
+                                       atol=1e-6,
+                                       err_msg=f"round {rnd}")
 
 
 class TestMeshParity:
