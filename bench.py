@@ -3,22 +3,37 @@
 Runs the fused federated train step (ResNet9, 8 simulated clients per round,
 count-sketch compression 5x500k/k=50k — the FetchSGD headline CIFAR10 config,
 reference utils.py:142-162) on synthetic CIFAR-shaped data and reports
-steady-state rounds/sec. Prints ONE JSON line:
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+steady-state rounds/sec. Prints ONE JSON line to stdout:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 ``vs_baseline`` is measured against BASELINE_ROUNDS_PER_SEC below — the
 reference publishes no numbers (BASELINE.md), so the constant encodes an
 A100-class estimate for the same config: 8 sequential ResNet9 fwd+bwd on
 batches of 8 plus CUDA CSVec sketching at ~180 ms/round ≈ 5.5 rounds/s.
+
+Robustness (round 1 died with rc=1 at TPU backend init and produced nothing):
+
+- the parent process never imports jax. It first runs a fail-fast backend
+  *probe* subprocess (default 120 s, ``BENCH_PROBE_TIMEOUT``); only if the
+  probe succeeds does it launch the measurement subprocess on the TPU
+  (``BENCH_RUN_TIMEOUT``, default 2400 s — first compile can be slow);
+- if the TPU probe or run fails, it falls back to a small-geometry CPU run in
+  a sanitized env (axon tunnel stripped) so a parseable JSON line with a real
+  rounds/sec number is always produced, annotated with the TPU failure;
+- if everything fails, it still prints a parseable JSON line with value 0 and
+  the error tail;
+- the measurement child logs timestamped progress to stderr (build, compile,
+  per-phase timings) and verifies the Pallas sketch kernel against the pure
+  XLA path before timing.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
-
-import numpy as np
 
 BASELINE_ROUNDS_PER_SEC = 5.5
 
@@ -27,10 +42,25 @@ LOCAL_BS = 8
 WARMUP = 3
 ITERS = 20
 
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
-def build():
+
+def _log(msg: str) -> None:
+    print(f"[bench +{time.monotonic() - _T0:8.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+_T0 = time.monotonic()
+
+
+# --------------------------------------------------------------------------
+# measurement child (--run [tiny])
+# --------------------------------------------------------------------------
+
+def build(tiny: bool):
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from commefficient_tpu import models
     from commefficient_tpu.federated.losses import make_cv_losses
@@ -47,24 +77,40 @@ def build():
     from commefficient_tpu.ops.flat import ravel_pytree
     from commefficient_tpu.ops.sketch import make_sketch
 
-    model = models.ResNet9()
+    if tiny:
+        # CPU-fallback geometry: same code path, small enough that a 1-core
+        # host produces a number in seconds. Clearly labeled in the output.
+        model = models.ResNet9(channels=(("prep", 8), ("layer1", 16),
+                                         ("layer2", 16), ("layer3", 32)))
+        k, c, r, blocks = 512, 8192, 3, 2
+    else:
+        model = models.ResNet9()
+        k, c, r, blocks = 50_000, 500_000, 5, 20
+
     x0 = jnp.zeros((1, 32, 32, 3), jnp.float32)
     params = model.init(jax.random.key(0), x0, train=False)["params"]
     flat, unravel = ravel_pytree(params)
     d = int(flat.size)
+    _log(f"model built: d={d}, sketch {r}x{c} k={k}")
 
     def ravel(tree):
         return ravel_pytree(tree)[0]
 
-    wcfg = WorkerConfig(mode="sketch", error_type="virtual", k=50_000,
+    wcfg = WorkerConfig(mode="sketch", error_type="virtual", k=k,
                         num_workers=NUM_WORKERS, weight_decay=5e-4)
-    scfg = ServerConfig(mode="sketch", error_type="virtual", k=50_000,
+    scfg = ServerConfig(mode="sketch", error_type="virtual", k=k,
                         grad_size=d, virtual_momentum=0.9)
-    sketch = make_sketch(d, c=500_000, r=5, seed=42, num_blocks=20)
+    sketch = make_sketch(d, c=c, r=r, seed=42, num_blocks=blocks)
     cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d)
     loss_train, loss_val = make_cv_losses(model)
+    # the entrypoints' real execution path: shard_map+psum over a clients
+    # mesh — a 1-device mesh on the single bench chip
+    from commefficient_tpu.parallel.mesh import default_client_mesh
+
+    mesh = default_client_mesh(NUM_WORKERS)
+    _log(f"mesh: {dict(mesh.shape)} over {mesh.devices.size} device(s)")
     steps = build_round_step(loss_train, loss_val, unravel, ravel, cfg,
-                             sketch=sketch, mesh=None)
+                             sketch=sketch, mesh=mesh)
 
     num_clients = 10
     server_state = init_server_state(scfg, sketch)
@@ -84,19 +130,54 @@ def build():
     return steps, flat, server_state, client_states, batch
 
 
-def main():
+def _check_pallas_kernel() -> None:
+    """On TPU, verify the fused Pallas sketch kernel against the pure XLA
+    path on a small geometry before trusting it in the timed loop."""
+    import jax
+    import numpy as np
+
+    if jax.default_backend() != "tpu":
+        _log("pallas check skipped (backend != tpu)")
+        return
+    import jax.numpy as jnp
+
+    from commefficient_tpu.ops.sketch import (
+        _sketch_vec_jax,
+        make_sketch,
+        sketch_vec,
+    )
+
+    cs = make_sketch(d=5000, c=512, r=3, seed=7, num_blocks=2)
+    v = jnp.asarray(np.random.RandomState(3).randn(5000), jnp.float32)
+    got = np.asarray(sketch_vec(cs, v))          # dispatches to Pallas on TPU
+    want = np.asarray(_sketch_vec_jax(cs, v))
+    err = float(np.abs(got - want).max())
+    if not np.allclose(got, want, atol=1e-4):
+        raise AssertionError(f"Pallas sketch kernel mismatch: max err {err}")
+    _log(f"pallas sketch kernel matches pure path (max err {err:.2e})")
+
+
+def run_measurement(tiny: bool) -> None:
+    _log(f"importing jax (platform pref: "
+         f"{os.environ.get('JAX_PLATFORMS', '<default>')})")
     import jax
 
-    steps, ps, server_state, client_states, batch = build()
+    _log(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
+    _check_pallas_kernel()
+
+    steps, ps, server_state, client_states, batch = build(tiny)
     rng = jax.random.key(0)
 
     state = (ps, server_state, client_states, {})
-    for _ in range(WARMUP):
+    _log("compiling + warmup (first jit of the round step is the slow part)")
+    for i in range(WARMUP):
         out = steps.train_step(state[0], state[1], state[2], state[3], batch,
                                0.1, rng)
         state = out[:4]
-    jax.block_until_ready(state[0])
+        jax.block_until_ready(state[0])
+        _log(f"warmup iter {i + 1}/{WARMUP} done")
 
+    _log(f"timing {ITERS} rounds")
     t0 = time.perf_counter()
     for _ in range(ITERS):
         out = steps.train_step(state[0], state[1], state[2], state[3], batch,
@@ -104,15 +185,108 @@ def main():
         state = out[:4]
     jax.block_until_ready(state[0])
     dt = time.perf_counter() - t0
+    _log(f"done: {dt:.3f}s for {ITERS} rounds")
 
     rounds_per_sec = ITERS / dt
+    geom = "tiny-fallback" if tiny else "ResNet9, 8 workers, sketch 5x500k k=50k"
     print(json.dumps({
-        "metric": "CIFAR10 fed rounds/sec/chip (ResNet9, 8 workers, sketch 5x500k k=50k)",
+        "metric": f"CIFAR10 fed rounds/sec/chip ({geom})",
         "value": round(rounds_per_sec, 4),
         "unit": "rounds/sec",
         "vs_baseline": round(rounds_per_sec / BASELINE_ROUNDS_PER_SEC, 4),
-    }))
+        "platform": jax.default_backend(),
+    }), flush=True)
+
+
+# --------------------------------------------------------------------------
+# parent orchestration
+# --------------------------------------------------------------------------
+
+def _cpu_env() -> dict:
+    from __graft_entry__ import sanitized_cpu_env
+
+    return sanitized_cpu_env()
+
+
+def _tpu_env() -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run_child(argv, env, timeout):
+    """Run a child, teeing stderr through, capturing the last stdout line."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + argv,
+            env=env, cwd=_REPO_DIR, stdout=subprocess.PIPE, stderr=None,
+            text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout}s"
+    if proc.returncode != 0:
+        return None, f"rc={proc.returncode}"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                pass
+    return None, "no JSON line in child stdout"
+
+
+def main() -> int:
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+    run_timeout = float(os.environ.get("BENCH_RUN_TIMEOUT", 2400))
+    cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", 1800))
+    tpu_error = None
+
+    _log(f"probing TPU backend (timeout {probe_timeout:.0f}s)")
+    probe = ("import jax, sys; d = jax.devices(); b = jax.default_backend(); "
+             "print('probe', b, d, file=sys.stderr); "
+             "assert b in ('tpu', 'axon'), f'backend is {b}, not a TPU'")
+    try:
+        p = subprocess.run([sys.executable, "-c", probe], env=_tpu_env(),
+                           cwd=_REPO_DIR, timeout=probe_timeout,
+                           capture_output=True, text=True)
+        if p.returncode != 0:
+            tpu_error = f"probe rc={p.returncode}: {p.stderr.strip()[-500:]}"
+    except subprocess.TimeoutExpired:
+        tpu_error = f"probe timeout after {probe_timeout:.0f}s (backend init hang)"
+
+    result = None
+    if tpu_error is None:
+        _log(f"TPU probe OK; running measurement (timeout {run_timeout:.0f}s)")
+        result, err = _run_child(["--run"], _tpu_env(), run_timeout)
+        if result is None:
+            tpu_error = f"tpu run failed: {err}"
+            _log(tpu_error)
+    else:
+        _log(f"TPU unavailable: {tpu_error}")
+
+    if result is None:
+        _log(f"falling back to CPU tiny geometry (timeout {cpu_timeout:.0f}s)")
+        result, err = _run_child(["--run", "tiny"], _cpu_env(), cpu_timeout)
+        if result is not None:
+            result["note"] = (f"TPU unavailable ({tpu_error}); CPU fallback "
+                              f"on reduced geometry — not comparable to the "
+                              f"A100 baseline")
+        else:
+            result = {
+                "metric": "CIFAR10 fed rounds/sec/chip (ResNet9, 8 workers, "
+                          "sketch 5x500k k=50k)",
+                "value": 0.0,
+                "unit": "rounds/sec",
+                "vs_baseline": 0.0,
+                "error": f"tpu: {tpu_error}; cpu fallback: {err}",
+            }
+
+    print(json.dumps(result), flush=True)
+    return 0
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--run":
+        run_measurement(tiny=(len(sys.argv) >= 3 and sys.argv[2] == "tiny"))
+        sys.exit(0)
     sys.exit(main())

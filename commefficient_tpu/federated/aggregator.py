@@ -53,6 +53,10 @@ from commefficient_tpu.federated.server import ServerConfig, init_server_state
 from commefficient_tpu.federated.worker import WorkerConfig
 from commefficient_tpu.ops.flat import ravel_pytree
 from commefficient_tpu.ops.sketch import make_sketch
+from commefficient_tpu.parallel.mesh import (
+    client_sharding,
+    default_client_mesh,
+)
 
 DEQUE_MAXLEN_MULT = 10  # Poisson-staleness argument, fed_aggregator.py:186-191
 
@@ -102,6 +106,12 @@ class FedModel:
                  init_params=None, model_state=None):
         self.model = model
         self.args = args
+        if mesh is None:
+            # entrypoint mesh policy: a `clients` mesh over --num_devices
+            # (replaces the reference's worker-process/GPU assignment,
+            # fed_aggregator.py:131-164)
+            mesh = default_client_mesh(args.num_workers,
+                                       getattr(args, "num_devices", -1))
         self.mesh = mesh
         self.training = True
 
@@ -144,8 +154,16 @@ class FedModel:
             compute_loss_train,
             compute_loss_val or compute_loss_train,
             self.unravel, ravel, cfg, sketch=self.sketch, mesh=mesh)
+        # per-client state is row-sharded over the clients mesh axis; rows are
+        # padded to a multiple of the mesh size so the sharding is even
+        # (padded rows are never indexed — client ids < num_clients)
+        n_shards = self.mesh.shape["clients"] if self.mesh is not None else 1
+        alloc_clients = -(-self.num_clients // n_shards) * n_shards
+        state_sharding = (client_sharding(self.mesh)
+                          if self.mesh is not None else None)
         self.client_states = init_client_states(
-            self.num_clients, self.grad_size, wcfg, init_weights=flat)
+            alloc_clients, self.grad_size, wcfg, init_weights=flat,
+            sketch=self.sketch, sharding=state_sharding)
 
         self._round_ctx = None
         self._rng = jax.random.key(args.seed + 1)

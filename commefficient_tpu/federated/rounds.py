@@ -60,16 +60,22 @@ from commefficient_tpu.federated.worker import (
     get_new_worker_weights,
     local_step,
 )
-from commefficient_tpu.ops.sketch import CountSketch
+from commefficient_tpu.ops.sketch import CountSketch, sketch_vec
 
 
 class ClientStates(NamedTuple):
     """Per-client persistent state; members are None when the config doesn't
     need them (matching the reference's conditional allocation,
-    fed_aggregator.py:105-129)."""
+    fed_aggregator.py:105-129).
 
-    velocities: Optional[jax.Array]  # (num_clients, d) iff local_momentum > 0
-    errors: Optional[jax.Array]      # (num_clients, d) iff error_type == local
+    For ``mode="sketch"`` the velocity/error state lives in **sketch space**:
+    ``(num_clients, r, c_pad)`` tables instead of ``(num_clients, d)`` dense
+    rows — the reference's allocation shape (fed_aggregator.py:116-120) and
+    *the* memory trick that makes EMNIST-scale per-client state feasible
+    (3500 clients × 6M dense floats ≈ 84 GB vs ≈35 GB sketched)."""
+
+    velocities: Optional[jax.Array]  # (num_clients, d) | (num_clients, r, c)
+    errors: Optional[jax.Array]      # (num_clients, d) | (num_clients, r, c)
     weights: Optional[jax.Array]     # (num_clients, d) iff do_topk_down
 
 
@@ -79,6 +85,7 @@ class RoundContext(NamedTuple):
 
     gradient: jax.Array
     ids: jax.Array
+    wmask: jax.Array  # (W,) 1 for participating slots, 0 for padding
     vel_rows: jax.Array
     err_rows: jax.Array
     stale_rows: jax.Array
@@ -88,13 +95,22 @@ class RoundContext(NamedTuple):
 
 def init_client_states(num_clients: int, grad_size: int, wcfg: WorkerConfig,
                        init_weights: Optional[jax.Array] = None,
-                       sharding=None) -> ClientStates:
+                       sharding=None,
+                       sketch: Optional[CountSketch] = None) -> ClientStates:
     def alloc(shape):
         z = jnp.zeros(shape, jnp.float32)
         return jax.device_put(z, sharding) if sharding is not None else z
 
-    velocities = alloc((num_clients, grad_size)) if wcfg.has_velocity else None
-    errors = alloc((num_clients, grad_size)) if wcfg.has_error else None
+    # sketch mode stores velocity/error per client as (r, c_pad) tables
+    # (reference fed_aggregator.py:116-120)
+    if wcfg.mode == "sketch" and (wcfg.has_velocity or wcfg.has_error):
+        assert sketch is not None, \
+            "sketch-mode client state needs the sketch geometry"
+        state_shape = (num_clients,) + sketch.table_shape
+    else:
+        state_shape = (num_clients, grad_size)
+    velocities = alloc(state_shape) if wcfg.has_velocity else None
+    errors = alloc(state_shape) if wcfg.has_error else None
     weights = None
     if wcfg.do_topk_down:
         assert init_weights is not None
@@ -237,8 +253,8 @@ def build_round_step(
         total_count = jnp.maximum(batch["mask"].sum(), 1.0)
         gradient = total / total_count
 
-        ctx = RoundContext(gradient, ids, vel_rows, err_rows, stale_rows,
-                           new_vel, new_err)
+        ctx = RoundContext(gradient, ids, worker_mask, vel_rows, err_rows,
+                           stale_rows, new_vel, new_err)
         return ctx, new_model_state, metrics
 
     # ---- phase 2: server update + state scatter ------------------------
@@ -267,13 +283,42 @@ def build_round_step(
             errors=scatter(client_states.errors, ctx.err_rows, ctx.new_err),
             weights=client_states.weights,
         )
+        # Masking below applies only to *participating* slots: padded slots
+        # carry a duplicate client id (the loader pads with id 0), so the
+        # update is written as a wmask-weighted delta-add — a padded slot
+        # contributes delta 0 and a real slot for the same id still lands
+        # its full masked value.
+        def masked_scatter(state_arr, keep):
+            """Zero the gathered rows' entries where ``keep`` is 0, for
+            participating slots only; scatter back duplicate-safely."""
+            rows = state_arr[ids]
+            w = ctx.wmask.reshape((-1,) + (1,) * (rows.ndim - 1))
+            delta = (rows * keep - rows) * w
+            return state_arr.at[ids].add(delta)
+
         # true_topk momentum factor masking of local velocities at the global
         # top-k coords (reference fed_aggregator.py:525-533)
         if (wcfg.mode == "true_topk" and wcfg.local_momentum > 0
                 and cs.velocities is not None):
-            nzmask = (update != 0)
-            rows = cs.velocities[ids] * (~nzmask)[None, :].astype(jnp.float32)
-            cs = cs._replace(velocities=cs.velocities.at[ids].set(rows))
+            keep = (update == 0).astype(jnp.float32)[None, :]
+            cs = cs._replace(velocities=masked_scatter(cs.velocities, keep))
+        # sketch mode: error feedback and momentum factor masking of the
+        # participating clients' *sketch-space* state tables at the nonzero
+        # cells of the re-sketched update — the sketch-space analogue of the
+        # server's own Verror/Vvelocity cell masking (reference
+        # fed_aggregator.py:592-611). The reference allocates table-shaped
+        # per-client state (fed_aggregator.py:116-120) but its worker asserts
+        # leave the path dead (fed_worker.py:228-236); this is the working
+        # completion of that design.
+        if (wcfg.mode == "sketch"
+                and (cs.velocities is not None or cs.errors is not None)):
+            cell_keep = (sketch_vec(sketch, update) == 0).astype(
+                jnp.float32)[None]
+            if cs.velocities is not None:
+                cs = cs._replace(
+                    velocities=masked_scatter(cs.velocities, cell_keep))
+            if cs.errors is not None:
+                cs = cs._replace(errors=masked_scatter(cs.errors, cell_keep))
         # topk-down: participating clients' stale weights advance to the
         # weights they actually used this round
         if wcfg.do_topk_down and cs.weights is not None:

@@ -17,8 +17,15 @@ Semantics preserved (reference anchors):
 - local_topk: transmit top-k, zero error and velocity at the transmitted
   coordinates (fed_worker.py:204-216);
 - sketch mode transmits the count-sketch table of the weighted gradient
-  (fed_worker.py:311-320) and never carries local error/velocity
-  (fed_worker.py:217-228);
+  (fed_worker.py:311-320). Local momentum and local error for sketch mode are
+  carried **in sketch space**: the client's velocity/error rows are
+  ``(r, c_pad)`` tables and the momentum/error recurrences below apply
+  unchanged (sketches are linear, so ``v = g + m·v`` and ``e += v`` commute
+  with sketching). This is the working completion of the reference's design —
+  it allocates table-shaped per-client state for exactly this
+  (fed_aggregator.py:116-120) but trailing asserts leave the path dead
+  (fed_worker.py:228-236); the matching server-side cell masking lives in
+  rounds.server_step;
 - DP: clip to ``l2_norm_clip`` then add N(0, noise_multiplier²)·√num_workers
   noise in worker mode (fed_worker.py:304-309);
 - ``max_grad_norm`` clipping, skipped in dense space for sketch mode where it

@@ -46,6 +46,13 @@ HBM. ``sketch_vec`` dispatches to it on TPU.
 
 All paths are jit/vmap/shard_map-safe: static shapes, no data-dependent
 control flow, chunk loop is a ``lax.scan``.
+
+Fidelity at FetchSGD scale (d≈6.5M, 5×500k, k=50k, power-law inputs) is
+measured in ``scripts/sketch_fidelity.py`` and recorded in
+``docs/sketch_fidelity.md``: top-k mass recall 1.0000 and relative L2 of the
+recovered update 0.0012 vs 0.0014 for an ideal fully-random-hash
+count-sketch — within noise of (marginally better than) 2-universal hashing,
+because within-chunk heavy-hitter pairs never collide.
 """
 
 from __future__ import annotations

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "make_mesh",
+    "default_client_mesh",
     "client_sharding",
     "replicated_sharding",
     "CLIENTS_AXIS",
@@ -44,6 +45,32 @@ __all__ = [
 
 CLIENTS_AXIS = "clients"
 SEQ_AXIS = "seq"
+
+
+def default_client_mesh(num_workers: int, num_devices: int = -1,
+                        devices=None) -> Mesh:
+    """The entrypoints' mesh policy (replaces the reference's device counting,
+    fed_aggregator.py:131-134): a 1-D ``clients`` mesh over
+    ``min(--num_devices, available)`` devices, reduced to the largest divisor
+    of ``num_workers`` so the round's client axis shards evenly. With
+    ``--num_devices -1`` (the default) every available device is used.
+
+    Always returns a mesh — a 1-device mesh keeps the shard_map/psum path
+    live even single-chip, so the code path benchmarked and the code path
+    tested are the same one.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    requested = num_devices if num_devices and num_devices > 0 \
+        else len(devices)
+    n = max(1, min(requested, len(devices)))
+    while num_workers % n:
+        n -= 1
+    if 0 < num_devices != n:
+        warnings.warn(
+            f"--num_devices {num_devices} reduced to {n} "
+            f"(must divide num_workers={num_workers} and be <= "
+            f"{len(devices)} available devices)", stacklevel=2)
+    return make_mesh([(CLIENTS_AXIS, n)], devices=devices[:n])
 
 
 def make_mesh(axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,

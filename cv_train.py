@@ -151,7 +151,7 @@ def train(model, opt, lr_scheduler, train_loader, test_loader, args, writer,
         train_loss, train_acc, download, upload = run_batches(
             model, opt, lr_scheduler, train_loader, True, epoch_fraction,
             args)
-        if train_loss is np.nan:
+        if np.isnan(train_loss):
             print("TERMINATING TRAINING DUE TO NAN LOSS")
             return
         train_time = timer()

