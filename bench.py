@@ -136,8 +136,11 @@ def _check_pallas_kernel() -> None:
     import jax
     import numpy as np
 
-    if jax.default_backend() != "tpu":
-        _log("pallas check skipped (backend != tpu)")
+    from commefficient_tpu.utils import is_tpu_backend
+
+    if not is_tpu_backend():
+        _log(f"pallas check skipped (backend {jax.default_backend()} "
+             "is not a TPU)")
         return
     import jax.numpy as jnp
 

@@ -53,10 +53,11 @@ from commefficient_tpu.federated.server import ServerConfig, init_server_state
 from commefficient_tpu.federated.worker import WorkerConfig
 from commefficient_tpu.ops.flat import ravel_pytree
 from commefficient_tpu.ops.sketch import make_sketch
-from commefficient_tpu.parallel.mesh import (
-    client_sharding,
-    default_client_mesh,
+from commefficient_tpu.federated.memory import (
+    client_state_sharding,
+    plan_client_state_memory,
 )
+from commefficient_tpu.parallel.mesh import default_client_mesh
 
 DEQUE_MAXLEN_MULT = 10  # Poisson-staleness argument, fed_aggregator.py:186-191
 
@@ -156,11 +157,18 @@ class FedModel:
             self.unravel, ravel, cfg, sketch=self.sketch, mesh=mesh)
         # per-client state is row-sharded over the clients mesh axis; rows are
         # padded to a multiple of the mesh size so the sharding is even
-        # (padded rows are never indexed — client ids < num_clients)
+        # (padded rows are never indexed — client ids < num_clients). When
+        # the sharded slice would not fit the per-device HBM budget the plan
+        # places the state in host memory (the reference's host-shared-memory
+        # design, fed_aggregator.py:105-129, but measured and opt-in).
         n_shards = self.mesh.shape["clients"] if self.mesh is not None else 1
         alloc_clients = -(-self.num_clients // n_shards) * n_shards
-        state_sharding = (client_sharding(self.mesh)
-                          if self.mesh is not None else None)
+        self.memory_plan = plan_client_state_memory(
+            alloc_clients, self.grad_size, wcfg, sketch=self.sketch,
+            mesh=self.mesh)
+        if self.memory_plan.total_bytes:
+            print(self.memory_plan.summary())
+        state_sharding = client_state_sharding(self.mesh, self.memory_plan)
         self.client_states = init_client_states(
             alloc_clients, self.grad_size, wcfg, init_weights=flat,
             sketch=self.sketch, sharding=state_sharding)

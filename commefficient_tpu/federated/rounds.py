@@ -202,14 +202,27 @@ def build_round_step(
             total = local_sum
         # model_state (e.g. BatchNorm stats): average over clients, weighted
         # by slot mask — a documented deviation; the reference lets each
-        # worker process's BN stats drift independently
-        wsum = jnp.maximum(worker_mask.sum(), 1.0)
-        new_ms = jax.tree_util.tree_map(
-            lambda x: jnp.einsum("c,c...->...", worker_mask, x) / wsum, new_ms)
+        # worker process's BN stats drift independently. A shard whose slots
+        # are all padding must contribute 0 to BOTH the numerator and the
+        # denominator of the cross-shard mean — clamping its weight to 1
+        # would shrink the averaged state every short round (BN running
+        # stats halve on an 8-of-16 round, exploding later eval losses).
+        wsum = worker_mask.sum()
+        local_mean = jax.tree_util.tree_map(
+            lambda x: jnp.einsum("c,c...->...", worker_mask, x)
+            / jnp.maximum(wsum, 1.0), new_ms)
         if mesh is not None:
-            denom = jax.lax.psum(wsum, axis)
+            total_w = jax.lax.psum(wsum, axis)
             new_ms = jax.tree_util.tree_map(
-                lambda x: jax.lax.psum(x * wsum, axis) / denom, new_ms)
+                lambda x: jax.lax.psum(x * wsum, axis)
+                / jnp.maximum(total_w, 1.0), local_mean)
+        else:
+            total_w = wsum
+            new_ms = local_mean
+        # an entirely-empty round keeps the old state rather than zeroing it
+        new_ms = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(total_w > 0, new, old),
+            new_ms, model_state)
         return total, new_vel, new_err, new_ms, metrics
 
     if mesh is not None:
@@ -271,54 +284,43 @@ def build_round_step(
 
         ids = ctx.ids
 
-        # scatter per-client state back via deltas (duplicate padded ids add 0)
-        def scatter(state_arr, old_rows, new_rows):
+        # Server-side masking of client state, fused into the scatter:
+        # - true_topk: momentum factor masking of local velocities at the
+        #   global top-k coords (reference fed_aggregator.py:525-533);
+        # - sketch: error feedback and momentum masking of the participating
+        #   clients' *sketch-space* state tables at the nonzero cells of the
+        #   re-sketched update — the sketch-space analogue of the server's
+        #   own Verror/Vvelocity cell masking (reference
+        #   fed_aggregator.py:592-611). The reference allocates table-shaped
+        #   per-client state (fed_aggregator.py:116-120) but its worker
+        #   asserts leave the path dead (fed_worker.py:228-236); this is the
+        #   working completion of that design.
+        keep_vel = keep_err = None
+        if wcfg.mode == "true_topk" and wcfg.local_momentum > 0:
+            keep_vel = (update == 0).astype(jnp.float32)[None, :]
+        elif wcfg.mode == "sketch" and (wcfg.has_velocity or wcfg.has_error):
+            cell_keep = (sketch_vec(sketch, update) == 0).astype(
+                jnp.float32)[None]
+            keep_vel = keep_err = cell_keep
+
+        # One delta-scatter per state array writes the masked new rows for
+        # *participating* slots only. Padded slots carry a duplicate client
+        # id (the loader pads with id 0) but have wmask 0, so they add delta
+        # 0 while a real slot for the same id still lands its full value.
+        def scatter(state_arr, old_rows, new_rows, keep):
             if state_arr is None:
                 return None
-            return state_arr.at[ids].add(new_rows - old_rows)
+            final = new_rows if keep is None else new_rows * keep
+            w = ctx.wmask.reshape((-1,) + (1,) * (old_rows.ndim - 1))
+            return state_arr.at[ids].add((final - old_rows) * w)
 
         cs = ClientStates(
             velocities=scatter(client_states.velocities, ctx.vel_rows,
-                               ctx.new_vel),
-            errors=scatter(client_states.errors, ctx.err_rows, ctx.new_err),
+                               ctx.new_vel, keep_vel),
+            errors=scatter(client_states.errors, ctx.err_rows, ctx.new_err,
+                           keep_err),
             weights=client_states.weights,
         )
-        # Masking below applies only to *participating* slots: padded slots
-        # carry a duplicate client id (the loader pads with id 0), so the
-        # update is written as a wmask-weighted delta-add — a padded slot
-        # contributes delta 0 and a real slot for the same id still lands
-        # its full masked value.
-        def masked_scatter(state_arr, keep):
-            """Zero the gathered rows' entries where ``keep`` is 0, for
-            participating slots only; scatter back duplicate-safely."""
-            rows = state_arr[ids]
-            w = ctx.wmask.reshape((-1,) + (1,) * (rows.ndim - 1))
-            delta = (rows * keep - rows) * w
-            return state_arr.at[ids].add(delta)
-
-        # true_topk momentum factor masking of local velocities at the global
-        # top-k coords (reference fed_aggregator.py:525-533)
-        if (wcfg.mode == "true_topk" and wcfg.local_momentum > 0
-                and cs.velocities is not None):
-            keep = (update == 0).astype(jnp.float32)[None, :]
-            cs = cs._replace(velocities=masked_scatter(cs.velocities, keep))
-        # sketch mode: error feedback and momentum factor masking of the
-        # participating clients' *sketch-space* state tables at the nonzero
-        # cells of the re-sketched update — the sketch-space analogue of the
-        # server's own Verror/Vvelocity cell masking (reference
-        # fed_aggregator.py:592-611). The reference allocates table-shaped
-        # per-client state (fed_aggregator.py:116-120) but its worker asserts
-        # leave the path dead (fed_worker.py:228-236); this is the working
-        # completion of that design.
-        if (wcfg.mode == "sketch"
-                and (cs.velocities is not None or cs.errors is not None)):
-            cell_keep = (sketch_vec(sketch, update) == 0).astype(
-                jnp.float32)[None]
-            if cs.velocities is not None:
-                cs = cs._replace(
-                    velocities=masked_scatter(cs.velocities, cell_keep))
-            if cs.errors is not None:
-                cs = cs._replace(errors=masked_scatter(cs.errors, cell_keep))
         # topk-down: participating clients' stale weights advance to the
         # weights they actually used this round
         if wcfg.do_topk_down and cs.weights is not None:

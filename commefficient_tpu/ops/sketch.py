@@ -271,7 +271,9 @@ def _sketch_vec_pallas(v3, shift_q, shift_w, sign_keys, *, S, T,
 
 
 def _use_pallas() -> bool:
-    return jax.default_backend() == "tpu"
+    from commefficient_tpu.utils import is_tpu_backend
+
+    return is_tpu_backend()
 
 
 def sketch_vec(cs: CountSketch, v: jax.Array) -> jax.Array:

@@ -25,7 +25,20 @@ __all__ = [
     "TSVLogger",
     "Timer",
     "make_logdir",
+    "is_tpu_backend",
 ]
+
+# JAX backend names that mean "a real TPU is attached". The axon platform is
+# a tunnel to a TPU chip and must be treated as TPU everywhere a decision
+# depends on it (Pallas dispatch, host-memory offload, bench probe) — gating
+# on "tpu" alone silently drops those paths on axon.
+TPU_BACKENDS = ("tpu", "axon")
+
+
+def is_tpu_backend() -> bool:
+    import jax
+
+    return jax.default_backend() in TPU_BACKENDS
 
 
 @dataclass(frozen=True)

@@ -95,6 +95,33 @@ class TestEndToEnd:
         assert np.isfinite(summary["train_loss"])
 
 
+class TestLearning:
+    """Training actually learns: test accuracy rises well above chance
+    (0.10) on the synthetic class-conditional data. Trajectories recorded in
+    docs/learning_curves.md."""
+
+    def test_batchnorm_uncompressed_learns_above_chance(self, tmp_path,
+                                                        monkeypatch):
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "100")
+        summary = cv_train.main([
+            "--dataset_name", "CIFAR10",
+            "--dataset_dir", str(tmp_path / "data"),
+            "--num_epochs", "6",
+            "--num_workers", "8", "--num_devices", "8",
+            "--local_batch_size", "16",
+            "--valid_batch_size", "50",
+            "--iid", "--num_clients", "16",
+            "--mode", "uncompressed", "--error_type", "none",
+            "--batchnorm", "--local_momentum", "0",
+            "--virtual_momentum", "0.9",
+            "--lr_scale", "0.1", "--pivot_epoch", "2",
+            "--seed", "0",
+        ])
+        assert summary["train_loss"] < 2.15, "train loss did not decrease"
+        assert summary["test_acc"] > 0.25, \
+            f"no learning: test_acc {summary['test_acc']} vs chance 0.10"
+
+
 class TestMeshWiring:
     """--num_devices flows from the CLI into a real clients mesh
     (VERDICT round 1: the flag was parsed and ignored)."""

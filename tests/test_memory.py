@@ -1,0 +1,99 @@
+"""Per-client state memory accounting (federated/memory.py) at the
+reference's EMNIST geometry: 3,500 clients (reference fed_aggregator.py:68-72)
+by ResNet9-scale grad_size."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from commefficient_tpu.federated.memory import (
+    client_state_sharding,
+    plan_client_state_memory,
+)
+from commefficient_tpu.federated.worker import WorkerConfig
+from commefficient_tpu.ops.sketch import make_sketch
+
+D = 6_568_640          # ResNet9-scale grad size
+EMNIST_CLIENTS = 3500
+GIB = 1024 ** 3
+
+
+class TestEmnistGeometry:
+    def test_dense_local_momentum_is_84gb(self):
+        wcfg = WorkerConfig(mode="uncompressed", local_momentum=0.9)
+        plan = plan_client_state_memory(EMNIST_CLIENTS, D, wcfg)
+        # 3500 x 6.5M x 4 B ≈ 85.6 GiB velocity, no error/stale
+        assert plan.error_bytes == 0 and plan.stale_weight_bytes == 0
+        assert plan.velocity_bytes == EMNIST_CLIENTS * D * 4
+        assert 80 * GIB < plan.total_bytes < 90 * GIB
+
+    def test_sketch_state_is_the_memory_trick(self):
+        """Sketch-space state (reference fed_aggregator.py:116-120) cuts the
+        EMNIST budget from ~86 GiB dense to ~33 GiB tables."""
+        wcfg = WorkerConfig(mode="sketch", error_type="local",
+                            local_momentum=0.9)
+        sketch = make_sketch(D, c=500_000, r=5, seed=0)
+        plan = plan_client_state_memory(EMNIST_CLIENTS, D, wcfg,
+                                        sketch=sketch)
+        row = 5 * sketch.c_pad * 4
+        assert plan.velocity_bytes == EMNIST_CLIENTS * row
+        assert plan.error_bytes == EMNIST_CLIENTS * row
+        dense = plan_client_state_memory(
+            EMNIST_CLIENTS, D,
+            WorkerConfig(mode="true_topk", error_type="local",
+                         local_momentum=0.9, k=1))
+        assert plan.total_bytes < 0.45 * dense.total_bytes
+
+    def test_topk_down_accounts_stale_weights(self):
+        wcfg = WorkerConfig(mode="true_topk", k=1, do_topk_down=True)
+        plan = plan_client_state_memory(EMNIST_CLIENTS, D, wcfg)
+        assert plan.stale_weight_bytes == EMNIST_CLIENTS * D * 4
+        assert plan.velocity_bytes == 0 and plan.error_bytes == 0
+
+    def test_no_state_modes_are_free(self):
+        wcfg = WorkerConfig(mode="sketch", error_type="virtual")
+        plan = plan_client_state_memory(EMNIST_CLIENTS, D, wcfg)
+        assert plan.total_bytes == 0
+
+
+class TestPlacement:
+    def _mesh(self, n):
+        return Mesh(np.array(jax.devices()[:n]), ("clients",))
+
+    def test_sharding_reduces_per_device(self):
+        wcfg = WorkerConfig(mode="uncompressed", local_momentum=0.9)
+        plan = plan_client_state_memory(EMNIST_CLIENTS + 4, D, wcfg,
+                                        mesh=self._mesh(8))
+        assert plan.num_shards == 8
+        assert plan.per_device_bytes == plan.total_bytes // 8
+
+    def test_placement_host_when_over_budget(self):
+        wcfg = WorkerConfig(mode="uncompressed", local_momentum=0.9)
+        plan = plan_client_state_memory(
+            EMNIST_CLIENTS, D, wcfg, mesh=self._mesh(8),
+            hbm_budget_bytes=8 * GIB)  # 86/8 ≈ 10.7 GiB/dev > 8 GiB
+        assert plan.placement == "host"
+
+    def test_placement_hbm_when_it_fits(self):
+        wcfg = WorkerConfig(mode="sketch", error_type="local")
+        sketch = make_sketch(D, c=500_000, r=5, seed=0)
+        plan = plan_client_state_memory(
+            EMNIST_CLIENTS + 4, D, wcfg, sketch=sketch, mesh=self._mesh(8),
+            hbm_budget_bytes=8 * GIB)  # 33/8 ≈ 4.1 GiB/dev < 8 GiB
+        assert plan.placement == "hbm"
+
+    def test_sharding_object_matches_plan(self):
+        wcfg = WorkerConfig(mode="sketch", error_type="local")
+        sketch = make_sketch(D, c=500_000, r=5, seed=0)
+        mesh = self._mesh(8)
+        plan = plan_client_state_memory(EMNIST_CLIENTS + 4, D, wcfg,
+                                        sketch=sketch, mesh=mesh,
+                                        hbm_budget_bytes=8 * GIB)
+        sh = client_state_sharding(mesh, plan)
+        assert sh is not None and sh.spec == jax.sharding.PartitionSpec(
+            "clients")
+        # host memory kinds only on TPU; on CPU it degrades to default
+        if jax.default_backend() != "tpu":
+            assert sh.memory_kind in (None, "unpinned_host", "device")

@@ -309,6 +309,30 @@ class TestPaddedSlotMasking:
         np.testing.assert_array_equal(np.asarray(cs1.velocities[0]),
                                       np.asarray(sentinel))
 
+    def test_model_state_not_shrunk_by_empty_shards(self):
+        """A round where entire shards are padding must not shrink the
+        averaged model_state (BatchNorm running stats): empty shards must
+        contribute 0 to numerator AND denominator of the cross-shard mean.
+        Regression: BN running stats halved on each short round, exploding
+        later eval losses."""
+        devices = np.array(jax.devices()[:8])
+        mesh = Mesh(devices, ("clients",))
+        flat, train_step, _, ss, cs = _setup(mesh=mesh)
+        batch = _batch()
+        wm = np.ones(8, np.float32)
+        wm[4:] = 0  # shards 4..7 entirely padding (1 slot per shard)
+        mask = np.asarray(batch["mask"]).copy()
+        mask[4:] = 0
+        batch = dict(batch, worker_mask=jnp.asarray(wm),
+                     mask=jnp.asarray(mask))
+        ms = {"stats": jnp.full((3,), 5.0)}
+        # _linear_loss passes model_state through unchanged, so the averaged
+        # state must come back exactly
+        _, _, _, ms1, _ = train_step(flat, ss, cs, ms, batch, 0.1,
+                                     jax.random.key(0))
+        np.testing.assert_allclose(np.asarray(ms1["stats"]),
+                                   np.full((3,), 5.0), rtol=1e-6)
+
     def test_true_topk_padding_preserves_client0(self):
         flat, train_step, _, ss, cs = _setup(mode="true_topk",
                                              error_type="virtual", k=2,
