@@ -51,7 +51,8 @@ def make_cv_losses(model, has_batch_stats: bool = False):
     return compute, compute
 
 
-def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
+def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
+                     seq_axis: str | None = None):
     """GPT-2 double-heads losses (reference gpt2_train.py:55-99).
 
     Train: ``lm_coef·lm_loss + mc_coef·mc_loss`` per example; no extra
@@ -62,21 +63,35 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
     tokens of the batch — identical when sequences have equal valid-token
     counts, and the per-example form is what masked client-weighted
     aggregation needs.
+
+    ``seq_axis``: sequence-parallel mode — logits/labels carry only the
+    local slice of the sequence (sharded over that mesh axis), the batch
+    must provide pre-shifted labels under ``"lm_labels_shifted"`` (the
+    shift crosses shard boundaries, so it happens host-side in the
+    collate), and per-example token sums/counts are psum'ed over the axis
+    so the loss value is replicated across seq shards.
     """
 
-    def _lm_nll_per_example(lm_logits, lm_labels):
-        # shift: predict token t+1 from position t (gpt2_train.py:63-67)
-        logits = lm_logits[..., :-1, :]
-        labels = lm_labels[..., 1:]
+    def _lm_nll_per_example(lm_logits, batch):
+        if seq_axis is not None:
+            logits = lm_logits
+            labels = batch["lm_labels_shifted"]
+        else:
+            # shift: predict token t+1 from position t (gpt2_train.py:63-67)
+            logits = lm_logits[..., :-1, :]
+            labels = batch["lm_labels"][..., 1:]
         valid = labels != -1
         safe = jnp.where(valid, labels, 0)
         logp = jax.nn.log_softmax(logits, axis=-1)
         tok_nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
         tok_nll = tok_nll * valid
         # sum over candidates & positions, normalize by valid token count
-        per_ex = tok_nll.sum(axis=(-2, -1)) / jnp.maximum(
-            valid.sum(axis=(-2, -1)), 1)
-        return per_ex
+        nll_sum = tok_nll.sum(axis=(-2, -1))
+        n_valid = valid.sum(axis=(-2, -1))
+        if seq_axis is not None:
+            nll_sum = jax.lax.psum(nll_sum, seq_axis)
+            n_valid = jax.lax.psum(n_valid, seq_axis)
+        return nll_sum / jnp.maximum(n_valid, 1)
 
     def _mc_ce_acc(mc_logits, mc_labels):
         logp = jax.nn.log_softmax(mc_logits, axis=-1)
@@ -90,7 +105,7 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
             token_type_ids=batch["token_type_ids"],
             mc_token_ids=batch["mc_token_ids"], train=train,
             rngs={"dropout": rng} if train else None)
-        lm_nll = _lm_nll_per_example(lm_logits, batch["lm_labels"])
+        lm_nll = _lm_nll_per_example(lm_logits, batch)
         mc_ce, _ = _mc_ce_acc(mc_logits, batch["mc_labels"])
         mask = batch["mask"]
         loss_sum = jnp.sum((lm_coef * lm_nll + mc_coef * mc_ce) * mask)
@@ -101,7 +116,7 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
             {"params": params}, batch["input_ids"],
             token_type_ids=batch["token_type_ids"],
             mc_token_ids=batch["mc_token_ids"], train=False)
-        lm_nll = _lm_nll_per_example(lm_logits, batch["lm_labels"])
+        lm_nll = _lm_nll_per_example(lm_logits, batch)
         _, acc = _mc_ce_acc(mc_logits, batch["mc_labels"])
         mask = batch["mask"]
         return (jnp.sum(lm_nll * mask), (jnp.sum(acc * mask),),

@@ -78,6 +78,13 @@ class WorkerConfig:
     fedavg_batch_size: int = -1
     fedavg_lr_decay: float = 1.0
     do_topk_down: bool = False
+    # Sequence-parallel mesh axis (long-context extension; no reference
+    # equivalent). When set, the round runs inside a shard_map whose mesh
+    # has this axis, activations are sequence-sharded, and forward_grad
+    # psums the dense gradient over it BEFORE any nonlinear transform
+    # (clip/DP/topk/sketch/momentum), so every compression mode sees the
+    # full gradient, replicated across seq shards.
+    seq_axis: Optional[str] = None
 
     @property
     def has_velocity(self) -> bool:
@@ -168,6 +175,10 @@ def forward_grad(compute_loss, params_flat, unravel, ravel, model_state,
     g_mean_tree, loss_mean, metric_means, count, new_state = _microbatch_grads(
         compute_loss, params, model_state, batch, rng, cfg)
     grad = ravel(g_mean_tree)
+    if cfg.seq_axis is not None:
+        # per-shard partial gradients (each shard backpropagated its local
+        # slice of the sequence) → full gradient, replicated over seq
+        grad = jax.lax.psum(grad, cfg.seq_axis)
     # weight decay (reference utils.py:254-259)
     if cfg.weight_decay != 0:
         grad = grad + (cfg.weight_decay / cfg.num_workers) * params_flat

@@ -50,6 +50,8 @@ class Block(nn.Module):
     n_embd: int
     n_head: int
     dropout: float
+    attn_impl: str = "dense"   # dense | ring | ulysses
+    seq_axis: str = "seq"
 
     @nn.compact
     def __call__(self, x, mask, deterministic: bool):
@@ -63,11 +65,25 @@ class Block(nn.Module):
             return t.reshape(B, T, self.n_head, C // self.n_head)
 
         q, k, v = heads(q), heads(k), heads(v)
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(C // self.n_head)
-        att = jnp.where(mask, att, jnp.finfo(att.dtype).min)
-        att = jax.nn.softmax(att, axis=-1)
-        att = nn.Dropout(self.dropout)(att, deterministic=deterministic)
-        out = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
+        if self.attn_impl == "dense":
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(
+                C // self.n_head)
+            att = jnp.where(mask, att, jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att, axis=-1)
+            att = nn.Dropout(self.dropout)(att, deterministic=deterministic)
+            out = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
+        else:
+            # sequence-parallel attention: T here is the LOCAL slice of the
+            # sequence, sharded over self.seq_axis; the primitives handle
+            # global causality. No attention-probs dropout on these paths
+            # (residual dropouts remain) — a documented deviation.
+            from commefficient_tpu.parallel.ring import ring_attention
+            from commefficient_tpu.parallel.ulysses import ulysses_attention
+
+            attn = {"ring": ring_attention,
+                    "ulysses": ulysses_attention}[self.attn_impl]
+            out = attn(q, k, v, axis_name=self.seq_axis,
+                       causal=True).reshape(B, T, C)
         out = nn.Dense(C, name="attn_proj",
                        kernel_init=nn.initializers.normal(0.02))(out)
         x = x + nn.Dropout(self.dropout)(out, deterministic=deterministic)
@@ -88,15 +104,26 @@ class GPT2DoubleHeads(nn.Module):
     n_layer: int = 12
     n_head: int = 12
     dropout: float = 0.1
+    # Sequence parallelism (no reference equivalent — SURVEY.md §5): with
+    # attn_impl "ring" or "ulysses" the module must be traced inside a
+    # shard_map whose mesh has `seq_axis`, with the sequence dimension of
+    # input_ids/token_type_ids sharded over it. Attention runs exactly over
+    # the global sequence (parallel/ring.py, parallel/ulysses.py); position
+    # embeddings are offset by the shard's global position; the MC head
+    # gathers the classification token's hidden state with a masked psum.
+    attn_impl: str = "dense"
+    seq_axis: str = "seq"
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, mc_token_ids=None,
                  train: bool = False):
         """input_ids: (..., T) int32; token_type_ids same shape;
-        mc_token_ids: (...,) index of the classification token per sequence.
+        mc_token_ids: (...,) index of the classification token per sequence
+        (a GLOBAL sequence position, also under sequence parallelism).
 
         Returns (lm_logits (..., T, vocab), mc_logits (...,)).
         """
+        sp = self.attn_impl != "dense"
         orig_shape = input_ids.shape
         T = orig_shape[-1]
         flat_ids = input_ids.reshape(-1, T)
@@ -108,14 +135,20 @@ class GPT2DoubleHeads(nn.Module):
         wpe = nn.Embed(self.n_positions, self.n_embd,
                        embedding_init=nn.initializers.normal(0.01),
                        name="wpe")
-        x = wte(flat_ids) + wpe(jnp.arange(T))[None]
+        if sp:
+            # global positions of this shard's sequence slice
+            pos0 = jax.lax.axis_index(self.seq_axis) * T
+        else:
+            pos0 = 0
+        x = wte(flat_ids) + wpe(pos0 + jnp.arange(T))[None]
         if token_type_ids is not None:
             x = x + wte(token_type_ids.reshape(-1, T))
         x = nn.Dropout(self.dropout)(x, deterministic=not train)
 
-        mask = jnp.tril(jnp.ones((T, T), bool))[None, None]
+        mask = None if sp else jnp.tril(jnp.ones((T, T), bool))[None, None]
         for i in range(self.n_layer):
             x = Block(self.n_embd, self.n_head, self.dropout,
+                      attn_impl=self.attn_impl, seq_axis=self.seq_axis,
                       name=f"h{i}")(x, mask, deterministic=not train)
         x = nn.LayerNorm(epsilon=1e-5, name="ln_f")(x)
 
@@ -124,7 +157,17 @@ class GPT2DoubleHeads(nn.Module):
         mc_logits = None
         if mc_token_ids is not None:
             flat_mc = mc_token_ids.reshape(-1)
-            cls_h = x[jnp.arange(B), flat_mc]  # (B, C)
+            if sp:
+                # the classification token lives in exactly one seq shard:
+                # mask-select locally, then psum the (B, C) hidden state
+                local_pos = flat_mc - pos0
+                in_range = (local_pos >= 0) & (local_pos < T)
+                safe = jnp.clip(local_pos, 0, T - 1)
+                picked = x[jnp.arange(B), safe]
+                picked = picked * in_range[:, None].astype(x.dtype)
+                cls_h = jax.lax.psum(picked, self.seq_axis)
+            else:
+                cls_h = x[jnp.arange(B), flat_mc]  # (B, C)
             # SequenceSummary head: linear to a single logit
             mc_logits = nn.Dense(1, name="mc_head",
                                  kernel_init=nn.initializers.normal(0.02))(

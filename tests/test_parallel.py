@@ -95,3 +95,54 @@ class TestUlyssesAttention:
         ref = dense_attention(q, k, v, causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
+
+
+class TestGPT2SeqParallel:
+    """GPT2DoubleHeads with attn_impl ring/ulysses inside a seq-sharded
+    shard_map must match the dense model logit-for-logit (same params)."""
+
+    def _models_and_data(self, attn_impl):
+        from commefficient_tpu.models.gpt2 import GPT2DoubleHeads
+
+        # n_head must be divisible by the seq-axis size for ulysses
+        V, T, E, L, H = 128, 32, 32, 2, max(N_SEQ, 4)
+        dense = GPT2DoubleHeads(vocab_size=V, n_positions=T, n_embd=E,
+                                n_layer=L, n_head=H, dropout=0.0)
+        sp = GPT2DoubleHeads(vocab_size=V, n_positions=T, n_embd=E,
+                             n_layer=L, n_head=H, dropout=0.0,
+                             attn_impl=attn_impl)
+        rng = np.random.RandomState(3)
+        ids = jnp.asarray(rng.randint(0, V, (2, 2, T)), jnp.int32)
+        tti = jnp.asarray(rng.randint(0, V, (2, 2, T)), jnp.int32)
+        mc = jnp.asarray(rng.randint(0, T, (2, 2)), jnp.int32)
+        params = dense.init(jax.random.key(0), ids, token_type_ids=tti,
+                            mc_token_ids=mc, train=False)["params"]
+        return dense, sp, params, ids, tti, mc
+
+    @pytest.mark.parametrize("attn_impl", ["ring", "ulysses"])
+    def test_logits_match_dense(self, mesh, attn_impl):
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        dense, sp, params, ids, tti, mc = self._models_and_data(attn_impl)
+        lm_ref, mc_ref = dense.apply({"params": params}, ids,
+                                     token_type_ids=tti, mc_token_ids=mc,
+                                     train=False)
+
+        seq = P(None, None, "seq")
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(seq, seq, P(None, None)),
+                 out_specs=(P(None, None, "seq", None), P(None, None)),
+                 check_vma=False)
+        def fwd(i, t, m):
+            return sp.apply({"params": params}, i, token_type_ids=t,
+                            mc_token_ids=m, train=False)
+
+        lm_sp, mc_sp = jax.jit(fwd)(ids, tti, mc)
+        np.testing.assert_allclose(np.asarray(lm_sp), np.asarray(lm_ref),
+                                   atol=3e-3, rtol=3e-3)
+        np.testing.assert_allclose(np.asarray(mc_sp), np.asarray(mc_ref),
+                                   atol=3e-3, rtol=3e-3)
