@@ -102,6 +102,18 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--iid", action="store_true", dest="do_iid")
     parser.add_argument("--train_dataloader_workers", type=int, default=0)
     parser.add_argument("--val_dataloader_workers", type=int, default=0)
+    # Sequence/context parallelism (TPU-first extension; the reference's only
+    # sequence-scaling lever is microbatching, SURVEY.md §5). The mesh gains a
+    # second `seq` axis of size --seq_devices; activations are sharded over it
+    # and attention runs exactly over the global sequence (parallel/ring.py,
+    # parallel/ulysses.py).
+    parser.add_argument("--seq_parallel", choices=["none", "ring", "ulysses"],
+                        default="none",
+                        help="Sequence-parallel attention over a `seq` mesh "
+                             "axis (GPT-2 only).")
+    parser.add_argument("--seq_devices", type=int, default=2,
+                        help="Size of the seq mesh axis when --seq_parallel "
+                             "is enabled.")
 
     # GPT2 args
     parser.add_argument("--model_checkpoint", type=str, default="gpt2")

@@ -278,10 +278,17 @@ class FedPERSONA(FedDataset):
         return os.path.join(self.dataset_dir, "validation.json")
 
 
-def make_personachat_collate_fn(max_seq_len: int, num_candidates: int):
+def make_personachat_collate_fn(max_seq_len: int, num_candidates: int,
+                                emit_shifted: bool = False):
     """Static-shape collate: (B, num_candidates, max_seq_len) padded arrays
     (the reference pads to the per-batch max, fed_persona.py:360-392; XLA
-    wants one fixed width)."""
+    wants one fixed width).
+
+    ``emit_shifted`` adds ``lm_labels_shifted`` — the next-token target
+    aligned with position t (``lm_labels[t+1]``, −1 at the final slot) —
+    which the sequence-parallel loss needs because the shift crosses seq-
+    shard boundaries, so it must happen host-side over the global sequence
+    (federated/losses.py seq_axis path)."""
 
     def collate(items):
         B = len(items)
@@ -302,13 +309,18 @@ def make_personachat_collate_fn(max_seq_len: int, num_candidates: int):
                 token_type_ids[b, c, :L] = tt[c][:T]
                 lm_labels[b, c, :L] = lm[c][:T]
                 mc_token_ids[b, c] = min(mc_tok[c], L - 1, T - 1)
-        return {
+        out = {
             "input_ids": input_ids,
             "mc_token_ids": mc_token_ids,
             "lm_labels": lm_labels,
             "mc_labels": mc_labels,
             "token_type_ids": token_type_ids,
         }
+        if emit_shifted:
+            shifted = np.full_like(lm_labels, -1)
+            shifted[..., :-1] = lm_labels[..., 1:]
+            out["lm_labels_shifted"] = shifted
+        return out
 
     return collate
 

@@ -83,6 +83,8 @@ def worker_config_from_args(args) -> WorkerConfig:
         fedavg_batch_size=args.fedavg_batch_size,
         fedavg_lr_decay=args.fedavg_lr_decay,
         do_topk_down=args.do_topk_down,
+        seq_axis=("seq" if getattr(args, "seq_parallel", "none") != "none"
+                  else None),
     )
 
 
@@ -110,9 +112,14 @@ class FedModel:
         if mesh is None:
             # entrypoint mesh policy: a `clients` mesh over --num_devices
             # (replaces the reference's worker-process/GPU assignment,
-            # fed_aggregator.py:131-164)
+            # fed_aggregator.py:131-164), plus a `seq` axis when sequence
+            # parallelism is requested
+            seq_devices = (getattr(args, "seq_devices", 1)
+                           if getattr(args, "seq_parallel", "none") != "none"
+                           else 1)
             mesh = default_client_mesh(args.num_workers,
-                                       getattr(args, "num_devices", -1))
+                                       getattr(args, "num_devices", -1),
+                                       seq_devices=seq_devices)
         self.mesh = mesh
         self.training = True
 

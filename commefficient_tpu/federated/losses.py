@@ -100,6 +100,10 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
         return ce, acc
 
     def compute_train(params, model_state, batch, rng, train):
+        if seq_axis is not None:
+            # distinct dropout masks per seq shard (the shard's activations
+            # are different positions of the same sequences)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(seq_axis))
         lm_logits, mc_logits = model.apply(
             {"params": params}, batch["input_ids"],
             token_type_ids=batch["token_type_ids"],

@@ -126,6 +126,11 @@ class RoundConfig:
     server: ServerConfig
     grad_size: int
     do_test: bool = False
+    # Batch keys whose LAST dimension is the (globally ordered) sequence,
+    # sharded over the worker's ``seq_axis`` when sequence parallelism is on.
+    # All other batch leaves are replicated across seq shards.
+    seq_sharded_keys: Tuple[str, ...] = ("input_ids", "token_type_ids",
+                                         "lm_labels_shifted")
 
 
 class FederatedSteps(NamedTuple):
@@ -225,18 +230,35 @@ def build_round_step(
             new_ms, model_state)
         return total, new_vel, new_err, new_ms, metrics
 
-    if mesh is not None:
+    seq_axis = wcfg.seq_axis
+    if mesh is not None and seq_axis is not None:
+        assert seq_axis in mesh.axis_names, \
+            f"seq_axis {seq_axis!r} not in mesh axes {mesh.axis_names}"
+
+    def _shard_clients(data_batch):
+        """shard_map wrapper built at trace time so the batch's sharding
+        specs can be per-leaf: every leaf is client-sharded on dim 0; leaves
+        named in cfg.seq_sharded_keys are additionally sequence-sharded on
+        their last dim when sequence parallelism is on."""
+        if mesh is None:
+            return clients_shard
         vec = P(axis)
         rep = P()
-        clients_sharded = shard_map(
+        if seq_axis is None:
+            bspec: Any = vec
+        else:
+            bspec = {
+                k: P(axis, *([None] * (v.ndim - 2)), seq_axis)
+                if k in cfg.seq_sharded_keys else vec
+                for k, v in data_batch.items()
+            }
+        return shard_map(
             clients_shard,
             mesh=mesh,
-            in_specs=(rep, vec, vec, vec, rep, vec, rep, vec, vec),
+            in_specs=(rep, vec, vec, vec, rep, bspec, rep, vec, vec),
             out_specs=(rep, vec, vec, rep, vec),
             check_vma=False,
         )
-    else:
-        clients_sharded = clients_shard
 
     def _maybe_rows(state_arr, ids, width):
         if state_arr is None:
@@ -258,7 +280,8 @@ def build_round_step(
         stale_rows = _maybe_rows(client_states.weights, ids, W)
         rngs = jax.random.split(rng, W)
 
-        total, new_vel, new_err, new_model_state, metrics = clients_sharded(
+        total, new_vel, new_err, new_model_state, metrics = _shard_clients(
+            data_batch)(
             ps_weights, vel_rows, err_rows, stale_rows,
             model_state, data_batch, lr, rngs, worker_mask)
 
@@ -345,10 +368,25 @@ def build_round_step(
         return new_ps, new_server_state, cs, new_model_state, metrics
 
     def val_step(ps_weights, model_state, batch):
-        _, metrics, _, _ = forward_grad(
-            compute_loss_val, ps_weights, unravel, ravel, model_state, batch,
-            jax.random.key(0), wcfg, sketch, compute_grad=False)
-        return metrics
+        def _val(w, ms, b):
+            _, metrics, _, _ = forward_grad(
+                compute_loss_val, w, unravel, ravel, ms, b,
+                jax.random.key(0), wcfg, sketch, compute_grad=False)
+            return metrics
+
+        if mesh is not None and seq_axis is not None:
+            # val batches are flat (no client axis); shard the sequence dim
+            # over the seq axis and replicate everything else. The loss psums
+            # its token sums over seq, so the metrics come back replicated.
+            bspec = {
+                k: P(*([None] * (v.ndim - 1)), seq_axis)
+                if k in cfg.seq_sharded_keys else P()
+                for k, v in batch.items()
+            }
+            sharded = shard_map(_val, mesh=mesh, in_specs=(P(), P(), bspec),
+                                out_specs=P(), check_vma=False)
+            return sharded(ps_weights, model_state, batch)
+        return _val(ps_weights, model_state, batch)
 
     # Donation keeps the dominant state — the (num_clients, d) per-client
     # velocity/error/weight arrays — in place across rounds instead of

@@ -267,6 +267,9 @@ def fedavg_local(compute_loss, params_flat, unravel, ravel, model_state,
 
         (loss_sum, (msums, count, new_ms)), g = jax.value_and_grad(
             loss_fn, has_aux=True)(p_flat, mstate)
+        if cfg.seq_axis is not None:
+            # each seq shard backpropagated its slice of the sequence
+            g = jax.lax.psum(g, cfg.seq_axis)
         return g, loss_sum, msums, count, new_ms
 
     probe = jax.eval_shape(

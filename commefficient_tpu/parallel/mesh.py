@@ -48,29 +48,43 @@ SEQ_AXIS = "seq"
 
 
 def default_client_mesh(num_workers: int, num_devices: int = -1,
-                        devices=None) -> Mesh:
+                        devices=None, seq_devices: int = 1) -> Mesh:
     """The entrypoints' mesh policy (replaces the reference's device counting,
     fed_aggregator.py:131-134): a 1-D ``clients`` mesh over
     ``min(--num_devices, available)`` devices, reduced to the largest divisor
     of ``num_workers`` so the round's client axis shards evenly. With
     ``--num_devices -1`` (the default) every available device is used.
 
+    ``seq_devices > 1`` appends a ``seq`` axis of that size (sequence
+    parallelism, ``--seq_parallel``): the ``clients`` axis then shrinks to fit
+    ``available // seq_devices`` devices. ``seq`` is the *minor* (fastest-
+    varying) axis so its ppermute/all-to-all traffic rides neighboring ICI
+    links.
+
     Always returns a mesh — a 1-device mesh keeps the shard_map/psum path
     live even single-chip, so the code path benchmarked and the code path
     tested are the same one.
     """
     devices = list(jax.devices()) if devices is None else list(devices)
+    n_avail = len(devices)
+    ns = max(1, min(seq_devices, n_avail))
+    if seq_devices > ns:
+        warnings.warn(f"--seq_devices {seq_devices} reduced to {ns} "
+                      f"(only {n_avail} devices available)", stacklevel=2)
     requested = num_devices if num_devices and num_devices > 0 \
-        else len(devices)
-    n = max(1, min(requested, len(devices)))
+        else n_avail
+    n = max(1, min(requested, n_avail // ns))
     while num_workers % n:
         n -= 1
-    if 0 < num_devices != n:
+    if 0 < num_devices != n and num_devices != n * ns:
         warnings.warn(
-            f"--num_devices {num_devices} reduced to {n} "
-            f"(must divide num_workers={num_workers} and be <= "
-            f"{len(devices)} available devices)", stacklevel=2)
-    return make_mesh([(CLIENTS_AXIS, n)], devices=devices[:n])
+            f"--num_devices {num_devices} reduced to {n} on the clients axis "
+            f"(must divide num_workers={num_workers}; {ns} seq device(s) per "
+            f"client shard; {n_avail} available devices)", stacklevel=2)
+    if ns == 1:
+        return make_mesh([(CLIENTS_AXIS, n)], devices=devices[:n])
+    return make_mesh([(CLIENTS_AXIS, n), (SEQ_AXIS, ns)],
+                     devices=devices[:n * ns])
 
 
 def make_mesh(axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,

@@ -46,7 +46,7 @@ from cv_train import union
 MAX_SEQ_LEN = int(os.environ.get("COMMEFFICIENT_GPT2_SEQ_LEN", 256))
 
 
-def get_data_loaders(args, tokenizer):
+def get_data_loaders(args, tokenizer, emit_shifted=False):
     train_dataset = FedPERSONA(
         tokenizer, args.num_candidates, args.max_history,
         args.personality_permutations,
@@ -62,13 +62,13 @@ def get_data_loaders(args, tokenizer):
     n_cand_val = max(args.num_candidates, 3)
     train_loader = FedLoader(
         train_dataset, args.num_workers, args.local_batch_size,
-        collate_fn=_wrap(make_personachat_collate_fn(MAX_SEQ_LEN,
-                                                     args.num_candidates)))
+        collate_fn=_wrap(make_personachat_collate_fn(
+            MAX_SEQ_LEN, args.num_candidates, emit_shifted=emit_shifted)))
     val_loader = FedLoader(
         val_dataset,
         val_batch_size=args.valid_batch_size * args.num_workers,
-        collate_fn=_wrap(make_personachat_collate_fn(MAX_SEQ_LEN,
-                                                     n_cand_val)))
+        collate_fn=_wrap(make_personachat_collate_fn(
+            MAX_SEQ_LEN, n_cand_val, emit_shifted=emit_shifted)))
     if args.train_dataloader_workers > 0:
         train_loader = PrefetchLoader(train_loader)
     if args.val_dataloader_workers > 0:
@@ -183,24 +183,37 @@ def train(argv=None):
     tokenizer.add_special_tokens(ATTR_TO_SPECIAL_TOKEN)
     args.len_tokenizer = len(tokenizer)
 
+    # sequence parallelism (--seq_parallel ring|ulysses): attention runs
+    # over the global sequence sharded across the mesh's `seq` axis
+    sp = args.seq_parallel != "none"
+    if sp:
+        assert MAX_SEQ_LEN % args.seq_devices == 0, \
+            f"seq len {MAX_SEQ_LEN} must divide by --seq_devices"
+    geometry = dict(attn_impl=args.seq_parallel) if sp else {}
+
     # model geometry: tiny when smoke-testing or using the byte fallback
     if args.do_test or os.environ.get("COMMEFFICIENT_TINY_MODEL"):
         model = GPT2DoubleHeads(vocab_size=max(512, args.len_tokenizer),
                                 n_positions=MAX_SEQ_LEN, n_embd=64,
-                                n_layer=2, n_head=2)
+                                n_layer=2, n_head=2, **geometry)
     else:
         model = GPT2DoubleHeads(vocab_size=max(50257 + 5,
                                                args.len_tokenizer),
-                                n_positions=1024)
+                                n_positions=1024, **geometry)
+    if sp and args.seq_parallel == "ulysses":
+        assert model.n_head % args.seq_devices == 0, \
+            "ulysses needs n_head divisible by --seq_devices"
 
     compute_loss_train, compute_loss_val = make_gpt2_losses(
-        model, args.lm_coef, args.mc_coef)
+        model, args.lm_coef, args.mc_coef,
+        seq_axis="seq" if sp else None)
 
     log_dir = make_logdir(args)
     os.makedirs(log_dir, exist_ok=True)
     tokenizer.save_pretrained(log_dir)
 
-    train_loader, val_loader = get_data_loaders(args, tokenizer)
+    train_loader, val_loader = get_data_loaders(args, tokenizer,
+                                                emit_shifted=sp)
 
     # try local pretrained weights (reference loads from the hub,
     # gpt2_train.py:262-273)
@@ -208,10 +221,13 @@ def train(argv=None):
         "input_ids": jnp.zeros((1, args.num_candidates, MAX_SEQ_LEN),
                                jnp.int32),
     }
-    variables = model.init(jax.random.key(args.seed), x0["input_ids"],
-                           token_type_ids=x0["input_ids"],
-                           mc_token_ids=jnp.zeros((1, args.num_candidates),
-                                                  jnp.int32), train=False)
+    # init with a dense-attention twin: same parameter structure, but usable
+    # outside shard_map (ring/ulysses need the `seq` axis bound)
+    init_model = model.copy(attn_impl="dense") if sp else model
+    variables = init_model.init(jax.random.key(args.seed), x0["input_ids"],
+                                token_type_ids=x0["input_ids"],
+                                mc_token_ids=jnp.zeros((1, args.num_candidates),
+                                                       jnp.int32), train=False)
     init_params = variables["params"]
     pretrained = load_hf_gpt2(init_params, args.model_checkpoint)
     if pretrained is not None:

@@ -150,3 +150,35 @@ class TestEndToEnd:
         ])
         assert np.isfinite(stats["val_nll"])
         assert np.isfinite(stats["val_ppl"])
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_gpt2_train_seq_parallel(self, tmp_path, impl):
+        """--seq_parallel runs the full train+val loop with the sequence dim
+        sharded over a 2-wide `seq` mesh axis (VERDICT item 10: the parallel/
+        toolkit must be invocable from the workload, not an island)."""
+        if len(jax.devices()) < 4:
+            pytest.skip("needs a 4-device mesh (2 clients x 2 seq)")
+        import gpt2_train
+
+        stats = gpt2_train.train(argv=[
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "1",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "sketch",
+            "--error_type", "virtual",
+            "--local_momentum", "0",
+            "--k", "64",
+            "--num_cols", "2048",
+            "--num_rows", "3",
+            "--num_blocks", "2",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+            "--seq_parallel", impl,
+            "--seq_devices", "2",
+        ])
+        assert np.isfinite(stats["val_nll"])
+        assert np.isfinite(stats["val_ppl"])
