@@ -303,12 +303,20 @@ def make_personachat_collate_fn(max_seq_len: int, num_candidates: int,
             n = min(len(ids), C)
             mc_labels[b] = min(mc_lab, C - 1)
             for c in range(n):
-                seq = ids[c][:T]
+                # left-truncate over-long sequences: the gold reply (the only
+                # positions with lm_labels != -1) and the classification
+                # token sit at the TAIL of build_input_from_segments output,
+                # so keeping the tail preserves the training signal (the
+                # reference never truncates — it pads to the per-batch max,
+                # fed_persona.py:360-392 — but static shapes force a cap
+                # here, and right-truncation silently dropped every label)
+                off = max(0, len(ids[c]) - T)
+                seq = ids[c][off:]
                 L = len(seq)
                 input_ids[b, c, :L] = seq
-                token_type_ids[b, c, :L] = tt[c][:T]
-                lm_labels[b, c, :L] = lm[c][:T]
-                mc_token_ids[b, c] = min(mc_tok[c], L - 1, T - 1)
+                token_type_ids[b, c, :L] = tt[c][off:]
+                lm_labels[b, c, :L] = lm[c][off:]
+                mc_token_ids[b, c] = min(max(mc_tok[c] - off, 0), L - 1, T - 1)
         out = {
             "input_ids": input_ids,
             "mc_token_ids": mc_token_ids,

@@ -119,6 +119,22 @@ class TestFedPERSONA:
         cid, *_ = val[0]
         assert cid == -1
 
+    def test_collate_left_truncates(self, tokenizer):
+        """Over-long sequences keep their tail: the gold reply's lm_labels
+        and the cls token survive truncation (right-truncation silently
+        dropped every label and val NLL degenerated to 0)."""
+        T = 16
+        ids = list(range(40))
+        tt = [7] * 40
+        lm = [-1] * 30 + list(range(30, 40))  # labels only on the tail
+        item = ([ids], [39], [lm], 0, [tt])
+        cols = make_personachat_collate_fn(T, 1)([item])
+        valid = cols["lm_labels"][0, 0] != -1
+        assert valid.sum() == 10
+        # the cls index points at the same token it did pre-truncation
+        mc = cols["mc_token_ids"][0, 0]
+        assert cols["input_ids"][0, 0, mc] == 39
+
     def test_collate_static_shapes(self, tmp_path, tokenizer):
         ds = FedPERSONA(tokenizer, 2, 2, 1, str(tmp_path), "PERSONA",
                         train=True, max_seq_len=64)
