@@ -39,7 +39,7 @@ double-counting bug, SURVEY.md §2.5).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dc_replace
 from functools import partial
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
@@ -152,6 +152,22 @@ def build_round_step(
 ) -> FederatedSteps:
     wcfg, scfg = cfg.worker, cfg.server
 
+    # Sketch-after-sum fusion: count-sketches are linear, so when nothing
+    # nonlinear touches the per-client table — no sketch-space client state
+    # (velocity/error), no sketch-space max_grad_norm clip — the sum of
+    # per-client sketches equals one sketch of the dense per-shard gradient
+    # sum. Workers then transmit dense gradients within the shard and the
+    # shard sketches once before the psum: identical result (up to float
+    # summation order), ~W× fewer sketch kernels per round. The transmitted
+    # quantity over the mesh is still the (r, c_pad) table, so the
+    # communication accounting and server math are untouched (reference
+    # upload semantics, fed_aggregator.py:291-299).
+    sketch_after_sum = (wcfg.mode == "sketch" and not wcfg.has_velocity
+                        and not wcfg.has_error
+                        and wcfg.max_grad_norm is None and not cfg.do_test)
+    inner_wcfg = (dc_replace(wcfg, mode="uncompressed") if sketch_after_sum
+                  else wcfg)
+
     def one_client(ps_weights, vel_row, err_row, stale_row, model_state,
                    batch_row, lr, rng, slot_mask):
         # choose weights (topk-down stale path, fed_worker.py:150-159)
@@ -178,7 +194,8 @@ def build_round_step(
         else:
             res, new_ms = local_step(compute_loss_train, weights_used,
                                      unravel, ravel, model_state, vel_row,
-                                     err_row, batch_row, rng, wcfg, sketch)
+                                     err_row, batch_row, rng, inner_wcfg,
+                                     sketch)
             transmit, new_vel, new_err, metrics = (res.transmit,
                                                    res.new_velocity,
                                                    res.new_error, res.metrics)
@@ -201,6 +218,11 @@ def build_round_step(
         )(vel_rows, err_rows, stale_rows, model_state, batch, lr, rng_keys,
           worker_mask)
         local_sum = jnp.sum(transmit, axis=0)
+        if sketch_after_sum:
+            # one sketch of the shard's dense gradient sum (see fusion note
+            # above); the psum then rides the small (r, c_pad) table exactly
+            # as the per-client path would
+            local_sum = sketch_vec(sketch, local_sum)
         if mesh is not None:
             total = jax.lax.psum(local_sum, axis)
         else:

@@ -32,6 +32,40 @@ class TestTopk:
         out = jax.jit(lambda x: topk(x, 3))(v)
         assert int(jnp.sum(out != 0)) == 3
 
+    def test_matches_sort_method(self):
+        rng = np.random.RandomState(7)
+        v = jnp.asarray(rng.randn(4096).astype(np.float32)
+                        * rng.rand(4096) ** 3)
+        np.testing.assert_array_equal(np.asarray(topk(v, 256)),
+                                      np.asarray(topk(v, 256, method="sort")))
+
+    def test_extreme_dynamic_range(self):
+        """Bit-space bisection stays exact when one outlier dwarfs the k-th
+        magnitude by far more than 2^16 (a float-valued bisection's absolute
+        precision would degenerate to keep-everything here)."""
+        rng = np.random.RandomState(3)
+        v = rng.randn(10_000).astype(np.float32) * 1e-6
+        v[42] = 1e20  # |v_max| / |v_k| ≈ 1e26
+        out = np.asarray(topk(jnp.asarray(v), 5))
+        assert (out != 0).sum() == 5
+        expected_idx = np.argsort(np.abs(v))[-5:]
+        assert set(np.flatnonzero(out)) == set(expected_idx)
+
+    def test_nan_propagates(self):
+        """A NaN coordinate must survive into the output (so the train
+        loop's NaN-abort sees it), without disabling the compression of the
+        finite coordinates."""
+        v = np.array([1.0, -5.0, np.nan, 3.0, -0.1, 0.2], np.float32)
+        out = np.asarray(topk(jnp.asarray(v), 2))
+        assert np.isnan(out[2])
+        finite = np.nan_to_num(out, nan=0.0)
+        assert set(np.flatnonzero(finite)) == {1, 3}
+
+    def test_fewer_nonzeros_than_k(self):
+        v = jnp.array([0.0, 2.0, 0.0, -1.0, 0.0])
+        out = topk(v, 4)
+        np.testing.assert_allclose(out, [0.0, 2.0, 0.0, -1.0, 0.0])
+
 
 class TestClip:
     def test_noop_inside_ball(self):

@@ -391,3 +391,52 @@ class TestValStep:
         loss, abs_err, count = metrics
         assert float(count) == 16
         assert np.isfinite(float(loss))
+
+
+class TestSketchAfterSumFusion:
+    """When no per-client sketch-space state exists, the round sketches the
+    dense per-shard gradient sum once instead of per client — by linearity
+    the resulting table must match the per-client-sketch sum exactly (up to
+    float summation order)."""
+
+    def test_matches_per_client_sketching(self):
+        from commefficient_tpu.federated.worker import (
+            WorkerConfig,
+            forward_grad,
+        )
+
+        params = {"w": jnp.zeros(D)}
+        flat, unravel = ravel_pytree(params)
+
+        def ravel(tree):
+            return ravel_pytree(tree)[0]
+
+        W = 4
+        wcfg = WorkerConfig(mode="sketch", error_type="virtual", k=2,
+                            num_workers=W)
+        scfg = ServerConfig(mode="sketch", error_type="virtual", k=2,
+                            grad_size=D, virtual_momentum=0.0)
+        sketch = make_sketch(D, 16, 3, seed=0, num_blocks=1)
+        cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=D)
+        steps = build_round_step(_linear_loss, _linear_loss, unravel, ravel,
+                                 cfg, sketch=sketch)
+        cs = init_client_states(16, D, wcfg, init_weights=flat, sketch=sketch)
+        batch = _batch(num_workers=W, bs=2)
+
+        ctx, _, _ = steps.client_step(flat, cs, {}, batch, 0.1,
+                                      jax.random.key(0))
+
+        # manual per-client sketch: table_c = sketch(grad_c * count_c)
+        from commefficient_tpu.ops.sketch import sketch_vec
+
+        total = jnp.zeros(sketch.table_shape)
+        for c in range(W):
+            row = {k: batch[k][c] for k in ("inputs", "targets", "mask")}
+            g, metrics, _, _ = forward_grad(
+                _linear_loss, flat, unravel, ravel, {}, row,
+                jax.random.key(0), wcfg, sketch)
+            total = total + g * metrics[-1]
+        expected = total / batch["mask"].sum()
+        np.testing.assert_allclose(np.asarray(ctx.gradient),
+                                   np.asarray(expected), rtol=1e-5,
+                                   atol=1e-6)
