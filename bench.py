@@ -136,11 +136,11 @@ def build(tiny: bool):
     return steps, flat, server_state, client_states, batch
 
 
-def build_gpt2():
+def build_gpt2(bf16: bool = False):
     """GPT-2 PersonaChat sketched federated round (BASELINE.md config 5):
     full 124M double-heads geometry, 4 clients/round, 2 candidates x 256
     tokens per example, sketch 5x500k/k=50k (reference gpt2_train.py:255-313
-    run shape)."""
+    run shape). ``bf16`` switches the fwd/bwd compute to bf16 (--bf16)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -182,7 +182,8 @@ def build_gpt2():
                         grad_size=d, virtual_momentum=0.9)
     sketch = make_sketch(d, c=c, r=r, seed=42, num_blocks=blocks)
     cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d)
-    loss_train, loss_val = make_gpt2_losses(model)
+    loss_train, loss_val = make_gpt2_losses(
+        model, compute_dtype=jnp.bfloat16 if bf16 else None)
     mesh = default_client_mesh(W)
     steps = build_round_step(loss_train, loss_val, unravel, ravel, cfg,
                              sketch=sketch, mesh=mesh)
@@ -232,17 +233,35 @@ def _time_rounds(steps, ps, server_state, client_states, batch, warmup,
 
 
 def run_gpt2_measurement() -> None:
-    """Child-process entry (--run-gpt2): prints its own JSON line."""
-    steps, ps, server_state, client_states, batch, tokens = build_gpt2()
-    n = 10
-    dt = _time_rounds(steps, ps, server_state, client_states, batch,
-                      warmup=2, iters=n, tag="gpt2")
-    print(json.dumps({
+    """Child-process entry (--run-gpt2): prints its own JSON line with the
+    f32 number (comparable to the reference's f32 training) and the bf16
+    number (--bf16 mixed precision, the TPU-native mode)."""
+    out = {
         "gpt2_metric": "GPT-2 PersonaChat tokens/sec/chip "
                        "(124M double-heads, 4 workers, sketch 5x500k k=50k)",
-        "gpt2_tokens_per_sec": round(tokens * n / dt, 1),
-        "gpt2_rounds_per_sec": round(n / dt, 3),
-    }), flush=True)
+    }
+    n = 10
+
+    def one_leg(bf16):
+        # loop-scoped so each leg's 124M-param state (weights, momentum and
+        # error tables, compiled executables) is dropped before the next
+        # leg builds — both legs live at once would ~double peak HBM
+        steps, ps, server_state, client_states, batch, tokens = \
+            build_gpt2(bf16=bf16)
+        tag = "gpt2-bf16" if bf16 else "gpt2-f32"
+        dt = _time_rounds(steps, ps, server_state, client_states, batch,
+                          warmup=2, iters=n, tag=tag)
+        return tokens, dt
+
+    for bf16 in (False, True):
+        tokens, dt = one_leg(bf16)
+        key = "gpt2_bf16" if bf16 else "gpt2"
+        out[f"{key}_tokens_per_sec"] = round(tokens * n / dt, 1)
+        out[f"{key}_rounds_per_sec"] = round(n / dt, 3)
+        # emit after each leg so a crash in the bf16 leg still leaves the
+        # f32 number on stdout (the parent salvages the last JSON line
+        # even from a failed child)
+        print(json.dumps(out), flush=True)
 
 
 def _check_pallas_kernel() -> None:
@@ -314,25 +333,39 @@ def _tpu_env() -> dict:
     return env
 
 
+def _last_json_line(text):
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    return None
+
+
 def _run_child(argv, env, timeout):
-    """Run a child, teeing stderr through, capturing the last stdout line."""
+    """Run a child, teeing stderr through, capturing the last stdout JSON
+    line. A crash or timeout AFTER the child printed a JSON line still
+    salvages that line (children emit incrementally for exactly this), with
+    the failure noted alongside."""
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)] + argv,
             env=env, cwd=_REPO_DIR, stdout=subprocess.PIPE, stderr=None,
             text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        return None, f"timeout after {timeout}s"
-    if proc.returncode != 0:
-        return None, f"rc={proc.returncode}"
-    for line in reversed(proc.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except json.JSONDecodeError:
-                pass
-    return None, "no JSON line in child stdout"
+        out, failure = proc.stdout, (None if proc.returncode == 0
+                                     else f"rc={proc.returncode}")
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode(errors="replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        failure = f"timeout after {timeout}s"
+    result = _last_json_line(out)
+    if result is None:
+        return None, failure or "no JSON line in child stdout"
+    if failure is not None:
+        result["partial"] = failure
+    return result, None
 
 
 def main() -> int:

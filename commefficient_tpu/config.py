@@ -42,6 +42,10 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
 
     # meta-args
     parser.add_argument("--test", action="store_true", dest="do_test")
+    # TPU mixed precision (no reference equivalent — the reference trains
+    # f32): bf16 forward/backward on the MXU, f32 master weights and
+    # compression/server math (federated/losses.py compute_dtype).
+    parser.add_argument("--bf16", action="store_true", dest="do_bf16")
     parser.add_argument("--mode", choices=MODES, default="sketch")
     parser.add_argument("--tensorboard", dest="use_tensorboard", action="store_true")
     # jax.profiler trace window (replaces the reference's commented cProfile

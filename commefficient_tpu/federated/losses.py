@@ -11,25 +11,49 @@ the mixup variant exists in the reference but is dead code
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import optax
 
 
-def make_cv_losses(model, has_batch_stats: bool = False):
+def _cast_tree(tree, dtype):
+    """Cast float32 leaves to the compute dtype (ints/keys untouched)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if x.dtype == jnp.float32 else x, tree)
+
+
+def _f32_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.float32)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+def make_cv_losses(model, has_batch_stats: bool = False,
+                   compute_dtype: Optional[Any] = None):
     """Returns (compute_loss_train, compute_loss_val) for an image classifier
-    flax module called as ``model.apply(vars, x, train=...)``."""
+    flax module called as ``model.apply(vars, x, train=...)``.
+
+    ``compute_dtype=jnp.bfloat16`` runs the forward/backward in bf16 on the
+    MXU (TPU mixed precision, ``--bf16``): params and inputs are cast going
+    in, logits come back to f32 before the softmax/CE, gradients flow back
+    through the casts and emerge f32 — master weights, compression, and all
+    server math stay f32. BatchNorm running stats are re-cast to f32 so the
+    carried model_state keeps a stable dtype across rounds.
+    """
 
     def _apply(params, model_state, x, train):
+        if compute_dtype is not None:
+            params = _cast_tree(params, compute_dtype)
+            x = x.astype(compute_dtype)
         variables = {"params": params}
         if has_batch_stats:
             variables["batch_stats"] = model_state
             if train:
                 logits, updates = model.apply(variables, x, train=True,
                                               mutable=["batch_stats"])
-                return logits, updates["batch_stats"]
+                return logits, _f32_tree(updates["batch_stats"])
             logits = model.apply(variables, x, train=False)
             return logits, model_state
         logits = model.apply(variables, x, train=train)
@@ -40,6 +64,7 @@ def make_cv_losses(model, has_batch_stats: bool = False):
         y = batch["targets"]
         mask = batch["mask"]
         logits, new_state = _apply(params, model_state, x, train)
+        logits = logits.astype(jnp.float32)
         losses = optax.softmax_cross_entropy_with_integer_labels(
             logits, y.astype(jnp.int32))
         correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
@@ -52,7 +77,8 @@ def make_cv_losses(model, has_batch_stats: bool = False):
 
 
 def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
-                     seq_axis: str | None = None):
+                     seq_axis: str | None = None,
+                     compute_dtype: Optional[Any] = None):
     """GPT-2 double-heads losses (reference gpt2_train.py:55-99).
 
     Train: ``lm_coef·lm_loss + mc_coef·mc_loss`` per example; no extra
@@ -104,11 +130,15 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
             # distinct dropout masks per seq shard (the shard's activations
             # are different positions of the same sequences)
             rng = jax.random.fold_in(rng, jax.lax.axis_index(seq_axis))
+        if compute_dtype is not None:
+            params = _cast_tree(params, compute_dtype)
         lm_logits, mc_logits = model.apply(
             {"params": params}, batch["input_ids"],
             token_type_ids=batch["token_type_ids"],
             mc_token_ids=batch["mc_token_ids"], train=train,
             rngs={"dropout": rng} if train else None)
+        lm_logits = lm_logits.astype(jnp.float32)
+        mc_logits = mc_logits.astype(jnp.float32)
         lm_nll = _lm_nll_per_example(lm_logits, batch)
         mc_ce, _ = _mc_ce_acc(mc_logits, batch["mc_labels"])
         mask = batch["mask"]
@@ -116,10 +146,14 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
         return loss_sum, (), jnp.sum(mask), model_state
 
     def compute_val(params, model_state, batch, rng, train):
+        if compute_dtype is not None:
+            params = _cast_tree(params, compute_dtype)
         lm_logits, mc_logits = model.apply(
             {"params": params}, batch["input_ids"],
             token_type_ids=batch["token_type_ids"],
             mc_token_ids=batch["mc_token_ids"], train=False)
+        lm_logits = lm_logits.astype(jnp.float32)
+        mc_logits = mc_logits.astype(jnp.float32)
         lm_nll = _lm_nll_per_example(lm_logits, batch)
         _, acc = _mc_ce_acc(mc_logits, batch["mc_labels"])
         mask = batch["mask"]

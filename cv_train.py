@@ -281,7 +281,8 @@ def main(argv=None):
 
     has_bn = args.do_batchnorm and hasattr(model, "do_batchnorm")
     compute_loss_train, compute_loss_val = make_cv_losses(
-        model, has_batch_stats=has_bn)
+        model, has_batch_stats=has_bn,
+        compute_dtype=jnp.bfloat16 if args.do_bf16 else None)
 
     init_params = None
     model_state = None

@@ -48,6 +48,14 @@ class TestEndToEnd:
             "--num_blocks", "2"])
         assert np.isfinite(summary["train_loss"])
 
+    def test_bf16_e2e(self, tmp_path, monkeypatch):
+        """--bf16 mixed precision: bf16 fwd/bwd, f32 master weights and
+        compression — the round must run and produce a finite f32 loss."""
+        summary = _run(tmp_path, monkeypatch, ["--mode", "uncompressed",
+                                  "--local_momentum", "0", "--bf16"])
+        assert np.isfinite(summary["train_loss"])
+        assert np.isfinite(summary["test_acc"])
+
     def test_true_topk_e2e(self, tmp_path, monkeypatch):
         summary = _run(tmp_path, monkeypatch, ["--mode", "true_topk", "--error_type",
                                   "virtual", "--local_momentum", "0",
