@@ -35,8 +35,11 @@ def _run(tmp_path, monkeypatch, extra):
 
 class TestEndToEnd:
     def test_uncompressed_round_runs_and_learns_something(self, tmp_path, monkeypatch):
+        # --eval_before_start exercises the epoch-0 val pass the reference
+        # crashes on (reference cv_train.py:92-95 arity bug, SURVEY.md §2.5)
         summary = _run(tmp_path, monkeypatch, ["--mode", "uncompressed",
-                                  "--local_momentum", "0"])
+                                  "--local_momentum", "0",
+                                  "--eval_before_start"])
         assert np.isfinite(summary["train_loss"])
         assert np.isfinite(summary["test_acc"])
 
