@@ -2,6 +2,7 @@
 equivalent of the reference's ``--test`` smoke runs (SURVEY.md §4)."""
 
 import os
+import re
 import sys
 
 import numpy as np
@@ -14,22 +15,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import cv_train  # noqa: E402
 
 
-def _run(tmp_path, monkeypatch, extra):
+def _run(tmp_path, monkeypatch, extra, dataset="CIFAR10", subdir="data",
+         iid=True, per_class="24"):
     # set at call time, not import time — see comment in test_data.py
-    monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "24")
+    monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_PER_CLASS", per_class)
     argv = [
-        "--dataset_name", "CIFAR10",
-        "--dataset_dir", str(tmp_path / "data"),
+        "--dataset_name", dataset,
+        "--dataset_dir", str(tmp_path / subdir),
         "--num_epochs", "1",
         "--num_workers", "2",
         "--local_batch_size", "4",
         "--valid_batch_size", "8",
-        "--iid",
-        "--num_clients", "4",
         "--lr_scale", "0.01",
         "--pivot_epoch", "0.5",
         "--seed", "0",
-    ] + extra
+    ] + (["--iid", "--num_clients", "4"] if iid else []) + extra
     return cv_train.main(argv)
 
 
@@ -177,3 +177,37 @@ class TestMeshWiring:
             "--num_devices", "8"])
         assert np.isfinite(summary["train_loss"])
         assert seen["mesh"].shape["clients"] == 2
+
+
+class TestMoreWorkloads:
+    def test_emnist_e2e(self, tmp_path, monkeypatch):
+        """FEMNIST natural-client path through the real entrypoint: LEAF-
+        shaped synthetic data, 1-channel stem, non-iid clients (reference
+        cv_train.py:353-354 EMNIST specifics)."""
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "6")
+        summary = _run(tmp_path, monkeypatch,
+                       ["--mode", "uncompressed", "--local_momentum", "0"],
+                       dataset="EMNIST", subdir="emnist", iid=False)
+        assert np.isfinite(summary["train_loss"])
+        assert np.isfinite(summary["test_acc"])
+
+    def test_checkpoint_then_finetune_cycle(self, tmp_path, monkeypatch,
+                                            capsys):
+        """--checkpoint saves, --finetune loads the backbone with a fresh
+        head and freezes all but the head via zero-LR groups (reference
+        cv_train.py:377-384, 418-421). Asserts tensors were actually loaded
+        — load_matching silently degrades to 0 on key drift."""
+        ckpt = str(tmp_path / "ckpt")
+        _run(tmp_path, monkeypatch, [
+            "--mode", "uncompressed", "--local_momentum", "0",
+            "--checkpoint", "--checkpoint_path", ckpt])
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "uncompressed", "--local_momentum", "0",
+            "--finetune", "--finetuned_from", "CIFAR10",
+            "--finetune_path", ckpt,
+        ], dataset="CIFAR100", subdir="c100", per_class="4")
+        assert np.isfinite(summary["train_loss"])
+        m = re.search(r"finetune: loaded (\d+) tensors",
+                      capsys.readouterr().out)
+        assert m and int(m.group(1)) > 0, \
+            "finetune silently loaded 0 checkpoint tensors"
