@@ -41,8 +41,9 @@ def _list_tree(split_dir):
 
 def _make_synthetic_tree(root, seed=0):
     n_clients = int(os.environ.get("COMMEFFICIENT_SYNTHETIC_CLIENTS", 16))
+    per_train = int(os.environ.get("COMMEFFICIENT_SYNTHETIC_PER_CLASS", 8))
     rng = np.random.RandomState(seed)
-    for split, per in (("train", 8), ("val", 2)):
+    for split, per in (("train", per_train), ("val", max(1, per_train // 4))):
         for c in range(n_clients):
             d = os.path.join(root, split, f"synthwnid{c:04d}")
             os.makedirs(d, exist_ok=True)
@@ -67,10 +68,16 @@ class FedImageNet(FedDataset):
         self.val_samples = _list_tree(os.path.join(self.dataset_dir, "val"))
 
     def prepare_datasets(self, download=False):
-        if download:
-            raise RuntimeError("Can't download ImageNet, sry")
         samples = _list_tree(os.path.join(self.dataset_dir, "train"))
         if not samples:
+            # the reference raises "Can't download ImageNet, sry" here
+            # (reference fed_imagenet.py prepare path) and requires a
+            # pre-extracted tree; with zero egress we fall through to the
+            # synthetic wnid tree like every other dataset shim in this
+            # repo so the plumbing stays runnable end to end
+            print("FedImageNet: no image tree under "
+                  f"{self.dataset_dir}/train — generating a synthetic one "
+                  "(real runs need pre-extracted ImageNet)")
             _make_synthetic_tree(self.dataset_dir)
             samples = _list_tree(os.path.join(self.dataset_dir, "train"))
         images_per_client = []

@@ -191,6 +191,24 @@ class TestMoreWorkloads:
         assert np.isfinite(summary["train_loss"])
         assert np.isfinite(summary["test_acc"])
 
+    def test_imagenet_e2e(self, tmp_path, monkeypatch):
+        """ImageNet plumbing through the real entrypoint: wnid-per-client
+        synthetic tree, 224x224 decode path, uncompressed round (reference
+        imagenet.sh run shape at toy scale)."""
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "4")
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "8")
+        summary = cv_train.main([
+            "--dataset_name", "ImageNet",
+            "--dataset_dir", str(tmp_path / "imagenet"),
+            "--num_epochs", "0.25",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "4",
+            "--mode", "uncompressed", "--local_momentum", "0",
+            "--lr_scale", "0.01", "--pivot_epoch", "0.1", "--seed", "0",
+        ])
+        assert np.isfinite(summary["train_loss"])
+
     def test_checkpoint_then_finetune_cycle(self, tmp_path, monkeypatch,
                                             capsys):
         """--checkpoint saves, --finetune loads the backbone with a fresh
