@@ -8,16 +8,17 @@ TPU-first design: ``jax.lax.top_k`` at FetchSGD scale (k=50k over d≈6.5M) is
 a full sort — ~15 ms/call on a v5e chip and the single hottest op of the
 whole federated round (it sits inside ``unsketch`` on the server). Since the
 callers only ever need the *dense masked* result (never the index list), the
-selection reduces to finding the k-th magnitude as a scalar threshold, which
-bisection finds exactly with ~31 fused full-vector reductions (~1-2 ms):
+selection reduces to finding the k-th magnitude as a scalar threshold, found
+exactly by a 16-ary threshold search (7 passes × 15 simultaneous counts, 4
+bits/pass) plus a short binary cleanup — ~13 full-vector passes total:
 
-  - the bisection runs on the **int32 bit patterns** of the squared
-    magnitudes — non-negative IEEE-754 floats compare identically as
-    integers — so 31 integer halvings resolve the k-th magnitude to a
-    single representable float at ANY dynamic range (a float-valued
-    bisection would only reach absolute precision max/2³², degenerating
-    into a keep-everything no-op when one outlier coordinate dwarfs the
-    k-th magnitude by ≥ 2¹⁶);
+  - the search runs on the **int32 bit patterns** of the absolute values
+    — non-negative IEEE-754 floats compare identically as integers — so
+    it resolves the k-th magnitude to a single representable float at ANY
+    dynamic range (a float-valued bisection would only reach absolute
+    precision max/2³², degenerating into a keep-everything no-op when one
+    outlier coordinate dwarfs the k-th magnitude by ≥ 2¹⁶; and abs, unlike
+    the reference's squares, neither underflows nor overflows);
   - invariant: count(m > lo) ≥ k > count(m > hi); at convergence lo and
     hi are adjacent bit patterns, so ``m > lo`` keeps exactly the top-k
     set, tie-inclusive: coordinates whose magnitude equals the k-th are
@@ -41,18 +42,48 @@ import jax.numpy as jnp
 
 
 def _topk_sort_1d(vec: jax.Array, k: int) -> jax.Array:
-    _, idx = jax.lax.top_k(jnp.square(vec), k)
+    # clamp so both methods accept k > d (threshold handles it naturally)
+    _, idx = jax.lax.top_k(jnp.abs(vec), min(k, vec.shape[0]))
     return jnp.zeros_like(vec).at[idx].set(vec[idx])
 
 
 def _topk_threshold_1d(vec: jax.Array, k: int) -> jax.Array:
-    m = jnp.square(vec)
+    # abs, not the reference's square (utils.py:246): same ordering, but
+    # squares underflow to 0 below |v|≈1e-19 (collapsing the selection) and
+    # overflow to inf above |v|≈2e19; abs is exact at every representable
+    # magnitude
+    m = jnp.abs(vec)
     nan_mask = jnp.isnan(m)
     mc = jnp.where(nan_mask, 0.0, m)
     # non-negative float32 bit patterns order identically as int32
     hi = jnp.max(mc).view(jnp.int32)
     lo = jnp.zeros_like(hi)
 
+    # Invariant throughout: count(m > lo) ≥ k > count(m > hi).
+    #
+    # Phase 1 — 16-ary refinement: each pass compares the whole vector
+    # against 15 interior thresholds at once (one HBM read, 15 in-register
+    # compares) and keeps the bracket where the count crosses k, winning
+    # 4 bits per pass instead of 1. The selection is branch-free: counts
+    # are non-increasing in the threshold, so the crossing index is just
+    # the number of thresholds whose count is still ≥ k.
+    ways = 16
+
+    def wide_body(_, lohi):
+        lo, hi = lohi
+        step = (hi - lo) // ways
+        ts = lo + step * jnp.arange(1, ways, dtype=jnp.int32)
+        counts = jnp.sum(mc[:, None] > ts.view(jnp.float32)[None, :], axis=0)
+        sel = jnp.sum(counts >= k).astype(jnp.int32)
+        new_lo = lo + step * sel
+        new_hi = jnp.where(sel == ways - 1, hi, lo + step * (sel + 1))
+        # step == 0 (interval below `ways`) → ts == lo, counts ≥ k, sel =
+        # ways-1 → (lo, hi) unchanged; phase 2 finishes those last bits
+        return new_lo, new_hi
+
+    lo, hi = jax.lax.fori_loop(0, 7, wide_body, (lo, hi))
+
+    # Phase 2 — plain bisection for the residual ≤ ~2^(31-7·4)·const bits
     def body(_, lohi):
         lo, hi = lohi
         # overflow-safe midpoint: lo + hi can exceed int32 (bit patterns
@@ -61,7 +92,7 @@ def _topk_threshold_1d(vec: jax.Array, k: int) -> jax.Array:
         above = jnp.sum(mc > mid.view(jnp.float32)) >= k
         return jnp.where(above, mid, lo), jnp.where(above, hi, mid)
 
-    lo, _ = jax.lax.fori_loop(0, 31, body, (lo, hi))
+    lo, _ = jax.lax.fori_loop(0, 6, body, (lo, hi))
     # lo == 0 ⇔ fewer than k nonzero magnitudes: keep them all (matches the
     # dense-masked result of lax.top_k, whose extra slots hold zeros)
     out = jnp.where(mc > lo.view(jnp.float32), vec, jnp.zeros_like(vec))

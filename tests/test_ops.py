@@ -66,6 +66,27 @@ class TestTopk:
         out = topk(v, 4)
         np.testing.assert_allclose(out, [0.0, 2.0, 0.0, -1.0, 0.0])
 
+    def test_randomized_vs_sort_across_scales(self):
+        """Threshold search equals lax.top_k selection over 60 orders of
+        magnitude (allowed difference: tie inclusion at the k-th value)."""
+        rng = np.random.RandomState(0)
+        for _ in range(20):
+            d = int(rng.randint(10, 20000))
+            k = int(rng.randint(1, d + 5))
+            scale = 10.0 ** rng.randint(-30, 30)
+            v = (rng.randn(d) * scale
+                 * (rng.rand(d) ** rng.randint(0, 6))).astype(np.float32)
+            a = np.asarray(topk(jnp.asarray(v), k))
+            b = np.asarray(topk(jnp.asarray(v), k, method="sort"))
+            if np.array_equal(a, b):
+                continue
+            m = np.abs(v)
+            kth = np.sort(m)[-min(k, d)]
+            sa, sb = set(np.flatnonzero(a)), set(np.flatnonzero(b))
+            assert {i for i in sb if m[i] > kth} <= sa
+            assert all(m[i] == kth for i in sa - sb)
+            assert all(m[i] in (kth, 0.0) for i in sb - sa)
+
 
 class TestClip:
     def test_noop_inside_ball(self):
