@@ -167,6 +167,28 @@ class TestEndToEnd:
         assert np.isfinite(stats["val_nll"])
         assert np.isfinite(stats["val_ppl"])
 
+    def test_gpt2_microbatch_e2e(self, tmp_path):
+        """--microbatch_size gradient accumulation through the entrypoint
+        (reference fed_worker.py:256-270, the reference's only sequence-
+        scaling mechanism)."""
+        import gpt2_train
+
+        stats = gpt2_train.train(argv=[
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "0.5",
+            "--num_workers", "2",
+            "--local_batch_size", "4",
+            "--microbatch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "uncompressed",
+            "--local_momentum", "0",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+        ])
+        assert np.isfinite(stats["val_nll"])
+
     @pytest.mark.parametrize("impl", ["ring", "ulysses"])
     def test_gpt2_train_seq_parallel(self, tmp_path, impl):
         """--seq_parallel runs the full train+val loop with the sequence dim
@@ -195,6 +217,6 @@ class TestEndToEnd:
             "--seed", "0",
             "--seq_parallel", impl,
             "--seq_devices", "2",
-        ])
+        ] + (["--bf16"] if impl == "ring" else []))
         assert np.isfinite(stats["val_nll"])
         assert np.isfinite(stats["val_ppl"])
