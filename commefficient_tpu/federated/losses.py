@@ -11,7 +11,7 @@ the mixup variant exists in the reference but is dead code
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp

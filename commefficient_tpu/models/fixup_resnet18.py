@@ -20,7 +20,6 @@ from commefficient_tpu.models.layers import (
     fixup_init,
     global_avg_pool,
     global_max_pool,
-    kaiming_normal_fan_out,
     torch_conv_init,
 )
 

@@ -21,7 +21,6 @@ train from scratch.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 

@@ -17,7 +17,6 @@ All modules take NHWC inputs (TPU-native layout; the reference is NCHW).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
 
 import jax.numpy as jnp
 from flax import linen as nn

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
-import jax.numpy as jnp
 from flax import linen as nn
 
 from commefficient_tpu.models.layers import ConvBN, max_pool, torch_conv_init
