@@ -12,7 +12,7 @@ with plain numpy, no framework dependency.
 from __future__ import annotations
 
 import os
-from typing import Dict
+from typing import Any, Dict
 
 import numpy as np
 import jax
