@@ -236,6 +236,9 @@ def run_gpt2_measurement() -> None:
     """Child-process entry (--run-gpt2): prints its own JSON line with the
     f32 number (comparable to the reference's f32 training) and the bf16
     number (--bf16 mixed precision, the TPU-native mode)."""
+    # own process — the --run child's kernel checks (and any kill-switch env
+    # they set) don't reach here, so re-verify before building
+    _check_pallas_kernel()
     out = {
         "gpt2_metric": "GPT-2 PersonaChat tokens/sec/chip "
                        "(124M double-heads, 4 workers, sketch 5x500k k=50k)",
@@ -292,6 +295,29 @@ def _check_pallas_kernel() -> None:
     if not np.allclose(got, want, atol=1e-4):
         raise AssertionError(f"Pallas sketch kernel mismatch: max err {err}")
     _log(f"pallas sketch kernel matches pure path (max err {err:.2e})")
+
+    # The DMA-based query kernel is newer: a compile failure or mismatch on
+    # the real chip disables it (per-kernel kill-switch) instead of sinking
+    # the whole bench — the pure XLA path is correct, just slower. The check
+    # geometry has S > 1024 sublanes so the grid runs the multi-sub-block
+    # (G > 1) window path — the one the FetchSGD-scale workload uses, whose
+    # DMA starts reach into the doubled+padded region.
+    from commefficient_tpu.ops.sketch import _estimates_jax, estimates
+
+    try:
+        cs2 = make_sketch(d=450_000, c=140_000, r=3, seed=11, num_blocks=2)
+        tbl = jnp.asarray(
+            np.random.RandomState(5).randn(*cs2.table_shape), jnp.float32)
+        got_e = np.asarray(estimates(cs2, tbl))  # dispatches to Pallas on TPU
+        want_e = np.asarray(_estimates_jax(cs2, tbl))
+        if not np.array_equal(got_e, want_e):
+            raise AssertionError(
+                f"max err {float(np.abs(got_e - want_e).max())}")
+        _log("pallas estimates kernel matches pure path (bit-exact, G>1)")
+    except Exception as e:  # noqa: BLE001 — any failure means: don't use it
+        os.environ["COMMEFFICIENT_PALLAS_ESTIMATES"] = "0"
+        _log(f"pallas estimates kernel DISABLED ({type(e).__name__}: "
+             f"{str(e)[:200]}); falling back to pure XLA query path")
 
 
 def run_measurement(tiny: bool) -> None:

@@ -287,9 +287,21 @@ def _sketch_vec_pallas(v3, shift_q, shift_w, sign_keys, *, S, T,
 
 
 def _use_pallas() -> bool:
+    import os
+
     from commefficient_tpu.utils import is_tpu_backend
 
-    return is_tpu_backend()
+    return (is_tpu_backend()
+            and os.environ.get("COMMEFFICIENT_PALLAS", "1") != "0")
+
+
+def _use_pallas_estimates() -> bool:
+    """Separate kill-switch for the query kernel so a failure there (newer,
+    DMA-based) can be disabled without losing the proven accumulate kernel."""
+    import os
+
+    return (_use_pallas()
+            and os.environ.get("COMMEFFICIENT_PALLAS_ESTIMATES", "1") != "0")
 
 
 def sketch_vec(cs: CountSketch, v: jax.Array) -> jax.Array:
@@ -310,8 +322,7 @@ def sketch_vec(cs: CountSketch, v: jax.Array) -> jax.Array:
 # query: (r, c_pad) table -> (d,) estimates
 # --------------------------------------------------------------------------
 
-def estimates(cs: CountSketch, table: jax.Array) -> jax.Array:
-    """Median-of-rows unbiased estimate of every coordinate — ``(d,)``."""
+def _estimates_jax(cs: CountSketch, table: jax.Array) -> jax.Array:
     S = cs.sublanes
     table3 = table.reshape(cs.r, S, _LANES)
 
@@ -324,6 +335,107 @@ def estimates(cs: CountSketch, table: jax.Array) -> jax.Array:
     t_bases = jnp.arange(cs.T, dtype=jnp.int32) * (S * _LANES)
     _, out = jax.lax.scan(body, None, (cs.inv_q.T, cs.inv_w.T, t_bases))
     return out.reshape(cs.T * cs.c_pad)[: cs.d]
+
+
+def _est_subblock(S: int) -> int:
+    """Output sub-block height (sublanes) for the estimates kernel."""
+    return min(1024, -(-S // 8) * 8)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("S", "T", "c_pad", "interpret"))
+def _estimates_pallas(tbl2, shift_q, shift_w, sign_keys, *, S, T, c_pad,
+                      interpret=False):
+    """Fused query kernel producing the ``(T, S, 128)`` estimate chunks.
+
+    The pure path re-rolls the whole ``(r, c_pad)`` table for every one of
+    the T chunks, so XLA materializes ~5 table-sized intermediates per chunk
+    (~1 GB of HBM round-trips at the FetchSGD geometry — measured 2.9 ms on
+    a v5e chip, the single hottest op of the server round). Here the table
+    is pre-doubled along sublanes in HBM (``tbl2[j] = [row_j; row_j; pad]``)
+    so that *any* cyclically-wrapped window is one static-size dynamic-offset
+    DMA; the grid walks (chunk, sub-block) and each step copies the r shifted
+    windows into VMEM, finishes the roll with the hardware lane-rotate plus
+    a carry select, applies the on-the-fly sign hashes, and writes the
+    elementwise median-of-rows — the table is read ~once and the estimates
+    written once (~175 MB of traffic total at the same geometry).
+
+    Window math: output position ``p`` of chunk ``t`` reads
+    ``row[(p + m) mod c_pad]`` with ``m = 128·q + w`` the *forward* shift, so
+    the sub-block starting at sublane ``g·SB`` needs input sublanes
+    ``[g·SB + q, g·SB + q + SB]`` of the doubled row, lane-rotated left by
+    ``w`` with the wrapped lanes drawn from the next sublane.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = shift_q.shape[0]
+    SB = _est_subblock(S)
+    G = -(-S // SB)
+
+    def kernel(q_ref, w_ref, key_ref, tbl2_ref, out_ref, buf, sems):
+        t = pl.program_id(0)
+        g = pl.program_id(1)
+        for j in range(r):
+            s0 = g * SB + q_ref[j, t]
+            pltpu.make_async_copy(
+                tbl2_ref.at[j, pl.ds(s0, SB + 1), :],
+                buf.at[j], sems.at[j]).start()
+        base = t * c_pad + g * (SB * _LANES)
+        idx = base + (
+            jax.lax.broadcasted_iota(jnp.int32, (SB, _LANES), 0) * _LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (SB, _LANES), 1))
+        l = jax.lax.broadcasted_iota(jnp.int32, (SB, _LANES), 1)
+        rows = []
+        for j in range(r):
+            pltpu.make_async_copy(
+                tbl2_ref.at[j, pl.ds(0, SB + 1), :],  # shape-only for wait
+                buf.at[j], sems.at[j]).wait()
+            w = w_ref[j, t]
+            z = pltpu.roll(buf[j], (_LANES - w) % _LANES, axis=1)
+            y = jnp.where(l < _LANES - w, z[:SB], z[1:])
+            rows.append(y * _signs_for(idx, key_ref[j]))
+        out_ref[...] = _median_small(rows)[None]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(T, G),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, SB, _LANES), lambda t, g, *_: (t, g, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((r, SB + 1, _LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((r,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, S, _LANES), jnp.float32),
+        interpret=interpret,
+    )(shift_q, shift_w, sign_keys, tbl2)
+
+
+def _doubled_table(cs: CountSketch, table: jax.Array) -> jax.Array:
+    """``(r, P, 128)`` doubled-and-padded sublane layout for the query
+    kernel: P covers the largest window start ``(G-1)·SB + (S-1)`` plus the
+    ``SB+1`` window, rounded up to the sublane tile."""
+    S = cs.sublanes
+    SB = _est_subblock(S)
+    G = -(-S // SB)
+    P = -(-((G - 1) * SB + S + SB + 1) // 8) * 8
+    t3 = table.reshape(cs.r, S, _LANES)
+    t6 = jnp.concatenate([t3, t3], axis=1)
+    return jnp.pad(t6, ((0, 0), (0, P - 2 * S), (0, 0)))
+
+
+def estimates(cs: CountSketch, table: jax.Array) -> jax.Array:
+    """Median-of-rows unbiased estimate of every coordinate — ``(d,)``."""
+    if _use_pallas_estimates():
+        out = _estimates_pallas(
+            _doubled_table(cs, table), cs.shift_q, cs.shift_w, cs.sign_keys,
+            S=cs.sublanes, T=cs.T, c_pad=cs.c_pad)
+        return out.reshape(cs.T * cs.c_pad)[: cs.d]
+    return _estimates_jax(cs, table)
 
 
 def unsketch(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:

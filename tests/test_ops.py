@@ -209,3 +209,45 @@ class TestSketchPallasKernel:
             S=cs.sublanes, T=cs.T, interpret=True,
         ).reshape(cs.r, cs.c_pad)
         np.testing.assert_allclose(kern, pure, rtol=1e-6, atol=1e-6)
+
+
+class TestEstimatesPallasKernel:
+    @staticmethod
+    def _compare(cs):
+        from commefficient_tpu.ops.sketch import (
+            _doubled_table,
+            _estimates_jax,
+            _estimates_pallas,
+            sketch_vec,
+        )
+
+        rng = np.random.RandomState(cs.d % 1000)
+        v = jnp.asarray(rng.randn(cs.d), jnp.float32)
+        table = sketch_vec(cs, v)
+        pure = _estimates_jax(cs, table)
+        kern = _estimates_pallas(
+            _doubled_table(cs, table), cs.shift_q, cs.shift_w, cs.sign_keys,
+            S=cs.sublanes, T=cs.T, c_pad=cs.c_pad, interpret=True,
+        ).reshape(cs.T * cs.c_pad)[: cs.d]
+        np.testing.assert_array_equal(np.asarray(kern), np.asarray(pure))
+
+    def test_interpret_matches_pure(self):
+        """The fused query kernel is bit-identical to the pure path (both
+        use the same median network), multi-chunk geometry with a d tail."""
+        self._compare(make_sketch(d=5000, c=256, r=3, seed=7))
+
+    def test_even_rows_and_exact_multiple(self):
+        """Even r exercises the mean-of-middle-two median branch; d an exact
+        multiple of c_pad exercises the no-tail path."""
+        self._compare(make_sketch(d=1024, c=256, r=4, seed=3))
+
+    def test_single_chunk_small_table(self):
+        """S smaller than the kernel sub-block (whole chunk in one step)."""
+        self._compare(make_sketch(d=200, c=128, r=3, seed=1))
+
+    def test_wide_table_multiple_subblocks(self):
+        """S above the sub-block size forces the multi-g window path whose
+        starts reach into the doubled+padded region."""
+        cs = make_sketch(d=3 * 1300 * 128, c=1300 * 128, r=5, seed=9)
+        assert cs.sublanes > 1024  # really exercises G > 1
+        self._compare(cs)
