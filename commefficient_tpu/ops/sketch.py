@@ -304,6 +304,44 @@ def _use_pallas_estimates() -> bool:
             and os.environ.get("COMMEFFICIENT_PALLAS_ESTIMATES", "1") != "0")
 
 
+_ESTIMATES_KERNEL_CHECKED = False
+
+
+def _check_estimates_kernel_once() -> None:
+    """One-time on-TPU self-check of the DMA query kernel before first use,
+    process-wide: any compile failure or mismatch against the pure XLA path
+    disables the kernel (env kill-switch) instead of silently corrupting
+    every ``unsketch`` of the run. The check geometry has S > 1024 sublanes
+    so it runs the multi-sub-block (G > 1) window path — the one the
+    FetchSGD-scale workload uses, whose DMA starts reach into the
+    doubled+padded region. Runs eagerly on concrete arrays, so it is safe to
+    trigger lazily from inside a trace of the surrounding round step."""
+    global _ESTIMATES_KERNEL_CHECKED
+    if _ESTIMATES_KERNEL_CHECKED:
+        return
+    _ESTIMATES_KERNEL_CHECKED = True
+    import os
+    import warnings
+
+    try:
+        cs = make_sketch(d=450_000, c=140_000, r=3, seed=11, num_blocks=2)
+        tbl = jnp.asarray(
+            np.random.RandomState(5).randn(*cs.table_shape), jnp.float32)
+        got = _estimates_pallas(
+            _doubled_table(cs, tbl), cs.shift_q, cs.shift_w, cs.sign_keys,
+            S=cs.sublanes, T=cs.T, c_pad=cs.c_pad)
+        want = _estimates_jax(cs, tbl)
+        if not np.array_equal(np.asarray(got).reshape(-1)[: cs.d],
+                              np.asarray(want)):
+            raise AssertionError("kernel output != pure XLA path")
+    except Exception as e:  # noqa: BLE001 — any failure means: don't use it
+        os.environ["COMMEFFICIENT_PALLAS_ESTIMATES"] = "0"
+        warnings.warn(
+            f"Pallas estimates kernel self-check failed "
+            f"({type(e).__name__}: {str(e)[:200]}); falling back to the "
+            f"pure XLA query path", RuntimeWarning)
+
+
 def sketch_vec(cs: CountSketch, v: jax.Array) -> jax.Array:
     """Accumulate a dense ``(d,)`` vector into an ``(r, c_pad)`` table.
 
@@ -430,6 +468,8 @@ def _doubled_table(cs: CountSketch, table: jax.Array) -> jax.Array:
 
 def estimates(cs: CountSketch, table: jax.Array) -> jax.Array:
     """Median-of-rows unbiased estimate of every coordinate — ``(d,)``."""
+    if _use_pallas_estimates():
+        _check_estimates_kernel_once()
     if _use_pallas_estimates():
         out = _estimates_pallas(
             _doubled_table(cs, table), cs.shift_q, cs.shift_w, cs.sign_keys,
