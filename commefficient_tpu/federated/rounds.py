@@ -59,6 +59,10 @@ from commefficient_tpu.federated.worker import (
     forward_grad,
     get_new_worker_weights,
     local_step,
+    microbatch_plan,
+    next_rng,
+    probe_n_metrics,
+    split_microbatches,
 )
 from commefficient_tpu.ops.sketch import CountSketch, sketch_vec
 
@@ -131,6 +135,10 @@ class RoundConfig:
     # All other batch leaves are replicated across seq shards.
     seq_sharded_keys: Tuple[str, ...] = ("input_ids", "token_type_ids",
                                          "lm_labels_shifted")
+    # Fused-gradient client phase: None = auto (on whenever legal — see
+    # ``build_round_step``), True/False forces it (tests use False to pin the
+    # per-client-gradient path for parity checks).
+    fuse_gradients: Optional[bool] = None
 
 
 class FederatedSteps(NamedTuple):
@@ -167,6 +175,96 @@ def build_round_step(
                         and wcfg.max_grad_norm is None and not cfg.do_test)
     inner_wcfg = (dc_replace(wcfg, mode="uncompressed") if sketch_after_sum
                   else wcfg)
+
+    # Fused-gradient client phase: every client in the round holds identical
+    # weights, and when nothing nonlinear or stateful touches the per-client
+    # gradient — no local momentum/error, no per-client clip/DP/topk, no
+    # stale topk-down weights — the sum of per-client transmits IS the
+    # gradient of the slot-masked sum of per-client losses:
+    #   Σ_i mask_i · count_i · mean_grad_i = ∇_w Σ_i mask_i · loss_sum_i .
+    # So the shard computes ONE d-sized gradient of a summed loss instead of
+    # W separate ones: the backward pass writes one parameter-gradient
+    # buffer (vs W at 124M params each for GPT-2), and the per-client
+    # forward/backward batches into one big MXU program. Per-client metrics
+    # and model_state still come from the vmapped loss evaluations, and the
+    # microbatch scan + per-client dropout rng streams are mirrored from
+    # worker._microbatch_grads, so the result matches the per-client path up
+    # to float summation order.
+    fused_grad = (
+        not cfg.do_test
+        and wcfg.mode in ("uncompressed", "true_topk", "sketch")
+        and not wcfg.has_velocity and not wcfg.has_error
+        and not wcfg.do_dp and not wcfg.do_topk_down
+        and wcfg.max_grad_norm is None
+    )
+    if cfg.fuse_gradients is not None:
+        assert not (cfg.fuse_gradients and not fused_grad), \
+            "fuse_gradients=True forced on a config where it is not legal"
+        fused_grad = cfg.fuse_gradients
+    # fused sketch mode only ever rides the sketch-after-sum path
+    assert not (fused_grad and wcfg.mode == "sketch" and not sketch_after_sum)
+
+    def fused_clients(ps_weights, model_state, batch, rng_keys, worker_mask):
+        """One-gradient client phase for a shard's W client slots. Returns
+        (local_dense_sum incl. weight decay and seq psum, stacked per-client
+        model_state, per-client metrics) — drop-in for the vmap path's
+        (Σ transmit, new_ms, metrics)."""
+        W = worker_mask.shape[0]
+        B = batch["mask"].shape[1]
+        mb, n_iters, pad = microbatch_plan(B, wcfg.microbatch_size)
+        # (n_iters, W, mb, ...) — client axis inside the scan axis
+        stacked = split_microbatches(batch, mb, n_iters, pad, example_dim=1)
+        mstates0 = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), model_state)
+
+        def step_loss(w_flat, mstates, micro, subs):
+            params = unravel(w_flat)
+
+            def per_client(ms, b, r):
+                return compute_loss_train(params, ms, b, r, True)
+
+            loss_sums, msums, counts, new_ms = jax.vmap(per_client)(
+                mstates, micro, subs)
+            total = jnp.sum(loss_sums * worker_mask)
+            return total, (loss_sums, msums, counts, new_ms)
+
+        grad_fn = jax.value_and_grad(step_loss, has_aux=True)
+
+        n_metrics = probe_n_metrics(
+            compute_loss_train, unravel(ps_weights), model_state,
+            jax.tree_util.tree_map(lambda x: x[0, 0], stacked))
+
+        def body(carry, micro):
+            g_acc, loss_acc, m_acc, n_acc, mstates, keys = carry
+            # the per-client scan's rng protocol, one lane per client
+            keys2, subs = jax.vmap(next_rng)(keys)
+            (_, (loss_sums, msums, counts, new_ms)), g = grad_fn(
+                ps_weights, mstates, micro, subs)
+            m_acc = tuple(a + m for a, m in zip(m_acc, msums))
+            return (g_acc + g, loss_acc + loss_sums, m_acc, n_acc + counts,
+                    new_ms, keys2), None
+
+        init = (jnp.zeros_like(ps_weights), jnp.zeros(W),
+                tuple(jnp.zeros(W) for _ in range(n_metrics)), jnp.zeros(W),
+                mstates0, rng_keys)
+        (g_sum, loss_sums, m_sums, counts, new_ms, _), _ = jax.lax.scan(
+            body, init, stacked)
+
+        if wcfg.seq_axis is not None:
+            # shards backpropagated their local sequence slice (linear, so
+            # one psum of the sum replaces the per-client psums)
+            g_sum = jax.lax.psum(g_sum, wcfg.seq_axis)
+        if wcfg.weight_decay != 0:
+            # per-client (wd/num_workers)·w scaled by the client's datum
+            # count (worker.forward_grad + local_step ×count)
+            wd_scale = jnp.sum(worker_mask * counts)
+            g_sum = g_sum + (wcfg.weight_decay / wcfg.num_workers) * \
+                wd_scale * ps_weights
+
+        denom = jnp.maximum(counts, 1.0)
+        metrics = (loss_sums / denom,) + tuple(m / denom for m in m_sums) \
+            + (counts,)
+        return g_sum, new_ms, metrics
 
     def one_client(ps_weights, vel_row, err_row, stale_row, model_state,
                    batch_row, lr, rng, slot_mask):
@@ -211,13 +309,20 @@ def build_round_step(
     def clients_shard(ps_weights, vel_rows, err_rows, stale_rows, model_state,
                       batch, lr, rng_keys, worker_mask):
         """Runs on one device over its W/n client slots; psums the transmit."""
-        f = partial(one_client, ps_weights)
-        transmit, new_vel, new_err, new_ms, metrics = jax.vmap(
-            f, in_axes=(0, 0, 0, None, 0, None, 0, 0),
-            out_axes=(0, 0, 0, 0, 0),
-        )(vel_rows, err_rows, stale_rows, model_state, batch, lr, rng_keys,
-          worker_mask)
-        local_sum = jnp.sum(transmit, axis=0)
+        if fused_grad:
+            local_sum, new_ms, metrics = fused_clients(
+                ps_weights, model_state, batch, rng_keys, worker_mask)
+            # no per-client state on any fused-eligible config: the inert
+            # placeholder rows pass through untouched
+            new_vel, new_err = vel_rows, err_rows
+        else:
+            f = partial(one_client, ps_weights)
+            transmit, new_vel, new_err, new_ms, metrics = jax.vmap(
+                f, in_axes=(0, 0, 0, None, 0, None, 0, 0),
+                out_axes=(0, 0, 0, 0, 0),
+            )(vel_rows, err_rows, stale_rows, model_state, batch, lr,
+              rng_keys, worker_mask)
+            local_sum = jnp.sum(transmit, axis=0)
         if sketch_after_sum:
             # one sketch of the shard's dense gradient sum (see fusion note
             # above); the psum then rides the small (r, c_pad) table exactly

@@ -106,21 +106,59 @@ class ClientResult(NamedTuple):
     metrics: Tuple[jax.Array, ...]  # (loss_mean, *metric_means, count)
 
 
+def microbatch_plan(B: int, microbatch_size: int):
+    """``(mb, n_iters, pad)`` for splitting a B-example batch into equal
+    microbatch slices (reference fed_worker.py:256-270 sizing; ≤ 0 means
+    whole-batch)."""
+    mb = B if microbatch_size <= 0 else min(microbatch_size, B)
+    n_iters = -(-B // mb)
+    return mb, n_iters, n_iters * mb - B
+
+
+def split_microbatches(batch, mb: int, n_iters: int, pad: int,
+                       example_dim: int = 0):
+    """Reshape every batch leaf's example axis into ``(n_iters, mb)``
+    zero-padded microbatch slices, with the scan axis moved to the front.
+    Shared by the per-client scan (example_dim 0) and the fused-gradient
+    round path (example_dim 1, leading client axis) so the two paths cannot
+    drift."""
+    def split(x):
+        if pad:
+            cfg = [(0, 0)] * x.ndim
+            cfg[example_dim] = (0, pad)
+            x = jnp.pad(x, cfg)
+        x = x.reshape(x.shape[:example_dim] + (n_iters, mb)
+                      + x.shape[example_dim + 1:])
+        return jnp.moveaxis(x, example_dim, 0)
+
+    return {k: split(v) for k, v in batch.items()}
+
+
+def next_rng(key):
+    """The per-microbatch rng protocol (``r, sub = split(r)``) — one shared
+    definition so the fused path's vmapped streams stay bitwise-identical to
+    the per-client scan's."""
+    ks = jax.random.split(key)
+    return ks[0], ks[1]
+
+
+def probe_n_metrics(compute_loss, params, model_state, example_batch) -> int:
+    """Number of auxiliary metric sums the loss returns (eval_shape: no
+    FLOPs)."""
+    probe = jax.eval_shape(
+        lambda: compute_loss(params, model_state, example_batch,
+                             jax.random.key(0), True))
+    return len(probe[1])
+
+
 def _microbatch_grads(compute_loss, params, model_state, batch, rng,
                       cfg: WorkerConfig):
     """Per-example-mean gradient over the masked batch, accumulated over
     microbatches with ``lax.scan``. Returns (grad_pytree_mean, loss_mean,
     metric_means, count, new_model_state)."""
     B = batch["mask"].shape[0]
-    mb = B if cfg.microbatch_size <= 0 else min(cfg.microbatch_size, B)
-    n_iters = -(-B // mb)
-    pad = n_iters * mb - B
-
-    def pad0(x):
-        return jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) if pad else x
-
-    stacked = {k: pad0(v).reshape((n_iters, mb) + v.shape[1:])
-               for k, v in batch.items()}
+    mb, n_iters, pad = microbatch_plan(B, cfg.microbatch_size)
+    stacked = split_microbatches(batch, mb, n_iters, pad)
 
     def loss_for_grad(p, mstate, micro, r):
         loss_sum, msums, count, new_state = compute_loss(p, mstate, micro, r,
@@ -131,7 +169,7 @@ def _microbatch_grads(compute_loss, params, model_state, batch, rng,
 
     def body(carry, micro):
         g_acc, loss_acc, m_acc, n_acc, mstate, r = carry
-        r, sub = jax.random.split(r)
+        r, sub = next_rng(r)
         (loss_sum, (msums, count, new_state)), g = grad_fn(params, mstate,
                                                            micro, sub)
         g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
@@ -140,12 +178,9 @@ def _microbatch_grads(compute_loss, params, model_state, batch, rng,
                 r), None
 
     zeros_g = jax.tree_util.tree_map(jnp.zeros_like, params)
-    # probe number of metrics with eval_shape (no FLOPs)
-    probe = jax.eval_shape(
-        lambda: compute_loss(params, model_state,
-                             jax.tree_util.tree_map(lambda x: x[0], stacked),
-                             jax.random.key(0), True))
-    n_metrics = len(probe[1])
+    n_metrics = probe_n_metrics(
+        compute_loss, params, model_state,
+        jax.tree_util.tree_map(lambda x: x[0], stacked))
     init = (zeros_g, jnp.zeros(()), tuple(jnp.zeros(()) for _ in range(n_metrics)),
             jnp.zeros(()), model_state, rng)
     (g_sum, loss_sum, m_sums, count, new_state, _), _ = jax.lax.scan(
@@ -249,15 +284,8 @@ def fedavg_local(compute_loss, params_flat, unravel, ravel, model_state,
     """FedAvg local training (reference fed_worker.py:61-113): local SGD over
     chunked whole-client batch, transmit (w₀ − w_final)·dataset_size."""
     B = batch["mask"].shape[0]
-    fbs = B if cfg.fedavg_batch_size == -1 else min(cfg.fedavg_batch_size, B)
-    n_chunks = -(-B // fbs)
-    pad = n_chunks * fbs - B
-
-    def pad0(x):
-        return jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) if pad else x
-
-    chunks = {k: pad0(v).reshape((n_chunks, fbs) + v.shape[1:])
-              for k, v in batch.items()}
+    fbs, n_chunks, pad = microbatch_plan(B, cfg.fedavg_batch_size)
+    chunks = split_microbatches(batch, fbs, n_chunks, pad)
 
     def grad_of(p_flat, mstate, chunk, r):
         def loss_fn(p, ms):
@@ -272,15 +300,13 @@ def fedavg_local(compute_loss, params_flat, unravel, ravel, model_state,
             g = jax.lax.psum(g, cfg.seq_axis)
         return g, loss_sum, msums, count, new_ms
 
-    probe = jax.eval_shape(
-        lambda: compute_loss(unravel(params_flat), model_state,
-                             jax.tree_util.tree_map(lambda x: x[0], chunks),
-                             jax.random.key(0), True))
-    n_metrics = len(probe[1])
+    n_metrics = probe_n_metrics(
+        compute_loss, unravel(params_flat), model_state,
+        jax.tree_util.tree_map(lambda x: x[0], chunks))
 
     def body(carry, chunk):
         w, mstate, r, step, loss_acc, m_acc, n_steps = carry
-        r, sub = jax.random.split(r)
+        r, sub = next_rng(r)
         g, loss_sum, msums, count, new_ms = grad_of(w, mstate, chunk, sub)
         # average gradient over the chunk (fed_worker.py:96-98)
         g_mean = g / jnp.maximum(count, 1.0)

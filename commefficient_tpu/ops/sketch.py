@@ -154,6 +154,11 @@ def make_sketch(d: int, c: int, r: int, seed: int = 42,
     m = rng.randint(0, c_pad, size=(r, T))
     inv = (-m) % c_pad
     keys = rng.randint(1, 2**31 - 1, size=(r,))
+    # primary trigger for the one-time query-kernel self-check: sketch
+    # geometry construction is always eager host-side setup, while
+    # ``estimates`` itself usually runs inside a jit trace where the
+    # check cannot execute
+    _check_estimates_kernel_once(eager=True)
     return CountSketch(
         shift_q=jnp.asarray(m // _LANES, jnp.int32),
         shift_w=jnp.asarray(m % _LANES, jnp.int32),
@@ -307,17 +312,38 @@ def _use_pallas_estimates() -> bool:
 _ESTIMATES_KERNEL_CHECKED = False
 
 
-def _check_estimates_kernel_once() -> None:
+def _trace_state_clean() -> bool:
+    """True when no jit trace is active. Private API, so fail closed
+    ('might be in a trace'); callers that are eager by construction pass
+    ``eager=True`` to the check instead of relying on this probe."""
+    try:
+        from jax._src import core as _core
+
+        return bool(_core.trace_state_clean())
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _check_estimates_kernel_once(eager: bool = False) -> None:
     """One-time on-TPU self-check of the DMA query kernel before first use,
     process-wide: any compile failure or mismatch against the pure XLA path
     disables the kernel (env kill-switch) instead of silently corrupting
     every ``unsketch`` of the run. The check geometry has S > 1024 sublanes
     so it runs the multi-sub-block (G > 1) window path — the one the
     FetchSGD-scale workload uses, whose DMA starts reach into the
-    doubled+padded region. Runs eagerly on concrete arrays, so it is safe to
-    trigger lazily from inside a trace of the surrounding round step."""
+    doubled+padded region. Must run OUTSIDE any jit trace (inside one, every
+    jax op — concrete inputs or not — lifts into the trace); the primary
+    trigger is ``make_sketch`` — always host-side eager setup — which
+    passes ``eager=True`` so the check survives even if the trace-state
+    probe's private import breaks."""
     global _ESTIMATES_KERNEL_CHECKED
     if _ESTIMATES_KERNEL_CHECKED:
+        return
+    if not _use_pallas_estimates():
+        # respect the operator kill-switch: never compile a kernel the env
+        # disabled (a Mosaic hard-crash there is not a catchable exception)
+        return
+    if not eager and not _trace_state_clean():
         return
     _ESTIMATES_KERNEL_CHECKED = True
     import os
@@ -467,9 +493,13 @@ def _doubled_table(cs: CountSketch, table: jax.Array) -> jax.Array:
 
 
 def estimates(cs: CountSketch, table: jax.Array) -> jax.Array:
-    """Median-of-rows unbiased estimate of every coordinate — ``(d,)``."""
-    if _use_pallas_estimates():
-        _check_estimates_kernel_once()
+    """Median-of-rows unbiased estimate of every coordinate — ``(d,)``.
+
+    The Pallas query kernel is self-checked once per process at
+    ``make_sketch`` time (the only ``CountSketch`` constructor); a process
+    that somehow obtains a sketch without constructing one (e.g.
+    deserialized) and only ever calls this inside a trace runs the kernel
+    unverified."""
     if _use_pallas_estimates():
         out = _estimates_pallas(
             _doubled_table(cs, table), cs.shift_q, cs.shift_w, cs.sign_keys,

@@ -9,24 +9,25 @@ a full sort — ~15 ms/call on a v5e chip and the single hottest op of the
 whole federated round (it sits inside ``unsketch`` on the server). Since the
 callers only ever need the *dense masked* result (never the index list), the
 selection reduces to finding the k-th magnitude as a scalar threshold, found
-exactly by a 16-ary threshold search (7 passes × 15 simultaneous counts, 4
-bits/pass) plus a short binary cleanup — ~13 full-vector passes total:
+exactly by a radix-nibble descent over the **int32 bit patterns** of the
+absolute values (non-negative IEEE-754 floats compare identically as
+integers): 8 passes, each comparing the whole vector against the 15 (7 for
+the top nibble — finite ``|float|`` patterns keep bit 31 clear and top
+nibble ≤ 7) candidate extensions of the resolved prefix and keeping the
+largest whose ≥-count still reaches k. That resolves 4 threshold bits per
+full-vector read with pure int32 compares — no float bisection precision
+cliffs at any dynamic range, no separate max pass, and ``|vec|`` is
+recomputed per pass (2 VPU ops) rather than materialized. Properties:
 
-  - the search runs on the **int32 bit patterns** of the absolute values
-    — non-negative IEEE-754 floats compare identically as integers — so
-    it resolves the k-th magnitude to a single representable float at ANY
-    dynamic range (a float-valued bisection would only reach absolute
-    precision max/2³², degenerating into a keep-everything no-op when one
-    outlier coordinate dwarfs the k-th magnitude by ≥ 2¹⁶; and abs, unlike
-    the reference's squares, neither underflows nor overflows);
-  - invariant: count(m > lo) ≥ k > count(m > hi); at convergence lo and
-    hi are adjacent bit patterns, so ``m > lo`` keeps exactly the top-k
-    set, tie-inclusive: coordinates whose magnitude equals the k-th are
-    all kept (``lax.top_k`` instead breaks ties by index). Ties at the
+  - invariant after every pass: count(m ≥ p) ≥ k with p a prefix of the
+    k-th magnitude's bit pattern; at the end ``m ≥ p`` keeps exactly the
+    top-k set, tie-inclusive: coordinates whose magnitude equals the k-th
+    are all kept (``lax.top_k`` instead breaks ties by index). Ties at the
     cut are measure-zero for real gradients; the compression semantics
     tolerate the extra coordinates;
   - NaN coordinates pass through as NaN (excluded from the threshold
-    search, re-inserted in the output) so divergence stays visible to the
+    search — their bit patterns exceed the inf pattern and are mapped to
+    0 — then re-inserted in the output) so divergence stays visible to the
     NaN-abort in the train loop (reference cv_train.py:110-112) — silently
     dropping them would disguise a diverged round as a healthy sparse
     update.
@@ -40,6 +41,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+_ABS_MASK = 0x7FFFFFFF
+_INF_BITS = 0x7F800000  # |pattern| above this ⇔ NaN
+
 
 def _topk_sort_1d(vec: jax.Array, k: int) -> jax.Array:
     # clamp so both methods accept k > d (threshold handles it naturally)
@@ -48,55 +52,36 @@ def _topk_sort_1d(vec: jax.Array, k: int) -> jax.Array:
 
 
 def _topk_threshold_1d(vec: jax.Array, k: int) -> jax.Array:
-    # abs, not the reference's square (utils.py:246): same ordering, but
-    # squares underflow to 0 below |v|≈1e-19 (collapsing the selection) and
-    # overflow to inf above |v|≈2e19; abs is exact at every representable
-    # magnitude
-    m = jnp.abs(vec)
-    nan_mask = jnp.isnan(m)
-    mc = jnp.where(nan_mask, 0.0, m)
-    # non-negative float32 bit patterns order identically as int32
-    hi = jnp.max(mc).view(jnp.int32)
-    lo = jnp.zeros_like(hi)
+    raw = vec.view(jnp.int32)
 
-    # Invariant throughout: count(m > lo) ≥ k > count(m > hi).
-    #
-    # Phase 1 — 16-ary refinement: each pass compares the whole vector
-    # against 15 interior thresholds at once (one HBM read, 15 in-register
-    # compares) and keeps the bracket where the count crosses k, winning
-    # 4 bits per pass instead of 1. The selection is branch-free: counts
-    # are non-increasing in the threshold, so the crossing index is just
-    # the number of thresholds whose count is still ≥ k.
-    ways = 16
+    def mag(r):
+        # |pattern| as int (abs, not the reference's square, utils.py:246:
+        # squares underflow below |v|≈1e-19 and overflow above ≈2e19; bit
+        # patterns are exact at every representable magnitude); NaN → 0 so
+        # divergence never wins the threshold race
+        m = r & _ABS_MASK
+        return jnp.where(m > _INF_BITS, 0, m)
 
-    def wide_body(_, lohi):
-        lo, hi = lohi
-        step = (hi - lo) // ways
-        ts = lo + step * jnp.arange(1, ways, dtype=jnp.int32)
-        counts = jnp.sum(mc[:, None] > ts.view(jnp.float32)[None, :], axis=0)
+    # Radix descent: after each pass p is the resolved high-nibble prefix of
+    # the k-th largest magnitude's bit pattern, maintaining
+    # count(m ≥ p) ≥ k. Unrolled: 8 static passes, thresholds are ints.
+    p = jnp.int32(0)
+    for shift in range(28, -1, -4):
+        hi_nib = 8 if shift == 28 else 16
+        ts = p + (jnp.arange(1, hi_nib, dtype=jnp.int32) << shift)
+        m = mag(raw)
+        counts = jnp.sum(m[:, None] >= ts[None, :], axis=0)
+        # counts are non-increasing in the threshold, so the chosen nibble
+        # is just the number of candidates whose count still reaches k
         sel = jnp.sum(counts >= k).astype(jnp.int32)
-        new_lo = lo + step * sel
-        new_hi = jnp.where(sel == ways - 1, hi, lo + step * (sel + 1))
-        # step == 0 (interval below `ways`) → ts == lo, counts ≥ k, sel =
-        # ways-1 → (lo, hi) unchanged; phase 2 finishes those last bits
-        return new_lo, new_hi
+        p = p + (sel << shift)
 
-    lo, hi = jax.lax.fori_loop(0, 7, wide_body, (lo, hi))
-
-    # Phase 2 — plain bisection for the residual ≤ ~2^(31-7·4)·const bits
-    def body(_, lohi):
-        lo, hi = lohi
-        # overflow-safe midpoint: lo + hi can exceed int32 (bit patterns
-        # reach 2^31 for large floats)
-        mid = lo + ((hi - lo) >> 1)
-        above = jnp.sum(mc > mid.view(jnp.float32)) >= k
-        return jnp.where(above, mid, lo), jnp.where(above, hi, mid)
-
-    lo, _ = jax.lax.fori_loop(0, 6, body, (lo, hi))
-    # lo == 0 ⇔ fewer than k nonzero magnitudes: keep them all (matches the
-    # dense-masked result of lax.top_k, whose extra slots hold zeros)
-    out = jnp.where(mc > lo.view(jnp.float32), vec, jnp.zeros_like(vec))
-    return jnp.where(nan_mask, vec, out)
+    # p == 0 ⇔ fewer than k nonzero magnitudes: m ≥ 0 keeps everything,
+    # and zero-magnitude coordinates contribute value 0 anyway — the same
+    # dense-masked result lax.top_k pads with zeros
+    out = jnp.where(mag(raw) >= p, vec, jnp.zeros_like(vec))
+    nan = (raw & _ABS_MASK) > _INF_BITS
+    return jnp.where(nan, vec, out)
 
 
 def topk(vec: jax.Array, k: int, method: str = "threshold") -> jax.Array:

@@ -39,7 +39,7 @@ def _linear_loss(params, model_state, batch, rng, train):
 
 
 def _setup(mode="uncompressed", error_type="none", num_workers=8, k=2,
-           mesh=None, virtual_momentum=0.0, **kw):
+           mesh=None, virtual_momentum=0.0, fuse=None, loss=None, **kw):
     params = {"w": jnp.zeros(D)}
     flat, unravel = ravel_pytree(params)
 
@@ -53,9 +53,11 @@ def _setup(mode="uncompressed", error_type="none", num_workers=8, k=2,
                         local_momentum=kw.get("local_momentum", 0.0))
     sketch = make_sketch(D, 16, 3, seed=0, num_blocks=1) if mode == "sketch" \
         else None
-    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=D)
+    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=D,
+                      fuse_gradients=fuse)
+    loss = loss if loss is not None else _linear_loss
     steps = build_round_step(
-        _linear_loss, _linear_loss, unravel, ravel, cfg, sketch=sketch,
+        loss, loss, unravel, ravel, cfg, sketch=sketch,
         mesh=mesh)
     train_step, val_step = steps.train_step, steps.val_step
     server_state = init_server_state(scfg, sketch)
@@ -440,3 +442,75 @@ class TestSketchAfterSumFusion:
         np.testing.assert_allclose(np.asarray(ctx.gradient),
                                    np.asarray(expected), rtol=1e-5,
                                    atol=1e-6)
+
+
+def _stateful_loss(params, model_state, batch, rng, train):
+    """Linear loss that also evolves a model_state (BN-stats stand-in):
+    running sum of inputs seen, updated per microbatch call."""
+    loss_sum, msums, count, _ = _linear_loss(params, model_state, batch, rng,
+                                             train)
+    new_state = {"x_sum": model_state["x_sum"]
+                 + jnp.sum(batch["inputs"] * batch["mask"][..., None],
+                           axis=tuple(range(batch["inputs"].ndim - 1)))}
+    return loss_sum, msums, count, new_state
+
+
+class TestFusedGradientParity:
+    """The fused one-gradient client phase (rounds.fused_clients) must match
+    the per-client-gradient path on every eligible config — same math,
+    different summation order."""
+
+    def _run_pair(self, batch=None, state=None, loss=None, tol=1e-5, **kw):
+        batch = batch if batch is not None else _batch()
+        state = state if state is not None else {}
+        outs = {}
+        for fuse in (True, False):
+            flat, train_step, _, ss, cs = _setup(fuse=fuse, loss=loss, **kw)
+            outs[fuse] = train_step(flat, ss, cs, state, batch, 0.1,
+                                    jax.random.key(0))
+        fused, plain = outs[True], outs[False]
+        np.testing.assert_allclose(np.asarray(fused[0]), np.asarray(plain[0]),
+                                   rtol=tol, atol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=tol, atol=1e-6),
+            fused[3], plain[3])   # model_state
+        for mf, mp in zip(fused[4], plain[4]):
+            np.testing.assert_allclose(np.asarray(mf), np.asarray(mp),
+                                       rtol=tol, atol=1e-6)
+
+    def test_uncompressed(self):
+        self._run_pair()
+
+    def test_weight_decay_and_padded_slots(self):
+        batch = _batch()
+        wm = np.ones(8, np.float32)
+        wm[5:] = 0
+        mask = np.asarray(batch["mask"]).copy()
+        mask[5:] = 0
+        batch = dict(batch, worker_mask=jnp.asarray(wm),
+                     mask=jnp.asarray(mask))
+        self._run_pair(batch=batch, weight_decay=0.1)
+
+    def test_sketch_after_sum(self):
+        self._run_pair(mode="sketch", error_type="virtual")
+
+    def test_true_topk(self):
+        self._run_pair(mode="true_topk", error_type="virtual",
+                       virtual_momentum=0.9)
+
+    def test_microbatched(self):
+        # bs=3 with microbatch_size=2 exercises the ragged padded tail
+        self._run_pair(batch=_batch(bs=3), microbatch_size=2)
+
+    def test_model_state_evolution(self):
+        self._run_pair(loss=_stateful_loss,
+                       state={"x_sum": jnp.zeros(D)})
+
+    def test_on_mesh(self):
+        devs = np.array(jax.devices()[:8])
+        self._run_pair(mesh=Mesh(devs, ("clients",)))
+
+    def test_forcing_fused_on_ineligible_config_raises(self):
+        with pytest.raises(AssertionError):
+            _setup(fuse=True, local_momentum=0.9)
