@@ -61,6 +61,13 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--finetune", action="store_true", dest="do_finetune")
     parser.add_argument("--checkpoint", action="store_true", dest="do_checkpoint")
     parser.add_argument("--checkpoint_path", type=str, default="./checkpoint")
+    # mid-run resume (no reference equivalent — its checkpointing is
+    # save-only, reference cv_train.py:418-421; SURVEY.md §5): save the FULL
+    # run state every N epochs, restart from it bit-exactly
+    parser.add_argument("--checkpoint_every", type=int, default=0,
+                        help="Save full run state every N epochs (0 = off).")
+    parser.add_argument("--resume", type=str, default="",
+                        help="Path of a run-state checkpoint to resume from.")
     parser.add_argument("--finetune_path", type=str, default="./finetune")
     parser.add_argument("--finetuned_from", type=str, choices=_dataset_names(),
                         help="Name of the dataset you pretrained on.")

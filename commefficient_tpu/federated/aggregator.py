@@ -188,6 +188,7 @@ class FedModel:
         if self.memory_plan.total_bytes:
             print(self.memory_plan.summary())
         state_sharding = client_state_sharding(self.mesh, self.memory_plan)
+        self._state_sharding = state_sharding  # reused by --resume restore
         self.client_states = init_client_states(
             alloc_clients, self.grad_size, wcfg, init_weights=flat,
             sketch=self.sketch, sharding=state_sharding)

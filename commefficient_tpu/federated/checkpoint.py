@@ -11,6 +11,7 @@ with plain numpy, no framework dependency.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Dict
 
@@ -54,6 +55,131 @@ def load_checkpoint(path: str):
         flat = {k: data[k] for k in data.files}
     tree = _unflatten(flat)
     return tree.get("params", {}), tree.get("model_state", {})
+
+
+def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
+                   next_epoch: int, totals=(0.0, 0.0)) -> str:
+    """Full mid-training run-state checkpoint for ``--resume`` — a
+    capability the reference lacks (its checkpointing is save-only,
+    reference cv_train.py:418-421; SURVEY.md §5 'Checkpoint / resume').
+
+    Captures everything a bit-exact epoch-boundary restart needs: the flat
+    PS weights, server (velocity, error) state, per-client state rows,
+    model_state (e.g. BatchNorm stats), the jax rng key, the global numpy
+    RNG (drives FedSampler's client sampling), LR-scheduler step count,
+    download-accounting state, and byte totals. One ``.npz``, plain numpy.
+    """
+    fm = fed_model
+    arrays = {"ps_weights": np.asarray(fm.ps_weights)}
+    for name in ("velocities", "errors", "weights"):
+        arr = getattr(fm.client_states, name)
+        if arr is not None:
+            arrays["client/" + name] = np.asarray(arr)
+    arrays.update({"model_state/" + k: v
+                   for k, v in _flatten(fm._model_state).items()})
+    arrays["server/velocity"] = np.asarray(optimizer.server_state.velocity)
+    arrays["server/error"] = np.asarray(optimizer.server_state.error)
+    arrays["rng"] = np.asarray(jax.random.key_data(fm._rng))
+    np_name, np_keys, np_pos, np_has_gauss, np_cached = np.random.get_state()
+    arrays["np_rng/keys"] = np_keys
+    if fm._simple_download:
+        arrays["acct/updated_since_init"] = np.asarray(fm._updated_since_init)
+    else:
+        arrays["acct/last_changed"] = np.asarray(fm._last_changed)
+        arrays["acct/client_part_round"] = fm._client_part_round
+    meta = {
+        "next_epoch": int(next_epoch),
+        "lr_step_count": int(lr_scheduler._step_count),
+        "total_download": float(totals[0]),
+        "total_upload": float(totals[1]),
+        "np_rng": {"name": np_name, "pos": int(np_pos),
+                   "has_gauss": int(np_has_gauss),
+                   "cached": float(np_cached)},
+        "round_idx": int(getattr(fm, "_round_idx", 0)),
+    }
+    arrays["meta_json"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    # atomic: a crash mid-save (the very event --resume exists for) must not
+    # leave a truncated file at the expected name. The tmp name keeps the
+    # .npz suffix so np.savez does not append another one.
+    tmp = path[:-len(".npz")] + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def maybe_save_run_state(args, epoch: int, fed_model, optimizer, lr_scheduler,
+                         totals) -> None:
+    """The entrypoints' shared per-epoch ``--checkpoint_every`` hook."""
+    if args.checkpoint_every and (epoch + 1) % args.checkpoint_every == 0:
+        path = save_run_state(
+            os.path.join(args.checkpoint_path, f"run_state_ep{epoch + 1}"),
+            fed_model, optimizer, lr_scheduler, next_epoch=epoch + 1,
+            totals=totals)
+        print(f"run state saved to {path} (epoch {epoch + 1})")
+
+
+def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
+    """Restore a ``save_run_state`` checkpoint in place; returns
+    ``(next_epoch, (total_download, total_upload))``."""
+    fm = fed_model
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    with np.load(path) as data:
+        flat = {k: data[k] for k in data.files}
+    meta = json.loads(bytes(flat.pop("meta_json")).decode())
+
+    fm.ps_weights = jnp.asarray(flat["ps_weights"])
+    cs = {}
+    for name in ("velocities", "errors", "weights"):
+        key = "client/" + name
+        cur = getattr(fm.client_states, name)
+        if key in flat:
+            assert cur is not None, \
+                f"checkpoint has client {name} but this config allocates none"
+            arr = jnp.asarray(flat[key])
+            if fm._state_sharding is not None:
+                arr = jax.device_put(arr, fm._state_sharding)
+            cs[name] = arr
+        else:
+            assert cur is None, \
+                f"config allocates client {name} but checkpoint has none"
+            cs[name] = None
+    from commefficient_tpu.federated.rounds import ClientStates
+
+    fm.client_states = ClientStates(**cs)
+    mstate_flat = {k[len("model_state/"):]: v for k, v in flat.items()
+                   if k.startswith("model_state/")}
+    if mstate_flat:
+        fm._model_state = jax.tree_util.tree_map(
+            jnp.asarray, _unflatten(mstate_flat))
+    fm._rng = jax.random.wrap_key_data(jnp.asarray(flat["rng"]))
+
+    from commefficient_tpu.federated.server import ServerState
+
+    optimizer.server_state = ServerState(
+        velocity=jnp.asarray(flat["server/velocity"]),
+        error=jnp.asarray(flat["server/error"]))
+
+    np_meta = meta["np_rng"]
+    np.random.set_state((np_meta["name"], flat["np_rng/keys"],
+                         np_meta["pos"], np_meta["has_gauss"],
+                         np_meta["cached"]))
+    if fm._simple_download:
+        fm._updated_since_init = jnp.asarray(flat["acct/updated_since_init"])
+    else:
+        fm._last_changed = jnp.asarray(flat["acct/last_changed"])
+        fm._client_part_round = np.asarray(flat["acct/client_part_round"])
+        fm._round_idx = meta["round_idx"]
+    fm._prev_ps = fm.ps_weights
+
+    lr_scheduler._step_count = meta["lr_step_count"]
+    lr_scheduler.optimizer.set_lr_factor(
+        lr_scheduler.lr_lambda(meta["lr_step_count"]))
+    return meta["next_epoch"], (meta["total_download"], meta["total_upload"])
 
 
 def load_matching(template_params, ckpt_params):

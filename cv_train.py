@@ -33,6 +33,8 @@ from commefficient_tpu.federated import FedModel, FedOptimizer, LambdaLR
 from commefficient_tpu.federated.checkpoint import (
     load_checkpoint,
     load_matching,
+    load_run_state,
+    maybe_save_run_state,
     save_checkpoint,
 )
 from commefficient_tpu.federated.losses import make_cv_losses
@@ -133,17 +135,16 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
 
 
 def train(model, opt, lr_scheduler, train_loader, test_loader, args, writer,
-          loggers=(), timer=None):
+          loggers=(), timer=None, start_epoch=0, totals=(0.0, 0.0)):
     timer = timer or Timer()
-    total_download = 0.0
-    total_upload = 0.0
-    if args.eval_before_start:
+    total_download, total_upload = totals
+    if args.eval_before_start and start_epoch == 0:
         _, test_acc, _, _ = run_batches(model, None, None, test_loader,
                                         False, 1, args)
         timer()
         print(f"Test acc at epoch 0: {test_acc:0.4f}")
     summary = {}
-    for epoch in range(math.ceil(args.num_epochs)):
+    for epoch in range(start_epoch, math.ceil(args.num_epochs)):
         if epoch == math.ceil(args.num_epochs) - 1:
             epoch_fraction = args.num_epochs - epoch
         else:
@@ -177,6 +178,8 @@ def train(model, opt, lr_scheduler, train_loader, test_loader, args, writer,
         summary = union({"epoch": epoch + 1, "lr": lr}, epoch_stats)
         for logger in loggers:
             logger.append(summary)
+        maybe_save_run_state(args, epoch, model, opt, lr_scheduler,
+                             (total_download, total_upload))
         if writer is not None:
             for key, val in (("Loss/train", train_loss),
                              ("Loss/test", test_loss),
@@ -317,10 +320,17 @@ def main(argv=None):
             writer = SummaryWriter(log_dir=log_dir)
         except ImportError:
             print("tensorboard unavailable; console logging only")
+    start_epoch, totals = 0, (0.0, 0.0)
+    if args.resume:
+        start_epoch, totals = load_run_state(args.resume, fed_model, opt,
+                                             lr_scheduler)
+        print(f"resumed run state from {args.resume} "
+              f"(continuing at epoch {start_epoch + 1})")
     print(f"Finished initializing in {timer():.2f} seconds")
 
     summary = train(fed_model, opt, lr_scheduler, train_loader, test_loader,
-                    args, writer, loggers=(TableLogger(),), timer=timer)
+                    args, writer, loggers=(TableLogger(),), timer=timer,
+                    start_epoch=start_epoch, totals=totals)
     fed_model.finalize()
     if args.do_checkpoint:
         os.makedirs(args.checkpoint_path, exist_ok=True)

@@ -145,11 +145,15 @@ def test_gpt2(model, val_loader, args, logger=None, timer=None, writer=None):
 
 
 def train_gpt2(model, opt, scheduler, train_loader, val_loader, args,
-               log_dir, writer=None, logger=None, timer=None):
+               log_dir, writer=None, logger=None, timer=None, start_epoch=0,
+               totals=(0.0, 0.0)):
+    from commefficient_tpu.federated.checkpoint import (
+        maybe_save_run_state,
+    )
+
     timer = timer or Timer()
-    total_download = 0.0
-    total_upload = 0.0
-    for epoch in range(math.ceil(args.num_epochs)):
+    total_download, total_upload = totals
+    for epoch in range(start_epoch, math.ceil(args.num_epochs)):
         if epoch == math.ceil(args.num_epochs) - 1:
             epoch_fraction = args.num_epochs - epoch
         else:
@@ -163,6 +167,8 @@ def train_gpt2(model, opt, scheduler, train_loader, val_loader, args,
             # gpt2_train.py:132-145)
             total_download += download.sum() / (1024 * 1024)
             total_upload += upload.sum() / (1024 * 1024)
+        maybe_save_run_state(args, epoch, model, opt, scheduler,
+                             (total_download, total_upload))
     print(f"Total Download (MiB): {total_download:0.2f} (only epoch 1)")
     print(f"Total Upload (MiB): {total_upload:0.2f} (only epoch 1)")
     n = train_loader.dataset.num_clients
@@ -250,8 +256,17 @@ def train(argv=None):
     if args.do_finetune:
         return test_gpt2(fed_model, val_loader, args, logger=TableLogger(),
                          timer=timer)
+    start_epoch, totals = 0, (0.0, 0.0)
+    if args.resume:
+        from commefficient_tpu.federated.checkpoint import load_run_state
+
+        start_epoch, totals = load_run_state(args.resume, fed_model, opt,
+                                             scheduler)
+        print(f"resumed run state from {args.resume} "
+              f"(continuing at epoch {start_epoch + 1})")
     stats = train_gpt2(fed_model, opt, scheduler, train_loader, val_loader,
-                       args, log_dir, logger=TableLogger(), timer=timer)
+                       args, log_dir, logger=TableLogger(), timer=timer,
+                       start_epoch=start_epoch, totals=totals)
     fed_model.finalize()
     return stats
 

@@ -16,13 +16,13 @@ import cv_train  # noqa: E402
 
 
 def _run(tmp_path, monkeypatch, extra, dataset="CIFAR10", subdir="data",
-         iid=True, per_class="24"):
+         iid=True, per_class="24", epochs="1"):
     # set at call time, not import time — see comment in test_data.py
     monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_PER_CLASS", per_class)
     argv = [
         "--dataset_name", dataset,
         "--dataset_dir", str(tmp_path / subdir),
-        "--num_epochs", "1",
+        "--num_epochs", epochs,
         "--num_workers", "2",
         "--local_batch_size", "4",
         "--valid_batch_size", "8",
@@ -229,3 +229,39 @@ class TestMoreWorkloads:
                       capsys.readouterr().out)
         assert m and int(m.group(1)) > 0, \
             "finetune silently loaded 0 checkpoint tensors"
+
+
+class TestResume:
+    def test_resume_matches_continuous(self, tmp_path, monkeypatch):
+        """--checkpoint_every + --resume: restarting from the epoch-1 run
+        state and training epoch 2 must reproduce the uninterrupted 2-epoch
+        run bit-for-bit (PS weights, server momentum/error, client sampling
+        stream, BN stats all restored). No reference equivalent — its
+        checkpointing is save-only (reference cv_train.py:418-421)."""
+        from commefficient_tpu.federated.checkpoint import load_checkpoint
+
+        common = [
+            "--mode", "sketch", "--error_type", "virtual",
+            "--local_momentum", "0", "--virtual_momentum", "0.9",
+            "--k", "200", "--num_cols", "1024", "--num_rows", "3",
+            "--num_blocks", "2", "--batchnorm", "--checkpoint",
+            "--train_dataloader_workers", "0",
+        ]
+        s_full = _run(tmp_path, monkeypatch, common + [
+            "--checkpoint_path", str(tmp_path / "full"),
+            "--checkpoint_every", "1"], epochs="2")
+        s_resumed = _run(tmp_path, monkeypatch, common + [
+            "--checkpoint_path", str(tmp_path / "resumed"),
+            "--resume", str(tmp_path / "full" / "run_state_ep1")],
+            epochs="2")
+
+        p_full, ms_full = load_checkpoint(str(tmp_path / "full" / "ResNet9"))
+        p_res, ms_res = load_checkpoint(str(tmp_path / "resumed" / "ResNet9"))
+        import jax
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b), p_full, p_res)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b), ms_full, ms_res)
+        assert s_full["train_loss"] == pytest.approx(s_resumed["train_loss"])
+        assert s_full["test_acc"] == pytest.approx(s_resumed["test_acc"])

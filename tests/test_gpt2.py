@@ -220,3 +220,35 @@ class TestEndToEnd:
         ] + (["--bf16"] if impl == "ring" else []))
         assert np.isfinite(stats["val_nll"])
         assert np.isfinite(stats["val_ppl"])
+
+
+class TestResume:
+    def test_checkpoint_and_resume(self, tmp_path):
+        """--checkpoint_every + --resume through gpt2_train (the bit-exact
+        restore property is proven in test_cv_train.TestResume; here the
+        shared machinery must round-trip the GPT-2 run shape)."""
+        import gpt2_train
+
+        common = [
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "2",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "uncompressed",
+            "--local_momentum", "0",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+        ]
+        stats = gpt2_train.train(argv=common + [
+            "--checkpoint_path", str(tmp_path / "ckpt"),
+            "--checkpoint_every", "1"])
+        assert np.isfinite(stats["val_nll"])
+        assert (tmp_path / "ckpt" / "run_state_ep1.npz").exists()
+        stats2 = gpt2_train.train(argv=common + [
+            "--resume", str(tmp_path / "ckpt" / "run_state_ep1")])
+        assert np.isfinite(stats2["val_nll"])
+        np.testing.assert_allclose(stats2["val_nll"], stats["val_nll"],
+                                   rtol=1e-5)
