@@ -153,6 +153,27 @@ def validate_args(args):
         assert args.local_batch_size == -1, "fedavg requires local_batch_size == -1"
         assert args.local_momentum == 0, "fedavg requires local_momentum == 0"
         assert args.error_type == "none", "fedavg requires error_type == none"
+    if args.device:
+        # select the JAX platform before the backend initializes (the
+        # reference's --device picks the torch device; here e.g.
+        # --device cpu debugs an entrypoint without claiming the TPU).
+        # Once the backend is initialized the update silently has no
+        # effect, so detect that case and say so instead of running on
+        # the wrong device without a word.
+        import jax
+
+        initialized = False
+        try:
+            from jax._src import xla_bridge
+
+            initialized = xla_bridge.backends_are_initialized()
+        except Exception:  # noqa: BLE001 — private API; fail open
+            pass
+        if initialized and jax.default_backend() != args.device:
+            print(f"--device {args.device} ignored: JAX backend already "
+                  f"initialized on {jax.default_backend()!r}")
+        else:
+            jax.config.update("jax_platforms", args.device)
     return args
 
 

@@ -3,6 +3,13 @@ from commefficient_tpu.federated.aggregator import (
     FedOptimizer,
     LambdaLR,
 )
+from commefficient_tpu.federated.checkpoint import (
+    load_checkpoint,
+    load_matching,
+    load_run_state,
+    save_checkpoint,
+    save_run_state,
+)
 from commefficient_tpu.federated.rounds import (
     ClientStates,
     RoundConfig,
@@ -21,6 +28,11 @@ __all__ = [
     "FedModel",
     "FedOptimizer",
     "LambdaLR",
+    "load_checkpoint",
+    "load_matching",
+    "load_run_state",
+    "save_checkpoint",
+    "save_run_state",
     "ClientStates",
     "RoundConfig",
     "build_round_step",

@@ -108,9 +108,14 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
             labels = batch["lm_labels"][..., 1:]
         valid = labels != -1
         safe = jnp.where(valid, labels, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        tok_nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        tok_nll = tok_nll * valid
+        # logsumexp − gathered logit, not log_softmax + gather: avoids
+        # materializing a full (..., V) log-prob tensor (1.6 GB at the bench
+        # geometry) — the reductions and the one-element gather are all the
+        # loss needs. f32 accumulation regardless of the logits' dtype.
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            logits, safe[..., None], axis=-1)[..., 0].astype(jnp.float32)
+        tok_nll = (lse - picked) * valid
         # sum over candidates & positions, normalize by valid token count
         nll_sum = tok_nll.sum(axis=(-2, -1))
         n_valid = valid.sum(axis=(-2, -1))
@@ -137,7 +142,8 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
             token_type_ids=batch["token_type_ids"],
             mc_token_ids=batch["mc_token_ids"], train=train,
             rngs={"dropout": rng} if train else None)
-        lm_logits = lm_logits.astype(jnp.float32)
+        # lm_logits stay in compute dtype; the nll reductions accumulate
+        # in f32 internally (see _lm_nll_per_example)
         mc_logits = mc_logits.astype(jnp.float32)
         lm_nll = _lm_nll_per_example(lm_logits, batch)
         mc_ce, _ = _mc_ce_acc(mc_logits, batch["mc_labels"])
@@ -152,7 +158,8 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
             {"params": params}, batch["input_ids"],
             token_type_ids=batch["token_type_ids"],
             mc_token_ids=batch["mc_token_ids"], train=False)
-        lm_logits = lm_logits.astype(jnp.float32)
+        # lm_logits stay in compute dtype; the nll reductions accumulate
+        # in f32 internally (see _lm_nll_per_example)
         mc_logits = mc_logits.astype(jnp.float32)
         lm_nll = _lm_nll_per_example(lm_logits, batch)
         _, acc = _mc_ce_acc(mc_logits, batch["mc_labels"])

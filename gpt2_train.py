@@ -29,6 +29,10 @@ from commefficient_tpu.data_utils.tokenization import (
     get_tokenizer,
 )
 from commefficient_tpu.federated import FedModel, FedOptimizer, LambdaLR
+from commefficient_tpu.federated.checkpoint import (
+    load_run_state,
+    maybe_save_run_state,
+)
 from commefficient_tpu.federated.losses import make_gpt2_losses
 from commefficient_tpu.models.gpt2 import (
     GPT2DoubleHeads,
@@ -147,10 +151,6 @@ def test_gpt2(model, val_loader, args, logger=None, timer=None, writer=None):
 def train_gpt2(model, opt, scheduler, train_loader, val_loader, args,
                log_dir, writer=None, logger=None, timer=None, start_epoch=0,
                totals=(0.0, 0.0)):
-    from commefficient_tpu.federated.checkpoint import (
-        maybe_save_run_state,
-    )
-
     timer = timer or Timer()
     total_download, total_upload = totals
     for epoch in range(start_epoch, math.ceil(args.num_epochs)):
@@ -258,8 +258,6 @@ def train(argv=None):
                          timer=timer)
     start_epoch, totals = 0, (0.0, 0.0)
     if args.resume:
-        from commefficient_tpu.federated.checkpoint import load_run_state
-
         start_epoch, totals = load_run_state(args.resume, fed_model, opt,
                                              scheduler)
         print(f"resumed run state from {args.resume} "

@@ -514,3 +514,22 @@ class TestFusedGradientParity:
     def test_forcing_fused_on_ineligible_config_raises(self):
         with pytest.raises(AssertionError):
             _setup(fuse=True, local_momentum=0.9)
+
+    def test_fused_path_engages_for_bench_configs(self, monkeypatch):
+        """Regression guard: the eligibility predicate must keep the fused
+        path ON for the headline bench configs (sketch-after-sum and plain
+        uncompressed) — local_step should never be traced there."""
+        import commefficient_tpu.federated.rounds as R
+
+        calls = []
+        orig = R.local_step
+
+        def spy(*a, **kw):
+            calls.append(1)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(R, "local_step", spy)
+        for mode, et in (("sketch", "virtual"), ("uncompressed", "none")):
+            flat, train_step, _, ss, cs = _setup(mode=mode, error_type=et)
+            train_step(flat, ss, cs, {}, _batch(), 0.1, jax.random.key(0))
+        assert not calls, "per-client local_step traced on a fused config"
