@@ -159,8 +159,20 @@ def validate_args(args):
         # --device cpu debugs an entrypoint without claiming the TPU).
         # Once the backend is initialized the update silently has no
         # effect, so detect that case and say so instead of running on
-        # the wrong device without a word.
+        # the wrong device without a word. `--device tpu` means "the TPU
+        # platform, whatever it registers as" — here that can be the
+        # axon tunnel plugin (utils.TPU_BACKENDS), so never override an
+        # env that already routes to a TPU platform with the literal
+        # string 'tpu', which is not a registered platform there.
+        import os as _os
+
         import jax
+
+        from commefficient_tpu.utils import TPU_BACKENDS
+
+        def satisfies(platform: str) -> bool:
+            return (platform == args.device
+                    or (args.device == "tpu" and platform in TPU_BACKENDS))
 
         initialized = False
         try:
@@ -169,10 +181,12 @@ def validate_args(args):
             initialized = xla_bridge.backends_are_initialized()
         except Exception:  # noqa: BLE001 — private API; fail open
             pass
-        if initialized and jax.default_backend() != args.device:
-            print(f"--device {args.device} ignored: JAX backend already "
-                  f"initialized on {jax.default_backend()!r}")
-        else:
+        if initialized:
+            if not satisfies(jax.default_backend()):
+                print(f"--device {args.device} ignored: JAX backend already "
+                      f"initialized on {jax.default_backend()!r}")
+        elif not any(satisfies(p) for p in
+                     _os.environ.get("JAX_PLATFORMS", "").split(",") if p):
             jax.config.update("jax_platforms", args.device)
     return args
 

@@ -286,7 +286,8 @@ class TestDeviceFlag:
         """--device wires through to jax.config.update('jax_platforms', ...)
         (round-1 verdict flagged it as parsed-and-ignored). Asserting on
         jax.default_backend() would be vacuous here — the suite env pins
-        JAX_PLATFORMS=cpu — so spy on the config update itself."""
+        JAX_PLATFORMS=cpu — so spy on the config update itself, with the
+        env var cleared so the request is not already satisfied."""
         import jax
 
         from commefficient_tpu.config import parse_args
@@ -294,8 +295,28 @@ class TestDeviceFlag:
         calls = []
         monkeypatch.setattr(jax.config, "update",
                             lambda k, v: calls.append((k, v)))
+        monkeypatch.setattr("jax._src.xla_bridge.backends_are_initialized",
+                            lambda: False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
         parse_args(argv=["--device", "cpu"])
         assert ("jax_platforms", "cpu") in calls
+
+    def test_device_tpu_respects_axon_platform(self, monkeypatch):
+        """--device tpu must NOT override an env that routes the TPU through
+        a differently-named plugin (the axon tunnel registers as 'axon', and
+        the literal platform string 'tpu' does not exist there)."""
+        import jax
+
+        from commefficient_tpu.config import parse_args
+
+        calls = []
+        monkeypatch.setattr(jax.config, "update",
+                            lambda k, v: calls.append((k, v)))
+        monkeypatch.setattr("jax._src.xla_bridge.backends_are_initialized",
+                            lambda: False)
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        parse_args(argv=["--device", "tpu"])
+        assert not calls
 
     def test_device_flag_warns_when_backend_initialized(self, monkeypatch,
                                                         capsys):
@@ -313,3 +334,18 @@ class TestDeviceFlag:
         parse_args(argv=["--device", "cpu"])
         assert not calls
         assert "ignored" in capsys.readouterr().out
+
+
+class TestProfiling:
+    def test_profile_writes_trace(self, tmp_path, monkeypatch):
+        """--profile traces a window of training steps via jax.profiler
+        (the tracing subsystem replacing the reference's commented-out
+        cProfile scaffolding, reference fed_aggregator.py:32-52)."""
+        profile_dir = tmp_path / "profiles"
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "uncompressed", "--local_momentum", "0",
+            "--profile", "--profile_dir", str(profile_dir),
+            "--profile_steps", "1"])
+        assert np.isfinite(summary["train_loss"])
+        traces = list(profile_dir.rglob("*.xplane.pb"))
+        assert traces, f"no xplane trace written under {profile_dir}"
