@@ -185,9 +185,21 @@ def validate_args(args):
             if not satisfies(jax.default_backend()):
                 print(f"--device {args.device} ignored: JAX backend already "
                       f"initialized on {jax.default_backend()!r}")
-        elif not any(satisfies(p) for p in
-                     _os.environ.get("JAX_PLATFORMS", "").split(",") if p):
-            jax.config.update("jax_platforms", args.device)
+        else:
+            env = [p.strip() for p in
+                   _os.environ.get("JAX_PLATFORMS", "").split(",")
+                   if p.strip()]
+            if not (env and satisfies(env[0])):
+                # JAX uses the FIRST listed platform, so only that entry
+                # counts as already-satisfying. For --device tpu prefer a
+                # TPU platform name the env already knows (the tunnel
+                # plugin's name) over the literal 'tpu', which may not be
+                # a registered platform on such hosts.
+                target = args.device
+                if args.device == "tpu":
+                    target = next((p for p in env if p in TPU_BACKENDS),
+                                  "tpu")
+                jax.config.update("jax_platforms", target)
     return args
 
 

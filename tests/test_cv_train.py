@@ -349,3 +349,22 @@ class TestProfiling:
         assert np.isfinite(summary["train_loss"])
         traces = list(profile_dir.rglob("*.xplane.pb"))
         assert traces, f"no xplane trace written under {profile_dir}"
+
+    def test_device_tpu_with_priority_list_reorders_to_tpu_platform(
+            self, monkeypatch):
+        """JAX picks the FIRST listed platform, so --device tpu with
+        JAX_PLATFORMS='cpu,axon' must update the config to the env's TPU
+        platform name (axon), not skip (runs on cpu) nor pass the literal
+        'tpu' (unregistered there)."""
+        import jax
+
+        from commefficient_tpu.config import parse_args
+
+        calls = []
+        monkeypatch.setattr(jax.config, "update",
+                            lambda k, v: calls.append((k, v)))
+        monkeypatch.setattr("jax._src.xla_bridge.backends_are_initialized",
+                            lambda: False)
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu, axon")
+        parse_args(argv=["--device", "tpu"])
+        assert calls == [("jax_platforms", "axon")]
