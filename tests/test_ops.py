@@ -2,6 +2,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from hypothesis import given, settings, strategies as st
 
 from commefficient_tpu.ops import (
     clip_by_l2,
@@ -251,3 +252,62 @@ class TestEstimatesPallasKernel:
         cs = make_sketch(d=3 * 1300 * 128, c=1300 * 128, r=5, seed=9)
         assert cs.sublanes > 1024  # really exercises G > 1
         self._compare(cs)
+
+
+class TestTopkEdges:
+    """Radix-descent edge cases: infinities, exact ties at the cut,
+    denormals, and k >= nonzero count."""
+
+    def test_inf_is_a_regular_top_magnitude(self):
+        v = np.array([1.0, -np.inf, 0.5, 3.0, np.inf, -0.1], np.float32)
+        out = np.asarray(topk(jnp.asarray(v), 2))
+        np.testing.assert_array_equal(out, [0, -np.inf, 0, 0, np.inf, 0])
+
+    def test_ties_at_cut_are_all_kept(self):
+        # tie-inclusive by design (lax.top_k would break ties by index)
+        v = np.zeros(100, np.float32)
+        v[:10] = 3.0
+        v[10:20] = -3.0
+        v[20:30] = 1.0
+        out = np.asarray(topk(jnp.asarray(v), 15))
+        assert (np.abs(out) == 3.0).sum() == 20  # all tied values kept
+        assert (out != 0).sum() == 20
+
+    def test_denormals_select_exactly(self):
+        rng = np.random.RandomState(5)
+        v = (rng.randn(4096) * 1e-40).astype(np.float32)  # subnormal range
+        assert np.all(np.abs(v[v != 0]) < np.finfo(np.float32).tiny)
+        out = np.asarray(topk(jnp.asarray(v), 64))
+        expected = set(np.argsort(np.abs(v))[-64:])
+        assert set(np.flatnonzero(out)) <= expected | set(
+            np.flatnonzero(np.abs(v) == np.sort(np.abs(v))[-64]))
+        assert (out != 0).sum() >= 64
+
+
+class TestSketchProperties:
+    """Property-based checks over random geometries (hypothesis)."""
+
+    @given(d=st.integers(64, 2000), c=st.integers(16, 384),
+           r=st.integers(1, 5), seed=st.integers(0, 10_000))
+    @settings(max_examples=15, deadline=None)
+    def test_linearity_random_geometry(self, d, c, r, seed):
+        cs = make_sketch(d, c, r, seed=seed, num_blocks=1)
+        rng = np.random.RandomState(seed % 997)
+        a = jnp.asarray(rng.randn(d), jnp.float32)
+        b = jnp.asarray(rng.randn(d), jnp.float32)
+        lhs = np.asarray(sketch_vec(cs, a + b))
+        rhs = np.asarray(sketch_vec(cs, a)) + np.asarray(sketch_vec(cs, b))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-5, atol=1e-5)
+
+    @given(d=st.integers(16, 120), r=st.integers(1, 5),
+           seed=st.integers(0, 10_000))
+    @settings(max_examples=15, deadline=None)
+    def test_single_chunk_round_trip(self, d, r, seed):
+        """With T == 1 (c_pad >= d) each row is a signed permutation, so
+        estimates() inverts sketch_vec() exactly for any r."""
+        cs = make_sketch(d, 128, r, seed=seed, num_blocks=1)
+        assert cs.T == 1
+        rng = np.random.RandomState(seed % 991)
+        v = jnp.asarray(rng.randn(d), jnp.float32)
+        got = np.asarray(estimates(cs, sketch_vec(cs, v)))
+        np.testing.assert_array_equal(got, np.asarray(v))
