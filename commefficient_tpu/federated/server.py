@@ -183,14 +183,3 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
         error = velocity
     return update * lr, ServerState(velocity, error)
 
-
-def mask_client_velocities(
-    client_velocities: jax.Array, client_ids: jax.Array, update: jax.Array
-) -> jax.Array:
-    """true_topk momentum factor masking of *local* velocities: zero the
-    participating clients' velocity entries at the global top-k coordinates
-    (reference fed_aggregator.py:525-533). ``client_velocities`` is
-    ``(num_clients, grad_size)``; ``update`` dense ``(d,)``."""
-    nz = (update != 0).astype(client_velocities.dtype)
-    rows = client_velocities[client_ids] * (1.0 - nz)[None, :]
-    return client_velocities.at[client_ids].set(rows)

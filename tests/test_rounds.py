@@ -533,3 +533,27 @@ class TestFusedGradientParity:
             flat, train_step, _, ss, cs = _setup(mode=mode, error_type=et)
             train_step(flat, ss, cs, {}, _batch(), 0.1, jax.random.key(0))
         assert not calls, "per-client local_step traced on a fused config"
+
+
+class TestTrueTopkVelocityMasking:
+    def test_participating_velocities_masked_at_update_coords(self):
+        """Server-side momentum factor masking (reference
+        fed_aggregator.py:525-533): after the round, every participating
+        client's velocity row is zero exactly at the global top-k update
+        coordinates — fused into the state scatter in rounds.server_step."""
+        flat, train_step, _, ss, cs = _setup(mode="true_topk",
+                                             error_type="virtual", k=2,
+                                             local_momentum=0.9)
+        batch = _batch()
+        new_ps, ss1, cs1, _, _ = train_step(flat, ss, cs, {}, batch, 0.1,
+                                            jax.random.key(0))
+        update_nz = np.asarray(new_ps) != 0
+        assert update_nz.sum() == 2
+        vel = np.asarray(cs1.velocities)
+        for cid in range(8):  # every slot participated
+            assert np.all(vel[cid][update_nz] == 0.0), cid
+            # ...and ONLY at those coordinates: local momentum off the
+            # top-k set must survive (gradients are generically nonzero)
+            assert np.any(vel[cid][~update_nz] != 0.0), cid
+        # non-participants keep whatever they had (zeros here, but the
+        # padding test above pins the sentinel case)

@@ -7,7 +7,6 @@ from commefficient_tpu.federated.server import (
     ServerConfig,
     ServerState,
     init_server_state,
-    mask_client_velocities,
     server_update,
 )
 from commefficient_tpu.ops.sketch import make_sketch, sketch_vec
@@ -157,14 +156,3 @@ class TestSketched:
         with pytest.raises(AssertionError):
             ServerConfig(mode="sketch", error_type="virtual", local_momentum=0.9)
 
-
-class TestClientVelocityMasking:
-    def test_masks_only_participating_rows(self):
-        cv = jnp.ones((4, 6))
-        update = jnp.array([1.0, 0, 0, 2.0, 0, 0])
-        ids = jnp.array([1, 3])
-        out = np.asarray(mask_client_velocities(cv, ids, update))
-        np.testing.assert_allclose(out[0], 1.0)
-        np.testing.assert_allclose(out[2], 1.0)
-        np.testing.assert_allclose(out[1], [0, 1, 1, 0, 1, 1])
-        np.testing.assert_allclose(out[3], [0, 1, 1, 0, 1, 1])
