@@ -68,9 +68,15 @@ class ServerConfig:
             assert self.error_type in ("local", "none")
         if self.mode == "sketch":
             if self.error_type == "local":
-                assert self.virtual_momentum == 0
+                assert self.virtual_momentum == 0, \
+                    "sketch + local error carries momentum locally: set " \
+                    "--virtual_momentum 0"
             if self.error_type == "virtual":
-                assert self.local_momentum == 0
+                assert self.local_momentum == 0, \
+                    "sketch + virtual error carries momentum on the " \
+                    "server: set --local_momentum 0 (the CLI default 0.9 " \
+                    "mirrors the reference and must be overridden for " \
+                    "the FetchSGD recipe)"
 
 
 class ServerState(NamedTuple):

@@ -1,6 +1,6 @@
 from commefficient_tpu.ops.topk import topk
 from commefficient_tpu.ops.clip import clip_by_l2
-from commefficient_tpu.ops.flat import ravel_pytree, make_unravel
+from commefficient_tpu.ops.flat import ravel_pytree
 from commefficient_tpu.ops.sketch import (
     CountSketch,
     make_sketch,
@@ -13,7 +13,6 @@ __all__ = [
     "topk",
     "clip_by_l2",
     "ravel_pytree",
-    "make_unravel",
     "CountSketch",
     "make_sketch",
     "sketch_vec",

@@ -22,12 +22,3 @@ def ravel_pytree(params: Any) -> Tuple[jax.Array, Callable[[jax.Array], Any]]:
     flat, unravel = _ravel_pytree(params)
     return flat.astype(jnp.float32), unravel
 
-
-def make_unravel(params: Any) -> Tuple[int, Callable[[jax.Array], Any]]:
-    """Return (grad_size, unravel) for a template pytree.
-
-    ``grad_size`` is the reference's count of trainable scalars
-    (reference fed_aggregator.py:81-88).
-    """
-    flat, unravel = _ravel_pytree(params)
-    return int(flat.size), unravel

@@ -8,7 +8,6 @@ from commefficient_tpu.ops import (
     clip_by_l2,
     l2estimate,
     make_sketch,
-    make_unravel,
     ravel_pytree,
     sketch_vec,
     topk,
@@ -115,8 +114,8 @@ class TestFlat:
 
     def test_grad_size(self):
         tree = {"w": jnp.zeros((5, 5)), "b": jnp.zeros((5,))}
-        size, unravel = make_unravel(tree)
-        assert size == 30
+        flat, _ = ravel_pytree(tree)
+        assert flat.size == 30
 
 
 class TestSketch:
