@@ -30,6 +30,8 @@ from commefficient_tpu.data_utils.tokenization import (
 )
 from commefficient_tpu.federated import FedModel, FedOptimizer, LambdaLR
 from commefficient_tpu.federated.checkpoint import (
+    load_checkpoint,
+    load_matching,
     load_run_state,
     maybe_save_run_state,
 )
@@ -189,6 +191,12 @@ def train(argv=None):
     tokenizer.add_special_tokens(ATTR_TO_SPECIAL_TOKEN)
     args.len_tokenizer = len(tokenizer)
 
+    # --finetune points the MODEL load at a previously saved run dir while
+    # the tokenizer stays that of the base checkpoint, then trains normally
+    # (reference gpt2_train.py:270-273)
+    if args.do_finetune and not args.do_test:
+        args.model_checkpoint = args.finetune_path
+
     # sequence parallelism (--seq_parallel ring|ulysses): attention runs
     # over the global sequence sharded across the mesh's `seq` axis
     sp = args.seq_parallel != "none"
@@ -240,6 +248,20 @@ def train(argv=None):
     if pretrained is not None:
         init_params = resize_token_embeddings(pretrained, args.len_tokenizer)
         print("loaded local pretrained GPT-2 weights")
+    elif os.path.exists(os.path.join(args.model_checkpoint, "model.npz")):
+        # a run dir this framework saved (save_pretrained → model.npz):
+        # the finetune round trip, since HF-format checkpoints are rarely
+        # present in the zero-egress environment
+        ckpt_params, _ = load_checkpoint(
+            os.path.join(args.model_checkpoint, "model"))
+        init_params, loaded, skipped = load_matching(init_params, ckpt_params)
+        assert loaded > 0, (
+            f"--finetune checkpoint {args.model_checkpoint} shares no "
+            f"tensor shapes with the current model geometry "
+            f"(COMMEFFICIENT_TINY_MODEL / COMMEFFICIENT_GPT2_SEQ_LEN "
+            f"mismatch?) — refusing to silently train from scratch")
+        print(f"loaded saved run dir: {loaded} tensors, "
+              f"fresh: {len(skipped)}")
 
     args.num_results_train = 1
     args.num_results_val = 2
@@ -253,9 +275,6 @@ def train(argv=None):
                                   [args.lr_scale, 0.0])
     scheduler = LambdaLR(opt, lr_lambda=lambda s: lr_schedule(s))
 
-    if args.do_finetune:
-        return test_gpt2(fed_model, val_loader, args, logger=TableLogger(),
-                         timer=timer)
     start_epoch, totals = 0, (0.0, 0.0)
     if args.resume:
         start_epoch, totals = load_run_state(args.resume, fed_model, opt,

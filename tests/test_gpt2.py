@@ -252,3 +252,39 @@ class TestResume:
         assert np.isfinite(stats2["val_nll"])
         np.testing.assert_allclose(stats2["val_nll"], stats["val_nll"],
                                    rtol=1e-5)
+
+
+class TestFinetune:
+    def test_finetune_roundtrip_trains_from_saved_run(self, tmp_path,
+                                                      monkeypatch, capsys):
+        """--finetune points the model load at a previously saved run dir
+        and then trains normally (reference gpt2_train.py:270-273); the
+        tokenizer stays that of the base checkpoint."""
+        import gpt2_train
+
+        common = [
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "0.3",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "uncompressed",
+            "--local_momentum", "0",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+        ]
+        run1 = tmp_path / "run1"
+        monkeypatch.setattr(gpt2_train, "make_logdir", lambda a: str(run1))
+        stats = gpt2_train.train(argv=common)
+        assert np.isfinite(stats["val_nll"])
+        assert (run1 / "model.npz").exists()
+
+        run2 = tmp_path / "run2"
+        monkeypatch.setattr(gpt2_train, "make_logdir", lambda a: str(run2))
+        stats2 = gpt2_train.train(argv=common + [
+            "--finetune", "--finetune_path", str(run1)])
+        out = capsys.readouterr().out
+        assert "loaded saved run dir" in out
+        assert np.isfinite(stats2["val_nll"])
