@@ -277,11 +277,18 @@ def build_round_step(
 
         if cfg.do_test:
             # smoke mode: skip fwd/bwd, all-ones transmit
-            # (reference fed_worker.py:117-122)
+            # (reference fed_worker.py:117-122); the fake metrics tuple must
+            # match the workload's real (loss, *metrics, count) arity — CV
+            # has an accuracy metric, GPT-2 none
             shape = sketch.table_shape if wcfg.mode == "sketch" else \
                 (cfg.grad_size,)
             transmit = jnp.ones(shape, jnp.float32)
-            metrics = (jnp.ones(()), jnp.ones(()), batch_row["mask"].sum())
+            n_metrics = probe_n_metrics(compute_loss_train,
+                                        unravel(weights_used), model_state,
+                                        batch_row)
+            metrics = (jnp.ones(()),) + tuple(
+                jnp.ones(()) for _ in range(n_metrics)) + \
+                (batch_row["mask"].sum(),)
             new_vel, new_err, new_ms = vel_row, err_row, model_state
         elif wcfg.mode == "fedavg":
             res, new_ms = fedavg_local(compute_loss_train, weights_used,

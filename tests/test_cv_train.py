@@ -368,3 +368,15 @@ class TestProfiling:
         monkeypatch.setenv("JAX_PLATFORMS", "cpu, axon")
         parse_args(argv=["--device", "tpu"])
         assert calls == [("jax_platforms", "axon")]
+
+
+class TestSmokeMode:
+    def test_do_test_fake_round(self, tmp_path, monkeypatch):
+        """--test: 1-channel shrunken model, 1x10/k=10 sketch, all-ones
+        transmits, loops break after one batch (reference cv_train.py:329-336,
+        fed_worker.py:117-122 — how the reference smoke-tested its plumbing
+        without compute)."""
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "sketch", "--error_type", "virtual",
+            "--local_momentum", "0", "--test"])
+        assert summary is not None and np.isfinite(summary["train_loss"])

@@ -292,3 +292,26 @@ class TestFinetune:
         out = capsys.readouterr().out
         assert "loaded saved run dir" in out
         assert np.isfinite(stats2["val_nll"])
+
+
+class TestSmokeMode:
+    def test_do_test_fake_round(self, tmp_path):
+        """--test through gpt2_train: skip middle batches, all-ones
+        transmits (reference gpt2_train.py:189-191, 245-247)."""
+        import gpt2_train
+
+        stats = gpt2_train.train(argv=[
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "1",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "uncompressed",
+            "--local_momentum", "0",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+            "--test",
+        ])
+        assert np.isfinite(stats["val_nll"])
