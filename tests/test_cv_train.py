@@ -380,3 +380,24 @@ class TestSmokeMode:
             "--mode", "sketch", "--error_type", "virtual",
             "--local_momentum", "0", "--test"])
         assert summary is not None and np.isfinite(summary["train_loss"])
+
+
+class TestMoreFlagCoverage:
+    def test_fedavg_multi_epoch_with_decay(self, tmp_path, monkeypatch):
+        """FedAvg local training: 2 local epochs over fedavg_batch_size
+        chunks with per-step lr decay (reference fed_worker.py:61-113,
+        utils.py:155-157)."""
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "fedavg", "--local_batch_size", "-1",
+            "--local_momentum", "0", "--error_type", "none",
+            "--num_fedavg_epochs", "2", "--fedavg_batch_size", "8",
+            "--fedavg_lr_decay", "0.9"])
+        assert np.isfinite(summary["train_loss"])
+
+    def test_cv_microbatch(self, tmp_path, monkeypatch):
+        """--microbatch_size gradient accumulation on the CV path
+        (reference fed_worker.py:256-270)."""
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "uncompressed", "--local_momentum", "0",
+            "--microbatch_size", "2"])
+        assert np.isfinite(summary["train_loss"])
