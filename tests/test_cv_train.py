@@ -401,3 +401,21 @@ class TestMoreFlagCoverage:
             "--mode", "uncompressed", "--local_momentum", "0",
             "--microbatch_size", "2"])
         assert np.isfinite(summary["train_loss"])
+
+    def test_sketch_with_topk_down(self, tmp_path, monkeypatch):
+        """--topk_down composes with sketch mode (stale weights per client,
+        sketched uploads — reference fed_worker.py:151-157 + 311-320)."""
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "sketch", "--error_type", "virtual",
+            "--local_momentum", "0", "--k", "500", "--num_cols", "2048",
+            "--num_rows", "3", "--num_blocks", "2", "--topk_down"])
+        assert np.isfinite(summary["train_loss"])
+
+    def test_uncompressed_local_momentum_and_error(self, tmp_path,
+                                                   monkeypatch):
+        """Dense per-client velocity + error feedback through the CLI
+        (reference fed_worker.py:193-202)."""
+        summary = _run(tmp_path, monkeypatch, [
+            "--mode", "uncompressed", "--error_type", "local",
+            "--local_momentum", "0.9"])
+        assert np.isfinite(summary["train_loss"])
