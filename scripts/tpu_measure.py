@@ -1,0 +1,142 @@
+"""Honest on-chip measurement batch for the current HEAD.
+
+Timing rules for the tunneled bench chip (see BASELINE.md and the verify
+skill): chain dependent calls inside one loop, end every timed region with a
+scalar materialization (the tunnel runtime is lazy; ``block_until_ready``
+alone undercounts), subtract the measured scalar-fetch round trip, and take
+best-of-N against tenancy noise.
+
+Measures: the CIFAR and GPT-2 (f32/bf16) fused federated rounds, per-op
+sketch/estimates/top-k costs at both FetchSGD geometries, and the
+touched-cells A/B — a sparse-scatter candidate replacement for the server's
+dense re-sketch of the k-sparse update (equivalent masks verified on CPU;
+integrate only if flatnonzero+scatter beats the ~2/9 ms dense re-sketch).
+
+Run on the real chip (claims the tunnel):  python scripts/tpu_measure.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import bench as B
+from commefficient_tpu.ops import sketch as sk
+from commefficient_tpu.ops.topk import topk
+
+_LANES = 128
+
+
+def drain(x):
+    return float(jnp.asarray(x).ravel()[0])
+
+
+def rtt_measure(x):
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        drain(x)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def time_rounds(steps, state0, batch, iters=20, reps=3, lr=0.1):
+    rng = jax.random.key(0)
+    state = state0
+    for _ in range(3):
+        out = steps.train_step(*state, batch, lr, rng)
+        state = out[:4]
+        drain(state[0])
+    rtt = rtt_measure(state[0])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = steps.train_step(*state, batch, lr, rng)
+            state = out[:4]
+        drain(state[0])
+        best = min(best, max(time.perf_counter() - t0 - rtt, 1e-9))
+    return best / iters, rtt
+
+
+def chained(f, x0, n=5, K=20):
+    @jax.jit
+    def body(x):
+        for _ in range(K):
+            x = f(x)
+        return x
+
+    r = body(x0)
+    drain(r)
+    rtt = rtt_measure(r)
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        r = body(x0)
+        drain(r)
+        best = min(best, (time.perf_counter() - t0 - rtt) / K * 1e3)
+    return best
+
+
+def touched_cells(cs, update, k_max):
+    """Sparse-scatter equivalent of ``sketch_vec(cs, update) != 0``."""
+    idx = jnp.flatnonzero(update, size=k_max, fill_value=cs.d)
+    pos = (idx % cs.c_pad).astype(jnp.int32)
+    chunk = (idx // cs.c_pad).astype(jnp.int32)
+    m = cs.shift_q * _LANES + cs.shift_w
+    out = jnp.zeros((cs.r, cs.c_pad), bool)
+    oob = idx >= cs.d
+    for j in range(cs.r):
+        cell = (pos + m[j, jnp.clip(chunk, 0, cs.T - 1)]) % cs.c_pad
+        cell = jnp.where(oob, cs.c_pad, cell)
+        out = out.at[j, cell].set(True, mode="drop")
+    return out
+
+
+def main():
+    print("backend:", jax.default_backend(), flush=True)
+
+    steps, ps, ss, cs, batch = B.build(tiny=False)
+    dt, rtt = time_rounds(steps, (ps, ss, cs, {}), batch)
+    print(f"CIFAR round: {dt * 1e3:.2f} ms ({1 / dt:.1f} r/s), "
+          f"rtt {rtt * 1e3:.0f} ms", flush=True)
+    del steps, ps, ss, cs, batch
+
+    for d in (6_568_640, 124_444_417):
+        geo = sk.make_sketch(d, c=500_000, r=5, seed=42, num_blocks=20)
+        v = jnp.asarray(np.random.RandomState(0).randn(d).astype(np.float32))
+        tbl = sk.sketch_vec(geo, v)
+        est = sk.estimates(geo, tbl)
+        upd = topk(est, 50_000)
+        drain(upd)
+        t_resk = chained(
+            lambda u: u + sk.sketch_vec(geo, u)[0, 0] * 1e-38, upd)
+        t_tc = chained(
+            lambda u: u + touched_cells(geo, u, 50_064)[0, 0] * 1e-38, upd)
+        t_topk = chained(lambda x: topk(x, 50_000), est)
+        t_sv = chained(lambda x: x + sk.sketch_vec(geo, x)[0, 0] * 1e-38, v)
+        t_es = chained(lambda t: sk.sketch_vec(geo, sk.estimates(geo, t)),
+                       tbl)
+        print(f"d={d}: resketch {t_resk:.2f} | touched-cells {t_tc:.2f} | "
+              f"topk {t_topk:.2f} | sketch_vec {t_sv:.2f} | "
+              f"est+sketch {t_es:.2f} ms", flush=True)
+        del geo, v, tbl, est, upd
+
+    for bf16 in (False, True):
+        steps, ps, ss, cs, batch, tokens = B.build_gpt2(bf16=bf16)
+        dt, _ = time_rounds(steps, (ps, ss, cs, {}), batch, iters=10)
+        tag = "bf16" if bf16 else "f32 "
+        print(f"GPT-2 {tag} round: {dt * 1e3:.2f} ms = "
+              f"{tokens / dt:,.0f} tokens/s", flush=True)
+        del steps, ps, ss, cs, batch
+
+
+if __name__ == "__main__":
+    main()
