@@ -82,14 +82,39 @@ class ByteTokenizer:
         return tok
 
 
+# Vendored byte-level BPE (the 256-token GPT-2 bytes->unicode alphabet,
+# no merges) so the default in-image path runs the reference's real
+# GPT2Tokenizer machinery (reference gpt2_train.py:262-273) instead of the
+# ByteTokenizer shim. Generated from
+# transformers.models.gpt2.tokenization_gpt2.bytes_to_unicode — the same
+# construction tests/test_gpt2_pretrained.py proves against the HF stack.
+VENDORED_BPE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "assets", "gpt2_bpe")
+
+
 def get_tokenizer(model_checkpoint: str = "gpt2"):
-    """HF GPT2Tokenizer when available locally; ByteTokenizer otherwise."""
+    """HF GPT2Tokenizer from the checkpoint when available locally, else
+    from the vendored byte-level BPE; ByteTokenizer as a last resort."""
     try:
         from transformers import GPT2Tokenizer
-
-        return GPT2Tokenizer.from_pretrained(model_checkpoint,
-                                             local_files_only=True)
     except Exception:
-        if os.path.isdir(model_checkpoint):
-            return ByteTokenizer.from_pretrained(model_checkpoint)
-        return ByteTokenizer()
+        GPT2Tokenizer = None
+    if GPT2Tokenizer is not None:
+        try:
+            return GPT2Tokenizer.from_pretrained(model_checkpoint,
+                                                 local_files_only=True)
+        except Exception:
+            pass
+    if os.path.isdir(model_checkpoint) and os.path.exists(
+            os.path.join(model_checkpoint, "byte_tokenizer.json")):
+        # a run dir saved by a ByteTokenizer round: keep the round trip
+        return ByteTokenizer.from_pretrained(model_checkpoint)
+    if GPT2Tokenizer is not None:
+        try:
+            return GPT2Tokenizer.from_pretrained(VENDORED_BPE_DIR,
+                                                 local_files_only=True)
+        except Exception:
+            pass
+    if os.path.isdir(model_checkpoint):
+        return ByteTokenizer.from_pretrained(model_checkpoint)
+    return ByteTokenizer()

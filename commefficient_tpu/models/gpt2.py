@@ -22,6 +22,8 @@ train from scratch.
 from __future__ import annotations
 
 
+import json
+
 import numpy as np
 
 import jax
@@ -194,9 +196,40 @@ def resize_token_embeddings(params, new_vocab_size: int, rng=None):
     return out
 
 
+def _load_safetensors(path: str):
+    """Read a .safetensors file with numpy alone (no torch, no safetensors
+    package): 8-byte little-endian header length, JSON header mapping tensor
+    name -> {dtype, shape, data_offsets}, then the raw tensor bytes."""
+    with open(path, "rb") as f:
+        n = int.from_bytes(f.read(8), "little")
+        header = json.loads(f.read(n).decode("utf-8"))
+        buf = f.read()
+    np_dtypes = {"F64": np.float64, "F32": np.float32, "F16": np.float16,
+                 "I64": np.int64, "I32": np.int32, "I16": np.int16,
+                 "I8": np.int8, "U8": np.uint8, "BOOL": np.bool_}
+    out = {}
+    for name, spec in header.items():
+        if name == "__metadata__":
+            continue
+        if spec["dtype"] == "BF16":
+            import ml_dtypes  # ships with jax
+
+            dtype = ml_dtypes.bfloat16
+        else:
+            dtype = np_dtypes[spec["dtype"]]
+        lo, hi = spec["data_offsets"]
+        out[name] = np.frombuffer(buf[lo:hi],
+                                  dtype=dtype).reshape(spec["shape"])
+    return out
+
+
 def load_hf_gpt2(params_template, checkpoint_dir: str):
-    """Convert locally cached HF GPT-2 torch weights into our layout.
-    Returns None when no local checkpoint exists (zero-egress default)."""
+    """Convert locally cached HF GPT-2 weights into our layout — either
+    ``pytorch_model.bin`` (via torch) or ``model.safetensors`` (parsed with
+    numpy alone, so safetensors-default modern checkpoints load without the
+    safetensors package). The reference loads any hub checkpoint (reference
+    gpt2_train.py:262-273). Returns None when no local checkpoint exists
+    (zero-egress default)."""
     import os
 
     candidates = [os.path.join(checkpoint_dir, f)
@@ -204,12 +237,12 @@ def load_hf_gpt2(params_template, checkpoint_dir: str):
     path = next((p for p in candidates if os.path.exists(p)), None)
     if path is None:
         return None
-    import torch
+    if path.endswith(".bin"):
+        import torch
 
-    state = torch.load(path, map_location="cpu") if path.endswith(".bin") \
-        else None
-    if state is None:
-        return None
+        state = torch.load(path, map_location="cpu")
+    else:
+        state = _load_safetensors(path)
     out = jax.tree_util.tree_map(np.asarray, params_template)
 
     def put(dst_keys, arr):

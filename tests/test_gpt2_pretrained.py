@@ -113,7 +113,50 @@ class TestWeightConversion:
             torch_model.transformer.wte.weight.detach().numpy())
 
 
+class TestSafetensors:
+    def test_logits_match_torch_from_safetensors(self, tmp_path):
+        """Modern HF checkpoints default to safetensors; ``load_hf_gpt2``
+        parses the format with numpy alone (8-byte header length + JSON
+        header + raw tensors) and must convert identically to the .bin
+        path (reference gpt2_train.py:262-273 loads any hub checkpoint)."""
+        ckpt = str(tmp_path / "st")
+        cfg = transformers.GPT2Config(
+            vocab_size=VOCAB, n_positions=POS, n_embd=EMBD, n_layer=LAYER,
+            n_head=HEAD, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+        torch.manual_seed(1)
+        model = transformers.GPT2LMHeadModel(cfg).eval()
+        model.save_pretrained(ckpt, safe_serialization=True)
+        assert os.path.exists(os.path.join(ckpt, "model.safetensors"))
+        assert not os.path.exists(os.path.join(ckpt, "pytorch_model.bin"))
+
+        ours = GPT2DoubleHeads(vocab_size=VOCAB, n_positions=POS,
+                               n_embd=EMBD, n_layer=LAYER, n_head=HEAD,
+                               dropout=0.0)
+        ids_np = np.random.RandomState(2).randint(0, VOCAB, (2, 16))
+        template = ours.init(jax.random.key(0),
+                             jnp.asarray(ids_np, jnp.int32),
+                             train=False)["params"]
+        converted = load_hf_gpt2(template, ckpt)
+        assert converted is not None, "safetensors checkpoint not found"
+        lm_ours, _ = ours.apply({"params": converted},
+                                jnp.asarray(ids_np, jnp.int32), train=False)
+        with torch.no_grad():
+            lm_torch = model(torch.tensor(ids_np)).logits.numpy()
+        np.testing.assert_allclose(np.asarray(lm_ours), lm_torch,
+                                   atol=2e-3, rtol=2e-3)
+
+
 class TestRealTokenizer:
+    def test_default_checkpoint_uses_vendored_real_bpe(self, tmp_path):
+        """The in-image default path (``--model_checkpoint gpt2``, no local
+        HF cache) must return a real ``transformers.GPT2Tokenizer`` backed
+        by the vendored byte-level BPE, not the ByteTokenizer shim
+        (reference gpt2_train.py:262-273 uses the real BPE machinery)."""
+        tok = get_tokenizer(str(tmp_path / "nonexistent-checkpoint"))
+        assert isinstance(tok, transformers.GPT2Tokenizer)
+        enc = tok.encode("hi there")
+        assert tok.decode(enc) == "hi there"
+
     def test_get_tokenizer_returns_gpt2_tokenizer(self, hf_checkpoint):
         ckpt, _ = hf_checkpoint
         tok = get_tokenizer(ckpt)
