@@ -154,10 +154,11 @@ def make_sketch(d: int, c: int, r: int, seed: int = 42,
     m = rng.randint(0, c_pad, size=(r, T))
     inv = (-m) % c_pad
     keys = rng.randint(1, 2**31 - 1, size=(r,))
-    # primary trigger for the one-time query-kernel self-check: sketch
+    # primary trigger for the one-time kernel self-checks: sketch
     # geometry construction is always eager host-side setup, while
-    # ``estimates`` itself usually runs inside a jit trace where the
-    # check cannot execute
+    # ``sketch_vec``/``estimates`` themselves usually run inside a jit
+    # trace where the checks cannot execute
+    _check_sketch_kernel_once(eager=True)
     _check_estimates_kernel_once(eager=True)
     return CountSketch(
         shift_q=jnp.asarray(m // _LANES, jnp.int32),
@@ -300,6 +301,16 @@ def _use_pallas() -> bool:
             and os.environ.get("COMMEFFICIENT_PALLAS", "1") != "0")
 
 
+def _use_pallas_sketch() -> bool:
+    """Kill-switch for the accumulate kernel, separate from the query
+    kernel's, so a Mosaic regression in either path can be disabled without
+    losing the other."""
+    import os
+
+    return (_use_pallas()
+            and os.environ.get("COMMEFFICIENT_PALLAS_SKETCH", "1") != "0")
+
+
 def _use_pallas_estimates() -> bool:
     """Separate kill-switch for the query kernel so a failure there (newer,
     DMA-based) can be disabled without losing the proven accumulate kernel."""
@@ -368,13 +379,59 @@ def _check_estimates_kernel_once(eager: bool = False) -> None:
             f"pure XLA query path", RuntimeWarning)
 
 
+_SKETCH_KERNEL_CHECKED = False
+
+
+def _check_sketch_kernel_once(eager: bool = False) -> None:
+    """One-time on-TPU self-check of the accumulate kernel, mirroring
+    ``_check_estimates_kernel_once``: bit-compare ``_sketch_vec_pallas``
+    against ``_sketch_vec_jax`` at a multi-chunk (T > 1) geometry and
+    disable the kernel via its env kill-switch on any compile failure or
+    mismatch — a Mosaic regression here would otherwise silently corrupt
+    every sketched round. Primary trigger is ``make_sketch`` (always eager
+    host-side setup); ``sketch_vec`` also triggers it when called eagerly,
+    covering CountSketch objects that bypassed ``make_sketch`` (e.g.
+    deserialized ones)."""
+    global _SKETCH_KERNEL_CHECKED
+    if _SKETCH_KERNEL_CHECKED:
+        return
+    if not _use_pallas_sketch():
+        return
+    if not eager and not _trace_state_clean():
+        return
+    _SKETCH_KERNEL_CHECKED = True
+    import os
+    import warnings
+
+    try:
+        cs = make_sketch(d=450_000, c=140_000, r=3, seed=11, num_blocks=2)
+        v = jnp.asarray(
+            np.random.RandomState(6).randn(cs.d), jnp.float32)
+        got = _sketch_vec_pallas(
+            _chunks3(cs, v), cs.shift_q, cs.shift_w, cs.sign_keys,
+            S=cs.sublanes, T=cs.T).reshape(cs.r, cs.c_pad)
+        want = _sketch_vec_jax(cs, v)
+        if not np.array_equal(np.asarray(got), np.asarray(want)):
+            raise AssertionError("kernel output != pure XLA path")
+    except Exception as e:  # noqa: BLE001 — any failure means: don't use it
+        os.environ["COMMEFFICIENT_PALLAS_SKETCH"] = "0"
+        warnings.warn(
+            f"Pallas sketch accumulate kernel self-check failed "
+            f"({type(e).__name__}: {str(e)[:200]}); falling back to the "
+            f"pure XLA accumulate path", RuntimeWarning)
+
+
 def sketch_vec(cs: CountSketch, v: jax.Array) -> jax.Array:
     """Accumulate a dense ``(d,)`` vector into an ``(r, c_pad)`` table.
 
     Equivalent of ``CSVec.accumulateVec`` + ``.table`` (reference
     fed_worker.py:313-320). Linear in ``v``.
     """
-    if _use_pallas():
+    if _trace_state_clean():
+        # entry point for sketches that bypassed make_sketch (e.g.
+        # deserialized): an eager first call still gets the self-check
+        _check_sketch_kernel_once(eager=True)
+    if _use_pallas_sketch():
         v3 = _chunks3(cs, v)
         out = _sketch_vec_pallas(v3, cs.shift_q, cs.shift_w, cs.sign_keys,
                                  S=cs.sublanes, T=cs.T)
@@ -496,10 +553,12 @@ def estimates(cs: CountSketch, table: jax.Array) -> jax.Array:
     """Median-of-rows unbiased estimate of every coordinate — ``(d,)``.
 
     The Pallas query kernel is self-checked once per process at
-    ``make_sketch`` time (the only ``CountSketch`` constructor); a process
-    that somehow obtains a sketch without constructing one (e.g.
-    deserialized) and only ever calls this inside a trace runs the kernel
-    unverified."""
+    ``make_sketch`` time (the only ``CountSketch`` constructor). A sketch
+    that bypassed ``make_sketch`` (e.g. deserialized) still gets the check
+    on an eager first call here; only the bypass-AND-first-call-inside-a-
+    trace combination runs the kernel unverified."""
+    if _trace_state_clean():
+        _check_estimates_kernel_once(eager=True)
     if _use_pallas_estimates():
         out = _estimates_pallas(
             _doubled_table(cs, table), cs.shift_q, cs.shift_w, cs.sign_keys,

@@ -211,6 +211,74 @@ class TestSketchPallasKernel:
         np.testing.assert_allclose(kern, pure, rtol=1e-6, atol=1e-6)
 
 
+class TestSketchKernelSelfCheck:
+    def _arm(self, monkeypatch, fake_pallas):
+        """Pretend we are on a TPU with a broken accumulate kernel."""
+        import commefficient_tpu.ops.sketch as sk
+        import commefficient_tpu.utils as utils
+
+        monkeypatch.setattr(utils, "is_tpu_backend", lambda: True)
+        monkeypatch.setattr(sk, "_SKETCH_KERNEL_CHECKED", False)
+        monkeypatch.setattr(sk, "_check_estimates_kernel_once",
+                            lambda eager=False: None)
+        monkeypatch.setenv("COMMEFFICIENT_PALLAS_SKETCH", "1")
+        monkeypatch.setattr(sk, "_sketch_vec_pallas", fake_pallas)
+        return sk
+
+    def test_forced_mismatch_disables_kernel_with_warning(self, monkeypatch):
+        """A mismatching accumulate kernel must be disabled at make_sketch
+        (env kill-switch + warning) so sketched rounds fall back to the
+        bit-correct pure XLA path instead of silently corrupting — the same
+        contract as the estimates kernel's self-check."""
+        import os
+
+        def zeros_kernel(v3, q, w, k, *, S, T, interpret=False):
+            return jnp.zeros((3, T * 0 + 140032), jnp.float32)
+
+        sk = self._arm(monkeypatch, zeros_kernel)
+        with pytest.warns(RuntimeWarning,
+                          match="sketch accumulate kernel self-check"):
+            cs = sk.make_sketch(d=2048, c=256, r=3, seed=1)
+        assert os.environ["COMMEFFICIENT_PALLAS_SKETCH"] == "0"
+        assert not sk._use_pallas_sketch()
+        # and sketch_vec now computes through the pure path, correctly
+        v = jnp.asarray(np.random.RandomState(0).randn(2048), jnp.float32)
+        np.testing.assert_array_equal(np.asarray(sk.sketch_vec(cs, v)),
+                                      np.asarray(sk._sketch_vec_jax(cs, v)))
+
+    def test_compile_failure_disables_kernel(self, monkeypatch):
+        """A kernel that cannot even compile (Mosaic regression) is likewise
+        caught and disabled rather than sinking the run."""
+        import os
+
+        def exploding_kernel(*a, **kw):
+            raise RuntimeError("mosaic lowering failed")
+
+        sk = self._arm(monkeypatch, exploding_kernel)
+        with pytest.warns(RuntimeWarning,
+                          match="sketch accumulate kernel self-check"):
+            sk.make_sketch(d=2048, c=256, r=3, seed=1)
+        assert os.environ["COMMEFFICIENT_PALLAS_SKETCH"] == "0"
+
+    def test_eager_sketch_vec_triggers_check(self, monkeypatch):
+        """A CountSketch that bypassed make_sketch (e.g. deserialized) still
+        gets the self-check on an eager first sketch_vec call."""
+        import commefficient_tpu.ops.sketch as sk
+
+        cs = sk.make_sketch(d=2048, c=256, r=3, seed=1)
+
+        def zeros_kernel(v3, q, w, k, *, S, T, interpret=False):
+            return jnp.zeros((3, T * 0 + 140032), jnp.float32)
+
+        sk = self._arm(monkeypatch, zeros_kernel)
+        v = jnp.asarray(np.random.RandomState(0).randn(2048), jnp.float32)
+        with pytest.warns(RuntimeWarning,
+                          match="sketch accumulate kernel self-check"):
+            out = sk.sketch_vec(cs, v)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(sk._sketch_vec_jax(cs, v)))
+
+
 class TestEstimatesPallasKernel:
     @staticmethod
     def _compare(cs):
