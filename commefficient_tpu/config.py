@@ -19,6 +19,7 @@ drive this framework unchanged. TPU-specific deviations, all documented here:
 from __future__ import annotations
 
 import argparse
+import os
 
 MODES = ["sketch", "true_topk", "local_topk", "fedavg", "uncompressed"]
 ERROR_TYPES = ["none", "local", "virtual"]
@@ -125,6 +126,15 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--seq_devices", type=int, default=2,
                         help="Size of the seq mesh axis when --seq_parallel "
                              "is enabled.")
+    # TPU-first extension: dropout/DP mask PRNG. threefry (JAX default) is
+    # counter-based ALU work; rbg uses the TPU hardware RNG and is much
+    # cheaper at GPT-2 mask volumes. unsafe_rbg additionally relaxes
+    # fold_in/split guarantees (fastest; fine for dropout).
+    parser.add_argument("--rng_impl",
+                        choices=["threefry2x32", "rbg", "unsafe_rbg"],
+                        default="threefry2x32",
+                        help="PRNG implementation for training randomness "
+                             "(dropout/DP noise).")
 
     # GPT2 args
     parser.add_argument("--model_checkpoint", type=str, default="gpt2")
@@ -137,6 +147,15 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--mc_coef", type=float, default=1.0)
     parser.add_argument("--max_grad_norm", type=float)
     parser.add_argument("--personality_permutations", type=int, default=1)
+    # TPU deviation: the reference pads each batch to the model max on the
+    # fly (fed_persona.py:360-392); XLA wants static shapes, so the pad
+    # length is a flag. COMMEFFICIENT_GPT2_SEQ_LEN is the deprecated
+    # round-1/2 env spelling, kept as the default's fallback.
+    parser.add_argument("--max_seq_len", type=int,
+                        default=int(os.environ.get(
+                            "COMMEFFICIENT_GPT2_SEQ_LEN", 256)),
+                        help="GPT-2 static sequence length (pad/left-"
+                             "truncate PersonaChat examples to this).")
     parser.add_argument("--eval_before_start", action="store_true")
 
     # Differential Privacy args
@@ -153,6 +172,10 @@ def validate_args(args):
         assert args.local_batch_size == -1, "fedavg requires local_batch_size == -1"
         assert args.local_momentum == 0, "fedavg requires local_momentum == 0"
         assert args.error_type == "none", "fedavg requires error_type == none"
+    if args.seq_parallel != "none":
+        assert args.max_seq_len % args.seq_devices == 0, (
+            f"--max_seq_len {args.max_seq_len} must divide by "
+            f"--seq_devices {args.seq_devices}")
     if args.device:
         # select the JAX platform before the backend initializes (the
         # reference's --device picks the torch device; here e.g.
@@ -198,7 +221,20 @@ def validate_args(args):
                 target = args.device
                 if args.device == "tpu":
                     target = next((p for p in env if p in TPU_BACKENDS),
-                                  "tpu")
+                                  None)
+                    if target is None and not env:
+                        # No TPU platform name anywhere in the env: leave
+                        # jax_platforms untouched and let JAX's default
+                        # priority pick the registered TPU plugin —
+                        # forcing the literal 'tpu' fails on hosts whose
+                        # TPU registers under a plugin name (e.g. the
+                        # axon tunnel).
+                        return args
+                    if target is None:
+                        # env forces some non-TPU platform (e.g. 'cpu')
+                        # but the user asked for the TPU: override with
+                        # the literal name, the only spelling we have.
+                        target = "tpu"
                 jax.config.update("jax_platforms", target)
     return args
 

@@ -121,6 +121,18 @@ class FedModel:
                  init_params=None, model_state=None):
         self.model = model
         self.args = args
+        # --device tpu is a hard request: when platform selection resolved
+        # to something else (e.g. JAX default priority picked CPU on a
+        # TPU-less host, which config.validate_args deliberately leaves
+        # alone so plugin-named TPUs keep working), fail loudly here —
+        # the backend is initialized by now, so this check is reliable.
+        if getattr(args, "device", None) == "tpu":
+            from commefficient_tpu.utils import is_tpu_backend
+
+            assert is_tpu_backend(), (
+                f"--device tpu requested but JAX initialized backend "
+                f"{jax.default_backend()!r} — no TPU platform is available "
+                f"on this host (or JAX_PLATFORMS excludes it)")
         if mesh is None:
             # entrypoint mesh policy: a `clients` mesh over --num_devices
             # (replaces the reference's worker-process/GPU assignment,
@@ -194,7 +206,13 @@ class FedModel:
             sketch=self.sketch, sharding=state_sharding)
 
         self._round_ctx = None
-        self._rng = jax.random.key(args.seed + 1)
+        # --rng_impl: TPU-first extension (no reference equivalent). The
+        # training rng only drives dropout/DP masks; threefry mask
+        # generation is ALU-bound on TPU (~113M dropout values per GPT-2
+        # round) while rbg rides the hardware RNG. Both are deterministic
+        # in the seed; streams differ between impls.
+        self._rng_impl = getattr(args, "rng_impl", None) or "threefry2x32"
+        self._rng = jax.random.key(args.seed + 1, impl=self._rng_impl)
 
         # ---- download-byte tracking (fed_aggregator.py:170-194) ----
         self._simple_download = (args.num_epochs <= 1

@@ -96,6 +96,9 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
                    "has_gauss": int(np_has_gauss),
                    "cached": float(np_cached)},
         "round_idx": int(getattr(fm, "_round_idx", 0)),
+        # key-data layout differs per PRNG impl (--rng_impl); the restore
+        # must rewrap with the same one
+        "rng_impl": getattr(fm, "_rng_impl", "threefry2x32"),
     }
     arrays["meta_json"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
@@ -132,6 +135,21 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
         flat = {k: data[k] for k in data.files}
     meta = json.loads(bytes(flat.pop("meta_json")).decode())
 
+    # Fail with a clear message on a geometry mismatch (different model,
+    # sketch size, or mode) instead of letting it surface later as a
+    # cryptic broadcast/unravel error deep in the round.
+    def check_shape(what, got, want):
+        assert got == want, (
+            f"checkpoint geometry mismatch: {what} has shape {got} but "
+            f"this run expects {want} — was the checkpoint written with a "
+            f"different model/sketch geometry or --mode?")
+
+    check_shape("ps_weights", flat["ps_weights"].shape, fm.ps_weights.shape)
+    check_shape("server velocity", flat["server/velocity"].shape,
+                tuple(optimizer.server_state.velocity.shape))
+    check_shape("server error", flat["server/error"].shape,
+                tuple(optimizer.server_state.error.shape))
+
     fm.ps_weights = jnp.asarray(flat["ps_weights"])
     cs = {}
     for name in ("velocities", "errors", "weights"):
@@ -140,6 +158,7 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
         if key in flat:
             assert cur is not None, \
                 f"checkpoint has client {name} but this config allocates none"
+            check_shape(f"client {name}", flat[key].shape, tuple(cur.shape))
             arr = jnp.asarray(flat[key])
             if fm._state_sharding is not None:
                 arr = jax.device_put(arr, fm._state_sharding)
@@ -156,7 +175,14 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
     if mstate_flat:
         fm._model_state = jax.tree_util.tree_map(
             jnp.asarray, _unflatten(mstate_flat))
-    fm._rng = jax.random.wrap_key_data(jnp.asarray(flat["rng"]))
+    ckpt_impl = meta.get("rng_impl", "threefry2x32")
+    run_impl = getattr(fm, "_rng_impl", "threefry2x32")
+    assert ckpt_impl == run_impl, (
+        f"checkpoint was written with --rng_impl {ckpt_impl} but this run "
+        f"uses {run_impl} — the PRNG streams differ; resume with the same "
+        f"--rng_impl")
+    fm._rng = jax.random.wrap_key_data(jnp.asarray(flat["rng"]),
+                                       impl=ckpt_impl)
 
     from commefficient_tpu.federated.server import ServerState
 

