@@ -1,0 +1,104 @@
+"""Full-scale learning evidence on the real chip.
+
+Round-2 verdict gap: every learning trajectory recorded so far is tiny
+geometry (d≈32k, CPU mesh), where sketch capacity arguments apply. This run
+trains the REAL FetchSGD CIFAR geometry — full ResNet9 (d=6,568,640),
+8 workers, sketch 5x500k / k=50k, virtual momentum 0.9 — sketched vs
+uncompressed on the same synthetic data and seed, and records both
+trajectories (reference recipe utils.py:142-162, fed_aggregator.py:568-613;
+paper targets in BASELINE.md).
+
+Run on the TPU (claims the tunnel):  python scripts/learning_fullscale.py
+Writes docs/learning_fullscale.json and prints per-epoch rows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# 512 images/class -> 5,120 train images, 10 rounds/epoch at the FetchSGD
+# batch of 512 (8 workers x 64). Test split stays at the fallback default.
+os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "512")
+# LEARN_TINY=1: harness smoke mode (CPU-sized model+sketch, same script
+# mechanics) used by the test suite; the real run uses the full geometry.
+TINY = os.environ.get("LEARN_TINY") == "1"
+if TINY:
+    os.environ["COMMEFFICIENT_TINY_MODEL"] = "1"
+else:
+    os.environ.pop("COMMEFFICIENT_TINY_MODEL", None)  # full-size ResNet9
+
+EPOCHS = os.environ.get("LEARN_EPOCHS", "24")
+
+COMMON = [
+    "--dataset_name", "CIFAR10",
+    "--dataset_dir", os.path.join(_REPO, "runs", "learn_fullscale_data"),
+    "--model", "ResNet9",
+    "--batchnorm",
+    "--iid", "--num_clients", "8",
+    "--num_workers", "8",
+    "--local_batch_size", "64",
+    "--valid_batch_size", "64",
+    "--num_epochs", EPOCHS,
+    "--pivot_epoch", "5",
+    "--weight_decay", "5e-4",
+    "--lr_scale", "0.4",
+    "--seed", "0",
+]
+
+SKETCH = [
+    "--mode", "sketch", "--error_type", "virtual",
+    "--k", "2000" if TINY else "50000",
+    "--num_cols", "16384" if TINY else "500000",
+    "--num_rows", "5",
+    "--num_blocks", "2" if TINY else "20",
+    "--virtual_momentum", "0.9", "--local_momentum", "0",
+]
+
+UNCOMPRESSED = [
+    "--mode", "uncompressed", "--error_type", "virtual",
+    "--virtual_momentum", "0.9", "--local_momentum", "0",
+]
+
+
+def run(tag, mode_args):
+    import cv_train
+
+    rows = []
+
+    class Recorder:
+        def append(self, row):
+            rows.append(dict(row))
+            print(f"[{tag}] {row}", flush=True)
+
+    orig = cv_train.TableLogger
+    cv_train.TableLogger = Recorder
+    try:
+        cv_train.main(COMMON + mode_args)
+    finally:
+        cv_train.TableLogger = orig
+    return rows
+
+
+def main():
+    import jax
+
+    print("backend:", jax.default_backend(), flush=True)
+    out = {"epochs": EPOCHS,
+           "per_class": os.environ["COMMEFFICIENT_SYNTHETIC_PER_CLASS"],
+           "backend": jax.default_backend()}
+    for tag, mode_args in (("uncompressed", UNCOMPRESSED),
+                           ("sketch", SKETCH)):
+        out[tag] = run(tag, mode_args)
+        path = os.path.join(_REPO, "docs", "learning_fullscale.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {path} after {tag}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -47,8 +47,9 @@ def rtt_measure(x):
     return best
 
 
-def time_rounds(steps, state0, batch, iters=20, reps=3, lr=0.1):
-    rng = jax.random.key(0)
+def time_rounds(steps, state0, batch, iters=20, reps=3, lr=0.1, rng=None):
+    if rng is None:
+        rng = jax.random.key(0)
     state = state0
     for _ in range(3):
         out = steps.train_step(*state, batch, lr, rng)
@@ -100,8 +101,40 @@ def touched_cells(cs, update, k_max):
     return out
 
 
+def matmul_peak_probe():
+    """Achievable-matmul-rate ceiling on this chip, bf16 and f32: the MFU
+    denominator sanity check (v5e nominal bf16 peak is 197 TFLOP/s; what a
+    big clean GEMM actually sustains through the tunnel-attached chip is the
+    honest ceiling for our MFU numbers)."""
+    for dt, tag in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32 ")):
+        n = 4096
+        x = jnp.asarray(np.random.RandomState(0).randn(n, n), dt)
+        ms = chained(lambda a: a @ a / jnp.float32(n).astype(dt), x, K=10)
+        tflops = 2 * n**3 / (ms * 1e-3) / 1e12
+        print(f"matmul {tag} {n}x{n}: {ms:.3f} ms = {tflops:.1f} TFLOP/s",
+              flush=True)
+
+
+def gpt2_phase_split(steps, ps, cs, batch, round_ms, tag):
+    """Time the client phase (fwd/bwd + compression) on its own —
+    BASELINE.md attributes ~50 of ~83 ms to client fwd/bwd; this pins where
+    round-3 perf effort should go."""
+    rng = jax.random.key(0)
+
+    # client_step is phase 1 of the same round the fused step runs
+    def client_scalar(p):
+        ctx = steps.client_step(p, cs, {}, batch, 0.1, rng)[0]
+        return p + ctx.gradient.reshape(-1)[0] * 1e-30
+
+    t_client = chained(client_scalar, ps, n=3, K=5)
+    print(f"GPT-2 {tag} client phase: {t_client:.2f} ms of "
+          f"{round_ms:.2f} ms round -> server+glue "
+          f"{round_ms - t_client:.2f} ms", flush=True)
+
+
 def main():
     print("backend:", jax.default_backend(), flush=True)
+    matmul_peak_probe()
 
     steps, ps, ss, cs, batch = B.build(tiny=False)
     dt, rtt = time_rounds(steps, (ps, ss, cs, {}), batch)
@@ -121,6 +154,22 @@ def main():
         t_tc = chained(
             lambda u: u + touched_cells(geo, u, 50_064)[0, 0] * 1e-38, upd)
         t_topk = chained(lambda x: topk(x, 50_000), est)
+
+        # single radix pass in isolation: 15 compares + count over d.
+        # Ideal = one HBM read (4B*d); if measured GB/s is far below the
+        # ~800 GB/s class, XLA is materializing the (d,15) broadcast and a
+        # Pallas count kernel is worth writing (topk is 8 of these passes).
+        ts = jnp.arange(1, 16, dtype=jnp.int32) << 24
+
+        def one_pass(x):
+            m = x.view(jnp.int32) & 0x7FFFFFFF
+            counts = jnp.sum(m[:, None] >= ts[None, :], axis=0)
+            return x + counts[0].astype(jnp.float32) * 1e-38
+
+        t_pass = chained(one_pass, est)
+        print(f"d={d}: one radix count pass {t_pass:.2f} ms = "
+              f"{4 * d / (t_pass * 1e-3) / 1e9:.0f} GB/s effective",
+              flush=True)
         t_sv = chained(lambda x: x + sk.sketch_vec(geo, x)[0, 0] * 1e-38, v)
         t_es = chained(lambda t: sk.sketch_vec(geo, sk.estimates(geo, t)),
                        tbl)
@@ -135,6 +184,23 @@ def main():
         tag = "bf16" if bf16 else "f32 "
         print(f"GPT-2 {tag} round: {dt * 1e3:.2f} ms = "
               f"{tokens / dt:,.0f} tokens/s", flush=True)
+        if not bf16:
+            # dropout-PRNG A/B: the round generates ~113M random dropout
+            # values (3 masks x 12 layers x 4096 x 768); threefry is
+            # ALU-bound on TPU while rbg uses the hardware RNG. Same jit,
+            # different key impl -> isolates mask-generation cost.
+            for impl in ("rbg", "unsafe_rbg"):
+                try:
+                    dt2, _ = time_rounds(steps, (ps, ss, cs, {}), batch,
+                                         iters=10,
+                                         rng=jax.random.key(0, impl=impl))
+                    print(f"GPT-2 f32 round ({impl} dropout keys): "
+                          f"{dt2 * 1e3:.2f} ms = {tokens / dt2:,.0f} "
+                          f"tokens/s", flush=True)
+                except Exception as e:  # noqa: BLE001
+                    print(f"GPT-2 {impl} leg failed: {e}", flush=True)
+        gpt2_phase_split(steps, ps, cs, batch, dt * 1e3,
+                         "bf16" if bf16 else "f32")
         del steps, ps, ss, cs, batch
 
 
