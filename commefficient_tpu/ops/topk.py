@@ -38,11 +38,96 @@ need reference tie-breaking.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 _ABS_MASK = 0x7FFFFFFF
 _INF_BITS = 0x7F800000  # |pattern| above this ⇔ NaN
+_LANES = 128
+_SUB = 512              # count-kernel block: (512, 128) i32 = 256 KiB VMEM
+
+
+def _use_pallas_topk() -> bool:
+    """Kill-switch for the Pallas count-pass kernel, OFF by default: it is
+    a bandwidth optimization whose on-chip win over XLA's fused
+    compare-count is decided by measurement (scripts/tpu_measure.py radix
+    probe + A/B); flip COMMEFFICIENT_PALLAS_TOPK=1 once it wins."""
+    import os
+
+    from commefficient_tpu.utils import is_tpu_backend
+
+    return (is_tpu_backend()
+            and os.environ.get("COMMEFFICIENT_PALLAS_TOPK", "0") == "1")
+
+
+@functools.partial(jax.jit, static_argnames=("T", "interpret"))
+def _count_ge_pallas(v3, ts, *, T, interpret=False):
+    """``counts[j] = sum(mag(v) >= ts[j])`` over the whole vector, one HBM
+    read: blocks of the int32 bit patterns stream through VMEM while the 16
+    threshold compares and their scalar reductions stay in registers/SMEM —
+    the radix-descent inner pass with its memory traffic pinned to 4·d
+    bytes (the pure-XLA formulation leaves the (d, 15) broadcast's fate to
+    the fusion heuristics). ``ts`` must be padded to 16 with INT32_MAX
+    (counts 0 there: finite-|float| patterns never reach it)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(ts_ref, v_ref, out_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            for j in range(16):
+                out_ref[j] = 0
+
+        m = v_ref[0] & _ABS_MASK
+        m = jnp.where(m > _INF_BITS, 0, m)
+        for j in range(16):
+            out_ref[j] += jnp.sum((m >= ts_ref[j]).astype(jnp.int32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(T,),
+        in_specs=[pl.BlockSpec((1, _SUB, _LANES), lambda t, *_: (t, 0, 0))],
+        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((16,), jnp.int32),
+        interpret=interpret,
+    )(ts, v3)
+
+
+def _topk_threshold_1d_pallas(vec: jax.Array, k: int,
+                              interpret: bool = False) -> jax.Array:
+    """Same radix descent as ``_topk_threshold_1d``, counts from the Pallas
+    kernel. Identical output: the descent is exact integer arithmetic, so
+    the two paths agree bit-for-bit whenever the counts do."""
+    raw = vec.view(jnp.int32)
+    d = raw.shape[0]
+    block = _SUB * _LANES
+    T = -(-d // block)
+    # pad with +0.0 bits: mag 0 never reaches any ts (all >= 1)
+    v3 = jnp.pad(raw, (0, T * block - d)).reshape(T, _SUB, _LANES)
+
+    p = jnp.int32(0)
+    for shift in range(28, -1, -4):
+        hi_nib = 8 if shift == 28 else 16
+        ts = p + (jnp.arange(1, hi_nib, dtype=jnp.int32) << shift)
+        ts = jnp.pad(ts, (0, 16 - (hi_nib - 1)),
+                     constant_values=jnp.int32(_ABS_MASK))
+        counts = _count_ge_pallas(v3, ts, T=T, interpret=interpret)
+        sel = jnp.sum(counts >= k).astype(jnp.int32)
+        p = p + (sel << shift)
+
+    def mag(r):
+        m = r & _ABS_MASK
+        return jnp.where(m > _INF_BITS, 0, m)
+
+    out = jnp.where(mag(raw) >= p, vec, jnp.zeros_like(vec))
+    nan = (raw & _ABS_MASK) > _INF_BITS
+    return jnp.where(nan, vec, out)
 
 
 def _topk_sort_1d(vec: jax.Array, k: int) -> jax.Array:
@@ -90,7 +175,10 @@ def topk(vec: jax.Array, k: int, method: str = "threshold") -> jax.Array:
     Accepts 1-D ``(d,)`` or 2-D ``(rows, d)`` input (row-wise top-k), mirroring
     reference utils.py:246-252.
     """
-    f = {"threshold": _topk_threshold_1d, "sort": _topk_sort_1d}[method]
+    if method == "threshold" and _use_pallas_topk():
+        f = _topk_threshold_1d_pallas
+    else:
+        f = {"threshold": _topk_threshold_1d, "sort": _topk_sort_1d}[method]
     if vec.ndim == 1:
         return f(vec, k)
     if vec.ndim == 2:

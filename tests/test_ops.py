@@ -351,6 +351,48 @@ class TestTopkEdges:
         assert (out != 0).sum() >= 64
 
 
+class TestTopkPallasCounts:
+    """The Pallas count-pass kernel (interpret mode on CPU) must reproduce
+    the XLA radix descent bit-for-bit: the descent is exact integer
+    arithmetic, so output equality reduces to count equality at every
+    pass."""
+
+    def _both(self, v, k):
+        from commefficient_tpu.ops.topk import (
+            _topk_threshold_1d,
+            _topk_threshold_1d_pallas,
+        )
+
+        vj = jnp.asarray(v, jnp.float32)
+        want = np.asarray(_topk_threshold_1d(vj, k))
+        got = np.asarray(_topk_threshold_1d_pallas(vj, k, interpret=True))
+        np.testing.assert_array_equal(got, want)
+
+    def test_random_non_block_multiple(self):
+        # d not a multiple of the (512, 128) block: pad path
+        rng = np.random.RandomState(0)
+        self._both(rng.randn(70_001).astype(np.float32), 1000)
+
+    def test_exact_block_multiple(self):
+        rng = np.random.RandomState(1)
+        self._both(rng.randn(65_536).astype(np.float32), 5000)
+
+    def test_nan_inf_ties_and_zeros(self):
+        v = np.zeros(66_000, np.float32)
+        v[:10] = 3.0
+        v[10:20] = -3.0
+        v[20] = np.inf
+        v[21] = -np.inf
+        v[22] = np.nan
+        v[23:40] = 1e-40  # subnormals
+        self._both(v, 15)
+
+    def test_k_exceeds_nonzeros(self):
+        v = np.zeros(66_000, np.float32)
+        v[:5] = 2.0
+        self._both(v, 1000)
+
+
 class TestSketchProperties:
     """Property-based checks over random geometries (hypothesis)."""
 
