@@ -15,9 +15,6 @@ it is the single test standing between the suite and the geometry class the
 round-2 verdict called unguarded.
 """
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
 

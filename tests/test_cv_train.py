@@ -119,7 +119,10 @@ class TestLearning:
         summary = cv_train.main([
             "--dataset_name", "CIFAR10",
             "--dataset_dir", str(tmp_path / "data"),
-            "--num_epochs", "6",
+            # 5 epochs: the docs/learning_curves.md trajectory reaches 0.41
+            # at epoch 5, comfortable margin over the 0.25 assert (epoch 6
+            # added ~45 s of single-core suite time for no extra signal)
+            "--num_epochs", "5",
             "--num_workers", "8", "--num_devices", "8",
             "--local_batch_size", "16",
             "--valid_batch_size", "50",
