@@ -48,6 +48,10 @@ def rtt_measure(x):
 
 
 def time_rounds(steps, state0, batch, iters=20, reps=3, lr=0.1, rng=None):
+    """Returns (seconds/round, rtt, final_state). train_step donates
+    ps_weights and client_states (donate_argnums=(0, 2)), so the caller's
+    state0 buffers are DELETED by the first call — reuse the returned
+    state, never the originals."""
     if rng is None:
         rng = jax.random.key(0)
     state = state0
@@ -64,7 +68,7 @@ def time_rounds(steps, state0, batch, iters=20, reps=3, lr=0.1, rng=None):
             state = out[:4]
         drain(state[0])
         best = min(best, max(time.perf_counter() - t0 - rtt, 1e-9))
-    return best / iters, rtt
+    return best / iters, rtt, state
 
 
 def chained(f, x0, n=5, K=20):
@@ -137,7 +141,7 @@ def main():
     matmul_peak_probe()
 
     steps, ps, ss, cs, batch = B.build(tiny=False)
-    dt, rtt = time_rounds(steps, (ps, ss, cs, {}), batch)
+    dt, rtt, _ = time_rounds(steps, (ps, ss, cs, {}), batch)
     print(f"CIFAR round: {dt * 1e3:.2f} ms ({1 / dt:.1f} r/s), "
           f"rtt {rtt * 1e3:.0f} ms", flush=True)
     del steps, ps, ss, cs, batch
@@ -170,6 +174,21 @@ def main():
         print(f"d={d}: one radix count pass {t_pass:.2f} ms = "
               f"{4 * d / (t_pass * 1e-3) / 1e9:.0f} GB/s effective",
               flush=True)
+
+        # Pallas count-pass A/B (kernel is default-off; flip
+        # COMMEFFICIENT_PALLAS_TOPK=1 in bench/entrypoints if this wins
+        # and the outputs match exactly)
+        from commefficient_tpu.ops.topk import _topk_threshold_1d_pallas
+
+        try:
+            same = bool(jnp.all(_topk_threshold_1d_pallas(est, 50_000)
+                                == topk(est, 50_000)))
+            t_ptopk = chained(
+                lambda x: _topk_threshold_1d_pallas(x, 50_000), est)
+            print(f"d={d}: pallas topk {t_ptopk:.2f} ms vs XLA {t_topk:.2f} "
+                  f"ms | outputs equal: {same}", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"d={d}: pallas topk failed: {e}", flush=True)
         t_sv = chained(lambda x: x + sk.sketch_vec(geo, x)[0, 0] * 1e-38, v)
         t_es = chained(lambda t: sk.sketch_vec(geo, sk.estimates(geo, t)),
                        tbl)
@@ -180,7 +199,9 @@ def main():
 
     for bf16 in (False, True):
         steps, ps, ss, cs, batch, tokens = B.build_gpt2(bf16=bf16)
-        dt, _ = time_rounds(steps, (ps, ss, cs, {}), batch, iters=10)
+        # train_step donates ps/client_states: after this call the local
+        # ps/cs buffers are dead — every later leg must use `st`
+        dt, _, st = time_rounds(steps, (ps, ss, cs, {}), batch, iters=10)
         tag = "bf16" if bf16 else "f32 "
         print(f"GPT-2 {tag} round: {dt * 1e3:.2f} ms = "
               f"{tokens / dt:,.0f} tokens/s", flush=True)
@@ -191,17 +212,17 @@ def main():
             # different key impl -> isolates mask-generation cost.
             for impl in ("rbg", "unsafe_rbg"):
                 try:
-                    dt2, _ = time_rounds(steps, (ps, ss, cs, {}), batch,
-                                         iters=10,
-                                         rng=jax.random.key(0, impl=impl))
+                    dt2, _, st = time_rounds(steps, st, batch, iters=10,
+                                             rng=jax.random.key(0,
+                                                               impl=impl))
                     print(f"GPT-2 f32 round ({impl} dropout keys): "
                           f"{dt2 * 1e3:.2f} ms = {tokens / dt2:,.0f} "
                           f"tokens/s", flush=True)
                 except Exception as e:  # noqa: BLE001
                     print(f"GPT-2 {impl} leg failed: {e}", flush=True)
-        gpt2_phase_split(steps, ps, cs, batch, dt * 1e3,
+        gpt2_phase_split(steps, st[0], st[2], batch, dt * 1e3,
                          "bf16" if bf16 else "f32")
-        del steps, ps, ss, cs, batch
+        del steps, ps, ss, cs, batch, st
 
 
 if __name__ == "__main__":
