@@ -1,0 +1,41 @@
+#!/bin/bash
+# One-shot TPU task queue for a tunnel-revival window. Probes liveness,
+# then runs the round-3 measurement batch in priority order, logging to
+# runs/tpu_batch_<ts>/. Each step has its own timeout so a re-wedge mid-
+# batch cannot eat the already-captured results.
+#
+# Usage: bash scripts/tpu_batch.sh   (claims the single axon chip)
+set -u
+cd "$(dirname "$0")/.."
+TS=$(date +%Y%m%d_%H%M%S)
+OUT="runs/tpu_batch_$TS"
+mkdir -p "$OUT"
+echo "logging to $OUT"
+
+log() { echo "[tpu_batch $(date +%H:%M:%S)] $*" | tee -a "$OUT/batch.log"; }
+
+log "probe: small matmul + scalar fetch (timeout 120s)"
+if ! timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((512, 512), jnp.bfloat16)
+print('alive:', float((x @ x).ravel()[0]))
+" >>"$OUT/batch.log" 2>&1; then
+  log "tunnel DEAD — aborting batch"
+  exit 1
+fi
+log "tunnel ALIVE — running the batch"
+
+log "step 1/3: scripts/tpu_measure.py (timeout 40m)"
+timeout 2400 python scripts/tpu_measure.py >"$OUT/tpu_measure.log" 2>&1
+log "step 1 rc=$? (see $OUT/tpu_measure.log)"
+
+log "step 2/3: full bench.py (timeout 90m)"
+timeout 5400 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
+log "step 2 rc=$? ($(tail -c 300 "$OUT/bench.json" 2>/dev/null))"
+
+log "step 3/3: learning_fullscale.py (timeout 90m)"
+timeout 5400 python scripts/learning_fullscale.py \
+  >"$OUT/learning.log" 2>&1
+log "step 3 rc=$? (docs/learning_fullscale.json written on success)"
+
+log "batch done"
