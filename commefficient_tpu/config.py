@@ -135,6 +135,12 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                         default="threefry2x32",
                         help="PRNG implementation for training randomness "
                              "(dropout/DP noise).")
+    # Failure-simulation extension (SURVEY §5: the reference has no client
+    # dropout/elasticity): each sampled client independently misses the
+    # round with this probability; deterministic in --seed, resume-safe.
+    parser.add_argument("--client_dropout", type=float, default=0.0,
+                        help="Per-round probability that a sampled client "
+                             "drops out (0 disables).")
 
     # GPT2 args
     parser.add_argument("--model_checkpoint", type=str, default="gpt2")
@@ -176,6 +182,8 @@ def validate_args(args):
         assert args.max_seq_len % args.seq_devices == 0, (
             f"--max_seq_len {args.max_seq_len} must divide by "
             f"--seq_devices {args.seq_devices}")
+    assert 0.0 <= args.client_dropout < 1.0, (
+        f"--client_dropout {args.client_dropout} must be in [0, 1)")
     if args.device:
         # select the JAX platform before the backend initializes (the
         # reference's --device picks the torch device; here e.g.

@@ -213,6 +213,12 @@ class FedModel:
         # in the seed; streams differ between impls.
         self._rng_impl = getattr(args, "rng_impl", None) or "threefry2x32"
         self._rng = jax.random.key(args.seed + 1, impl=self._rng_impl)
+        # --client_dropout draws: a dedicated stream, NOT the global
+        # np.random one — the PrefetchLoader's producer thread draws from
+        # the global stream concurrently with training, so sharing it
+        # would make drop patterns depend on queue timing. Captured and
+        # restored by the run-state checkpoint (resume-safe).
+        self._drop_rng = np.random.RandomState(args.seed + 2)
 
         # ---- download-byte tracking (fed_aggregator.py:170-194) ----
         self._simple_download = (args.num_epochs <= 1
@@ -278,6 +284,28 @@ class FedModel:
     def _call_train(self, batch: dict):
         ids = np.asarray(batch["client_ids"])
         wmask = np.asarray(batch["worker_mask"])
+        drop_p = getattr(self.args, "client_dropout", 0.0) or 0.0
+        if drop_p > 0:
+            # Failure simulation (extension; SURVEY §5 notes the reference
+            # has none): each sampled client independently drops out of the
+            # round with probability p, through the same slot-masking path
+            # that already handles padded worker slots. Draws come from the
+            # model's dedicated stream (seeded from --seed, captured by
+            # --checkpoint/--resume), so runs are deterministic on both
+            # entrypoints even with a prefetch thread on the global stream.
+            # If every client of a round would drop, the round keeps the
+            # full cohort (a zero-participant round has no defined average).
+            drop = (self._drop_rng.random_sample(wmask.shape) < drop_p) \
+                & (wmask > 0)
+            if drop[wmask > 0].all():
+                drop[:] = False
+            wmask = np.where(drop, 0.0, wmask).astype(np.float32)
+            batch = dict(batch)
+            batch["worker_mask"] = wmask
+            # dropped clients' examples leave the loss/metric averages too
+            mask = np.asarray(batch["mask"])
+            batch["mask"] = (mask * wmask.reshape(
+                wmask.shape + (1,) * (mask.ndim - 1))).astype(mask.dtype)
         participating = np.unique(ids[wmask > 0])
 
         download, upload = self._account_bytes(participating)

@@ -82,6 +82,13 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
     arrays["rng"] = np.asarray(jax.random.key_data(fm._rng))
     np_name, np_keys, np_pos, np_has_gauss, np_cached = np.random.get_state()
     arrays["np_rng/keys"] = np_keys
+    # --client_dropout's dedicated stream (separate from the global one)
+    if getattr(fm, "_drop_rng", None) is not None:
+        _, d_keys, d_pos, d_gauss, d_cached = fm._drop_rng.get_state()
+        arrays["drop_rng/keys"] = d_keys
+        arrays["drop_rng/meta"] = np.asarray(
+            [d_pos, d_gauss], np.int64)
+        arrays["drop_rng/cached"] = np.asarray([d_cached], np.float64)
     if fm._simple_download:
         arrays["acct/updated_since_init"] = np.asarray(fm._updated_since_init)
     else:
@@ -194,6 +201,11 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
     np.random.set_state((np_meta["name"], flat["np_rng/keys"],
                          np_meta["pos"], np_meta["has_gauss"],
                          np_meta["cached"]))
+    if "drop_rng/keys" in flat and getattr(fm, "_drop_rng", None) is not None:
+        d_pos, d_gauss = (int(x) for x in flat["drop_rng/meta"])
+        fm._drop_rng.set_state(("MT19937", flat["drop_rng/keys"],
+                                d_pos, d_gauss,
+                                float(flat["drop_rng/cached"][0])))
     if fm._simple_download:
         fm._updated_since_init = jnp.asarray(flat["acct/updated_since_init"])
     else:

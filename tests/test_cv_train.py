@@ -99,6 +99,28 @@ class TestEndToEnd:
             "--noise_multiplier", "0.01", "--rng_impl", "rbg"])
         assert np.isfinite(summary["train_loss"])
 
+    def test_client_dropout_e2e(self, tmp_path, monkeypatch, capsys):
+        """--client_dropout (failure-simulation extension; the reference
+        has no client dropout, SURVEY §5): dropped clients transmit
+        nothing, so total upload falls below the full-participation run;
+        deterministic in --seed."""
+
+        def total_upload(extra):
+            _run(tmp_path, monkeypatch, [
+                "--mode", "uncompressed", "--local_momentum", "0",
+                "--num_workers", "4"] + extra, subdir="ddata")
+            out = capsys.readouterr().out
+            m = re.search(r"Total Upload \(MiB\): ([0-9.]+)", out)
+            assert m, "missing upload total in output"
+            return float(m.group(1))
+
+        full = total_upload([])
+        dropped = total_upload(["--client_dropout", "0.6"])
+        dropped2 = total_upload(["--client_dropout", "0.6"])
+        assert dropped < full, (dropped, full)
+        assert dropped == pytest.approx(dropped2), \
+            "dropout pattern must be deterministic in --seed"
+
     def test_dp_server_e2e(self, tmp_path, monkeypatch):
         """server-side DP noise (reference fed_aggregator.py:505-508)."""
         summary = _run(tmp_path, monkeypatch, [
@@ -269,11 +291,15 @@ class TestResume:
     # and a per-client-state shape (local_topk with local error + momentum,
     # exercising the ClientStates velocities/errors round-trip)
     CONFIGS = {
+        # --client_dropout rides along: the resume must restore the
+        # dedicated drop stream or the post-resume participation pattern
+        # (and thus weights) diverges from the uninterrupted run
         "sketch_bn": [
             "--mode", "sketch", "--error_type", "virtual",
             "--local_momentum", "0", "--virtual_momentum", "0.9",
             "--k", "200", "--num_cols", "1024", "--num_rows", "3",
             "--num_blocks", "2", "--batchnorm",
+            "--client_dropout", "0.3",
         ],
         # --rng_impl rbg rides along: resume must rewrap the saved key data
         # with the checkpoint's PRNG impl (key layouts differ per impl)
