@@ -146,6 +146,39 @@ class TestSafetensors:
                                    atol=2e-3, rtol=2e-3)
 
 
+class TestSafetensorsParser:
+    def test_bf16_tensor_parses(self, tmp_path):
+        """The BF16 branch: HF saves f32 by default, but bf16 checkpoints
+        exist in the wild; parse one built by hand against ml_dtypes."""
+        import json as _json
+
+        import ml_dtypes
+
+        from commefficient_tpu.models.gpt2 import _load_safetensors
+
+        vals = np.asarray([[1.5, -2.25, 0.0], [3.0, -0.5, 8.0]], np.float32)
+        bf16 = vals.astype(ml_dtypes.bfloat16)
+        f32 = np.asarray([7.0, -1.25], np.float32)
+        payload = bf16.tobytes() + f32.tobytes()
+        header = _json.dumps({
+            "a": {"dtype": "BF16", "shape": [2, 3],
+                  "data_offsets": [0, bf16.nbytes]},
+            "b": {"dtype": "F32", "shape": [2],
+                  "data_offsets": [bf16.nbytes, bf16.nbytes + f32.nbytes]},
+            "__metadata__": {"format": "pt"},
+        }).encode()
+        path = tmp_path / "model.safetensors"
+        with open(path, "wb") as f:
+            f.write(len(header).to_bytes(8, "little"))
+            f.write(header)
+            f.write(payload)
+
+        out = _load_safetensors(str(path))
+        assert out["a"].dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(out["a"].astype(np.float32), vals)
+        np.testing.assert_array_equal(out["b"], f32)
+
+
 class TestRealTokenizer:
     def test_default_checkpoint_uses_vendored_real_bpe(self, tmp_path):
         """The in-image default path (``--model_checkpoint gpt2``, no local
