@@ -44,10 +44,12 @@ COMMON = [
     "--local_batch_size", "64",
     "--valid_batch_size", "64",
     "--num_epochs", EPOCHS,
-    "--pivot_epoch", "5",
+    "--pivot_epoch", os.environ.get("LEARN_PIVOT", "5"),
     "--weight_decay", "5e-4",
     "--lr_scale", "0.4",
     "--seed", "0",
+    # overlap host-side augmentation/assembly with device compute
+    "--train_dataloader_workers", "1",
 ]
 
 SKETCH = [
