@@ -126,6 +126,14 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--seq_devices", type=int, default=2,
                         help="Size of the seq mesh axis when --seq_parallel "
                              "is enabled.")
+    # Tensor parallelism (TPU-first extension, GPT-2 only): Megatron-style
+    # head/hidden sharding over a third `model` mesh axis with two psums
+    # per block; composes with the clients axis (not with --seq_parallel
+    # yet). Parameters stay full-shape/replicated, so the federated flat
+    # vector, compression, and checkpoints are unchanged.
+    parser.add_argument("--model_devices", type=int, default=1,
+                        help="Size of the `model` (tensor-parallel) mesh "
+                             "axis for GPT-2 (1 disables).")
     # TPU-first extension: dropout/DP mask PRNG. threefry (JAX default) is
     # counter-based ALU work; rbg uses the TPU hardware RNG and is much
     # cheaper at GPT-2 mask volumes. unsafe_rbg additionally relaxes
@@ -184,6 +192,10 @@ def validate_args(args):
             f"--seq_devices {args.seq_devices}")
     assert 0.0 <= args.client_dropout < 1.0, (
         f"--client_dropout {args.client_dropout} must be in [0, 1)")
+    assert args.model_devices >= 1, "--model_devices must be >= 1"
+    if args.model_devices > 1:
+        assert args.seq_parallel == "none", (
+            "--model_devices > 1 currently requires --seq_parallel none")
     if args.device:
         # select the JAX platform before the backend initializes (the
         # reference's --device picks the torch device; here e.g.

@@ -77,7 +77,19 @@ def _changed_since_counts(last_changed, since):
     return jnp.sum(last_changed[None, :] >= since[:, None], axis=1)
 
 
-def worker_config_from_args(args) -> WorkerConfig:
+def worker_config_from_args(args, mesh=None) -> WorkerConfig:
+    # parallel axes come from the REALIZED mesh when given: the mesh policy
+    # may have reduced --seq_devices/--model_devices to 1 on a small host
+    # (warn-and-degrade), and a WorkerConfig naming an axis the mesh lacks
+    # crashes at trace time instead
+    seq_axis = "seq" if getattr(args, "seq_parallel", "none") != "none" \
+        else None
+    model_axis = "model" if getattr(args, "model_devices", 1) > 1 else None
+    if mesh is not None:
+        if seq_axis is not None and seq_axis not in mesh.axis_names:
+            seq_axis = None
+        if model_axis is not None and model_axis not in mesh.axis_names:
+            model_axis = None
     return WorkerConfig(
         mode=args.mode,
         error_type=args.error_type,
@@ -95,8 +107,8 @@ def worker_config_from_args(args) -> WorkerConfig:
         fedavg_batch_size=args.fedavg_batch_size,
         fedavg_lr_decay=args.fedavg_lr_decay,
         do_topk_down=args.do_topk_down,
-        seq_axis=("seq" if getattr(args, "seq_parallel", "none") != "none"
-                  else None),
+        seq_axis=seq_axis,
+        model_axis=model_axis,
     )
 
 
@@ -143,7 +155,9 @@ class FedModel:
                            else 1)
             mesh = default_client_mesh(args.num_workers,
                                        getattr(args, "num_devices", -1),
-                                       seq_devices=seq_devices)
+                                       seq_devices=seq_devices,
+                                       model_devices=getattr(
+                                           args, "model_devices", 1))
         self.mesh = mesh
         self.training = True
 
@@ -169,7 +183,7 @@ class FedModel:
         def ravel(tree):
             return ravel_pytree(tree)[0]
 
-        wcfg = worker_config_from_args(args)
+        wcfg = worker_config_from_args(args, mesh=self.mesh)
         scfg = server_config_from_args(args, self.grad_size)
         self.worker_config, self.server_config = wcfg, scfg
         self.sketch = None
@@ -178,8 +192,13 @@ class FedModel:
             self.sketch = make_sketch(self.grad_size, args.num_cols,
                                       args.num_rows, seed=args.seed,
                                       num_blocks=args.num_blocks)
+        tp_sliced = None
+        if wcfg.model_axis is not None:
+            from commefficient_tpu.models.gpt2 import tp_sliced_param
+
+            tp_sliced = tp_sliced_param
         cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=self.grad_size,
-                          do_test=args.do_test)
+                          do_test=args.do_test, tp_sliced=tp_sliced)
         from commefficient_tpu.federated.losses import make_cv_losses  # noqa: F401
 
         self.steps = build_round_step(

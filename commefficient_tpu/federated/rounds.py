@@ -43,6 +43,8 @@ from dataclasses import dataclass, replace as dc_replace
 from functools import partial
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
@@ -139,6 +141,11 @@ class RoundConfig:
     # ``build_round_step``), True/False forces it (tests use False to pin the
     # per-client-gradient path for parity checks).
     fuse_gradients: Optional[bool] = None
+    # Tensor parallelism: predicate over '/'-joined lowercase param paths,
+    # True for weights whose gradient is slice-local per model shard (e.g.
+    # models.gpt2.tp_sliced_param). Required when worker.model_axis is set;
+    # used to build the flat grad-rescale mask (1 sliced, 1/nm replicated).
+    tp_sliced: Optional[Callable[[str], bool]] = None
 
 
 class FederatedSteps(NamedTuple):
@@ -204,6 +211,29 @@ def build_round_step(
     # fused sketch mode only ever rides the sketch-after-sum path
     assert not (fused_grad and wcfg.mode == "sketch" and not sketch_after_sum)
 
+    # Tensor parallelism: flat grad-rescale mask built once, host-side —
+    # 1.0 on segments whose weights the model computes slice-locally per
+    # model shard, 1/nm where every shard computed the identical full grad
+    # (see worker.WorkerConfig.model_axis).
+    tp_scale = None
+    if wcfg.model_axis is not None:
+        assert mesh is not None and wcfg.model_axis in mesh.axis_names, \
+            f"model_axis {wcfg.model_axis!r} not in mesh axes"
+        assert cfg.tp_sliced is not None, \
+            "worker.model_axis set but RoundConfig.tp_sliced is missing"
+        nm = mesh.shape[wcfg.model_axis]
+        tpl = unravel(jnp.zeros(cfg.grad_size, jnp.float32))
+        leaves = jax.tree_util.tree_leaves_with_path(tpl)
+        segs = []
+        for path, leaf in leaves:
+            keys = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path).lower()
+            val = 1.0 if cfg.tp_sliced(keys) else 1.0 / nm
+            segs.append(jnp.full(int(np.prod(leaf.shape)), val, jnp.float32))
+        tp_scale = jnp.concatenate(segs)
+        assert tp_scale.size == cfg.grad_size, \
+            "tp_scale layout does not match the flat vector"
+
     def fused_clients(ps_weights, model_state, batch, rng_keys, worker_mask):
         """One-gradient client phase for a shard's W client slots. Returns
         (local_dense_sum incl. weight decay and seq psum, stacked per-client
@@ -254,6 +284,9 @@ def build_round_step(
             # shards backpropagated their local sequence slice (linear, so
             # one psum of the sum replaces the per-client psums)
             g_sum = jax.lax.psum(g_sum, wcfg.seq_axis)
+        if wcfg.model_axis is not None:
+            # reconcile sliced/replicated segments (see worker.forward_grad)
+            g_sum = jax.lax.psum(g_sum, wcfg.model_axis) * tp_scale
         if wcfg.weight_decay != 0:
             # per-client (wd/num_workers)·w scaled by the client's datum
             # count (worker.forward_grad + local_step ×count)
@@ -293,14 +326,14 @@ def build_round_step(
         elif wcfg.mode == "fedavg":
             res, new_ms = fedavg_local(compute_loss_train, weights_used,
                                        unravel, ravel, model_state, batch_row,
-                                       rng, lr, wcfg)
+                                       rng, lr, wcfg, tp_scale=tp_scale)
             transmit, new_vel, new_err, metrics = (res.transmit, vel_row,
                                                    err_row, res.metrics)
         else:
             res, new_ms = local_step(compute_loss_train, weights_used,
                                      unravel, ravel, model_state, vel_row,
                                      err_row, batch_row, rng, inner_wcfg,
-                                     sketch)
+                                     sketch, tp_scale=tp_scale)
             transmit, new_vel, new_err, metrics = (res.transmit,
                                                    res.new_velocity,
                                                    res.new_error, res.metrics)
@@ -518,6 +551,13 @@ def build_round_step(
                 for k, v in batch.items()
             }
             sharded = shard_map(_val, mesh=mesh, in_specs=(P(), P(), bspec),
+                                out_specs=P(), check_vma=False)
+            return sharded(ps_weights, model_state, batch)
+        if mesh is not None and wcfg.model_axis is not None:
+            # tensor-parallel model: the apply must run inside a shard_map
+            # that binds model_axis; everything is replicated, the blocks'
+            # internal psums make the outputs replicated too
+            sharded = shard_map(_val, mesh=mesh, in_specs=(P(), P(), P()),
                                 out_specs=P(), check_vma=False)
             return sharded(ps_weights, model_state, batch)
         return _val(ps_weights, model_state, batch)

@@ -85,6 +85,15 @@ class WorkerConfig:
     # (clip/DP/topk/sketch/momentum), so every compression mode sees the
     # full gradient, replicated across seq shards.
     seq_axis: Optional[str] = None
+    # Tensor-parallel mesh axis (Megatron-style, GPT-2 only; no reference
+    # equivalent). Transformer blocks compute 1/nm of heads/hidden per
+    # shard; the per-shard backward then yields slice-local gradients for
+    # the sliced weights and replicated (identical) gradients for
+    # everything else, so forward_grad reconciles with one psum followed
+    # by a flat rescale mask (1 on sliced segments, 1/nm elsewhere) before
+    # any nonlinear transform — every compression mode again sees the
+    # full gradient, replicated across model shards.
+    model_axis: Optional[str] = None
 
     @property
     def has_velocity(self) -> bool:
@@ -194,7 +203,7 @@ def _microbatch_grads(compute_loss, params, model_state, batch, rng,
 
 def forward_grad(compute_loss, params_flat, unravel, ravel, model_state,
                  batch, rng, cfg: WorkerConfig, sketch: Optional[CountSketch],
-                 compute_grad: bool = True):
+                 compute_grad: bool = True, tp_scale=None):
     """reference fed_worker.py:249-335 as a pure function.
 
     Returns (transmit_or_None, (loss_mean, *metric_means, count),
@@ -214,6 +223,12 @@ def forward_grad(compute_loss, params_flat, unravel, ravel, model_state,
         # per-shard partial gradients (each shard backpropagated its local
         # slice of the sequence) → full gradient, replicated over seq
         grad = jax.lax.psum(grad, cfg.seq_axis)
+    if cfg.model_axis is not None:
+        # sliced-weight segments: each shard holds its slice's grad, zero
+        # elsewhere → psum reconstitutes; replicated segments: every shard
+        # holds the full identical grad → psum overcounts by nm, fixed by
+        # the 1/nm entries of tp_scale (see WorkerConfig.model_axis)
+        grad = jax.lax.psum(grad, cfg.model_axis) * tp_scale
     # weight decay (reference utils.py:254-259)
     if cfg.weight_decay != 0:
         grad = grad + (cfg.weight_decay / cfg.num_workers) * params_flat
@@ -246,11 +261,12 @@ def forward_grad(compute_loss, params_flat, unravel, ravel, model_state,
 
 def local_step(compute_loss, params_flat, unravel, ravel, model_state,
                velocity, error, batch, rng, cfg: WorkerConfig,
-               sketch: Optional[CountSketch]) -> Tuple[ClientResult, Any]:
+               sketch: Optional[CountSketch],
+               tp_scale=None) -> Tuple[ClientResult, Any]:
     """One client's training contribution (reference fed_worker.py:184-230)."""
     g, metrics, new_state, _ = forward_grad(
         compute_loss, params_flat, unravel, ravel, model_state, batch, rng,
-        cfg, sketch)
+        cfg, sketch, tp_scale=tp_scale)
     count = metrics[-1]
     # sum-of-example-gradients scaling (fed_worker.py:190); linear, so it
     # applies to sketch tables too
@@ -280,7 +296,8 @@ def local_step(compute_loss, params_flat, unravel, ravel, model_state,
 
 
 def fedavg_local(compute_loss, params_flat, unravel, ravel, model_state,
-                 batch, rng, lr, cfg: WorkerConfig) -> Tuple[ClientResult, Any]:
+                 batch, rng, lr, cfg: WorkerConfig,
+                 tp_scale=None) -> Tuple[ClientResult, Any]:
     """FedAvg local training (reference fed_worker.py:61-113): local SGD over
     chunked whole-client batch, transmit (w₀ − w_final)·dataset_size."""
     B = batch["mask"].shape[0]
@@ -298,6 +315,10 @@ def fedavg_local(compute_loss, params_flat, unravel, ravel, model_state,
         if cfg.seq_axis is not None:
             # each seq shard backpropagated its slice of the sequence
             g = jax.lax.psum(g, cfg.seq_axis)
+        if cfg.model_axis is not None:
+            # reconcile sliced/replicated grads (see forward_grad) so the
+            # local SGD weights stay replicated across model shards
+            g = jax.lax.psum(g, cfg.model_axis) * tp_scale
         return g, loss_sum, msums, count, new_ms
 
     n_metrics = probe_n_metrics(

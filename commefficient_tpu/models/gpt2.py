@@ -22,7 +22,9 @@ train from scratch.
 from __future__ import annotations
 
 
+import functools
 import json
+from typing import Optional
 
 import numpy as np
 
@@ -31,7 +33,19 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 __all__ = ["GPT2DoubleHeads", "GPT2Config", "resize_token_embeddings",
-           "load_hf_gpt2"]
+           "load_hf_gpt2", "tp_sliced_param"]
+
+
+def tp_sliced_param(path: str) -> bool:
+    """True for parameters whose gradient is computed slice-locally per
+    tensor-parallel shard (see TPDense): the packed qkv projection and the
+    mlp up-projection (kernel AND bias — both column-sliced), and the two
+    row-sliced down-projection kernels. Row-sliced biases are added after
+    the psum, so their grads are replicated like every other param.
+    ``path`` is the '/'-joined lowercase flat-param path."""
+    if "attn_qkv" in path or "mlp_fc" in path:
+        return True
+    return ("attn_proj" in path or "mlp_proj" in path) and "kernel" in path
 
 
 class GPT2Config:
@@ -47,23 +61,130 @@ class GPT2Config:
         self.dropout = dropout
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _psum_repct(x, axis_name):
+    """``psum`` whose backward passes the cotangent through unchanged.
+
+    The cotangent of a TP row-reduction's output is replicated across the
+    model axis (the loss is computed identically on every shard), so the
+    true VJP is the identity. JAX's default transpose of ``psum`` under
+    shard_map without replication tracking is another ``psum``, which
+    would scale every gradient upstream of the reduction by nm — measured
+    as an exact nm× error on all sliced-weight grads. Pinning the VJP
+    makes the TP gradient math independent of that transpose choice."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _psum_repct_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _psum_repct_bwd(axis_name, _, ct):
+    return (ct,)
+
+
+_psum_repct.defvjp(_psum_repct_fwd, _psum_repct_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _ident_psumct(x, axis_name):
+    """Megatron's f operator: identity forward (x is replicated), psum
+    backward. Each shard's backward produces only its weight slice's share
+    of the input cotangent; the psum reassembles the full cotangent so
+    everything upstream (layernorms, embeddings, earlier blocks) sees the
+    same gradient as the dense model. Together with ``_psum_repct`` (the g
+    operator: psum forward, identity backward) the pair makes TP autodiff
+    exact regardless of JAX's default psum transpose under shard_map."""
+    return x
+
+
+def _ident_psumct_fwd(x, axis_name):
+    return x, None
+
+
+def _ident_psumct_bwd(axis_name, _, ct):
+    return (jax.lax.psum(ct, axis_name),)
+
+
+_ident_psumct.defvjp(_ident_psumct_fwd, _ident_psumct_bwd)
+
+
+class TPDense(nn.Module):
+    """A Dense whose PARAMETERS are full-shape (identical tree/layout to
+    ``nn.Dense``, so checkpoints, HF conversion, and the federated flat
+    vector never see tensor parallelism) but whose COMPUTE runs on a
+    column- or row-slice selected by this shard's index on ``model_axis``.
+
+    ``mode="col"``: y_local = x @ kernel[:, slice] + bias[slice] — output
+    features sharded, no communication. ``mode="row"``: y = psum_model(
+    x_local @ kernel[slice, :]) + bias — the Megatron reduction point;
+    bias is added once, after the psum. ``blocks`` splits the feature dim
+    into equal blocks sliced independently (the packed q|k|v projection
+    needs per-part head slices, not a flat column range)."""
+
+    features: int
+    model_axis: Optional[str]
+    mode: str = "col"
+    blocks: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        d_in = x.shape[-1]
+        if self.mode == "row" and self.model_axis is not None:
+            nm = jax.lax.psum(1, self.model_axis)
+            d_in = d_in * nm  # x carries only this shard's input slice
+        kernel = self.param("kernel", nn.initializers.normal(0.02),
+                            (d_in, self.features))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        if self.model_axis is None:
+            return x @ kernel + bias
+        nm = jax.lax.psum(1, self.model_axis)
+        idx = jax.lax.axis_index(self.model_axis)
+        if self.mode == "col":
+            x = _ident_psumct(x, self.model_axis)
+            blk = self.features // self.blocks
+            sub = blk // nm
+            cols = [jax.lax.dynamic_slice_in_dim(kernel, b * blk + idx * sub,
+                                                 sub, axis=1)
+                    for b in range(self.blocks)]
+            bs = [jax.lax.dynamic_slice_in_dim(bias, b * blk + idx * sub,
+                                               sub, axis=0)
+                  for b in range(self.blocks)]
+            return x @ jnp.concatenate(cols, axis=1) + jnp.concatenate(bs)
+        sub = d_in // nm
+        rows = jax.lax.dynamic_slice_in_dim(kernel, idx * sub, sub, axis=0)
+        return _psum_repct(x @ rows, self.model_axis) + bias
+
+
 class Block(nn.Module):
     n_embd: int
     n_head: int
     dropout: float
     attn_impl: str = "dense"   # dense | ring | ulysses
     seq_axis: str = "seq"
+    # Tensor parallelism (Megatron-style, no reference equivalent): when
+    # set, attention heads and the MLP hidden dim are computed 1/nm per
+    # shard of this mesh axis, with one psum after attn_proj and one after
+    # mlp_proj. Activations entering/leaving the block are replicated
+    # across the axis; residual dropouts draw the same rng on every shard,
+    # preserving that invariant (the att-probs dropout reuses the same
+    # mask pattern across shards' disjoint head slices — a documented,
+    # statistically mild deviation).
+    model_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask, deterministic: bool):
+        tp = self.model_axis is not None
+        nm = jax.lax.psum(1, self.model_axis) if tp else 1
         h = nn.LayerNorm(epsilon=1e-5, name="ln_1")(x)
         B, T, C = h.shape
-        qkv = nn.Dense(3 * C, name="attn_qkv",
-                       kernel_init=nn.initializers.normal(0.02))(h)
+        qkv = TPDense(3 * C, self.model_axis, mode="col", blocks=3,
+                      name="attn_qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
+        n_local = self.n_head // nm if tp else self.n_head
 
         def heads(t):
-            return t.reshape(B, T, self.n_head, C // self.n_head)
+            return t.reshape(B, T, n_local, C // self.n_head)
 
         q, k, v = heads(q), heads(k), heads(v)
         if self.attn_impl == "dense":
@@ -72,7 +193,8 @@ class Block(nn.Module):
             att = jnp.where(mask, att, jnp.finfo(att.dtype).min)
             att = jax.nn.softmax(att, axis=-1)
             att = nn.Dropout(self.dropout)(att, deterministic=deterministic)
-            out = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
+            out = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(
+                B, T, C // nm if tp else C)
         else:
             # sequence-parallel attention: T here is the LOCAL slice of the
             # sequence, sharded over self.seq_axis; the primitives handle
@@ -85,16 +207,14 @@ class Block(nn.Module):
                     "ulysses": ulysses_attention}[self.attn_impl]
             out = attn(q, k, v, axis_name=self.seq_axis,
                        causal=True).reshape(B, T, C)
-        out = nn.Dense(C, name="attn_proj",
-                       kernel_init=nn.initializers.normal(0.02))(out)
+        out = TPDense(C, self.model_axis, mode="row", name="attn_proj")(out)
         x = x + nn.Dropout(self.dropout)(out, deterministic=deterministic)
 
         h = nn.LayerNorm(epsilon=1e-5, name="ln_2")(x)
-        h = nn.Dense(4 * C, name="mlp_fc",
-                     kernel_init=nn.initializers.normal(0.02))(h)
+        h = TPDense(4 * C, self.model_axis, mode="col",
+                    name="mlp_fc")(h)
         h = nn.gelu(h, approximate=True)
-        h = nn.Dense(C, name="mlp_proj",
-                     kernel_init=nn.initializers.normal(0.02))(h)
+        h = TPDense(C, self.model_axis, mode="row", name="mlp_proj")(h)
         return x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
 
 
@@ -114,6 +234,13 @@ class GPT2DoubleHeads(nn.Module):
     # gathers the classification token's hidden state with a masked psum.
     attn_impl: str = "dense"
     seq_axis: str = "seq"
+    # Tensor parallelism over a `model` mesh axis (see Block.model_axis):
+    # transformer blocks compute 1/nm of the heads/hidden per shard with
+    # psums at the two Megatron reduction points; embeddings, LM head and
+    # mc head stay replicated (their grads are rescaled by 1/nm in the
+    # worker — see federated/rounds.py tp_grad_scale). v1 restriction:
+    # combine with attn_impl "dense" only.
+    model_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, mc_token_ids=None,
@@ -125,6 +252,8 @@ class GPT2DoubleHeads(nn.Module):
         Returns (lm_logits (..., T, vocab), mc_logits (...,)).
         """
         sp = self.attn_impl != "dense"
+        assert not (sp and self.model_axis is not None), \
+            "tensor parallelism currently requires attn_impl='dense'"
         orig_shape = input_ids.shape
         T = orig_shape[-1]
         flat_ids = input_ids.reshape(-1, T)
@@ -150,6 +279,7 @@ class GPT2DoubleHeads(nn.Module):
         for i in range(self.n_layer):
             x = Block(self.n_embd, self.n_head, self.dropout,
                       attn_impl=self.attn_impl, seq_axis=self.seq_axis,
+                      model_axis=self.model_axis,
                       name=f"h{i}")(x, mask, deterministic=not train)
         x = nn.LayerNorm(epsilon=1e-5, name="ln_f")(x)
 

@@ -41,14 +41,17 @@ __all__ = [
     "replicated_sharding",
     "CLIENTS_AXIS",
     "SEQ_AXIS",
+    "MODEL_AXIS",
 ]
 
 CLIENTS_AXIS = "clients"
 SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
 
 
 def default_client_mesh(num_workers: int, num_devices: int = -1,
-                        devices=None, seq_devices: int = 1) -> Mesh:
+                        devices=None, seq_devices: int = 1,
+                        model_devices: int = 1) -> Mesh:
     """The entrypoints' mesh policy (replaces the reference's device counting,
     fed_aggregator.py:131-134): a 1-D ``clients`` mesh over
     ``min(--num_devices, available)`` devices, reduced to the largest divisor
@@ -56,10 +59,13 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
     ``--num_devices -1`` (the default) every available device is used.
 
     ``seq_devices > 1`` appends a ``seq`` axis of that size (sequence
-    parallelism, ``--seq_parallel``): the ``clients`` axis then shrinks to fit
-    ``available // seq_devices`` devices. ``seq`` is the *minor* (fastest-
-    varying) axis so its ppermute/all-to-all traffic rides neighboring ICI
-    links.
+    parallelism, ``--seq_parallel``); ``model_devices > 1`` appends a
+    ``model`` axis (tensor parallelism, ``--model_devices``). The
+    ``clients`` axis shrinks to fit ``available // (seq·model)`` devices.
+    ``model`` is the *minor-most* (fastest-varying) axis — its two
+    activation psums per transformer block are the highest-rate collective
+    traffic, so they ride neighboring ICI links; ``seq`` comes next for
+    the same reason relative to ``clients``.
 
     Always returns a mesh — a 1-device mesh keeps the shard_map/psum path
     live even single-chip, so the code path benchmarked and the code path
@@ -67,24 +73,31 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
     """
     devices = list(jax.devices()) if devices is None else list(devices)
     n_avail = len(devices)
-    ns = max(1, min(seq_devices, n_avail))
+    nm = max(1, min(model_devices, n_avail))
+    if model_devices > nm:
+        warnings.warn(f"--model_devices {model_devices} reduced to {nm} "
+                      f"(only {n_avail} devices available)", stacklevel=2)
+    ns = max(1, min(seq_devices, n_avail // nm))
     if seq_devices > ns:
         warnings.warn(f"--seq_devices {seq_devices} reduced to {ns} "
                       f"(only {n_avail} devices available)", stacklevel=2)
     requested = num_devices if num_devices and num_devices > 0 \
         else n_avail
-    n = max(1, min(requested, n_avail // ns))
+    n = max(1, min(requested, n_avail // (ns * nm)))
     while num_workers % n:
         n -= 1
-    if 0 < num_devices != n and num_devices != n * ns:
+    if 0 < num_devices != n and num_devices != n * ns * nm:
         warnings.warn(
             f"--num_devices {num_devices} reduced to {n} on the clients axis "
-            f"(must divide num_workers={num_workers}; {ns} seq device(s) per "
-            f"client shard; {n_avail} available devices)", stacklevel=2)
-    if ns == 1:
-        return make_mesh([(CLIENTS_AXIS, n)], devices=devices[:n])
-    return make_mesh([(CLIENTS_AXIS, n), (SEQ_AXIS, ns)],
-                     devices=devices[:n * ns])
+            f"(must divide num_workers={num_workers}; {ns} seq x {nm} model "
+            f"device(s) per client shard; {n_avail} available devices)",
+            stacklevel=2)
+    axes = [(CLIENTS_AXIS, n)]
+    if ns > 1:
+        axes.append((SEQ_AXIS, ns))
+    if nm > 1:
+        axes.append((MODEL_AXIS, nm))
+    return make_mesh(axes, devices=devices[:n * ns * nm])
 
 
 def make_mesh(axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,

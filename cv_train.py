@@ -273,6 +273,9 @@ def build_param_groups(args, params):
 
 def main(argv=None):
     args = parse_args(argv=argv)
+    assert args.model_devices == 1, (
+        "--model_devices (tensor parallelism) is GPT-2 only; the CV models "
+        "have no model axis — use gpt2_train.py")
     if args.lr_scale is None:
         args.lr_scale = 0.4  # cifar10-fast default peak LR
     print(args)

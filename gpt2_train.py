@@ -198,9 +198,27 @@ def train(argv=None):
         args.model_checkpoint = args.finetune_path
 
     # sequence parallelism (--seq_parallel ring|ulysses): attention runs
-    # over the global sequence sharded across the mesh's `seq` axis
-    sp = args.seq_parallel != "none"
+    # over the global sequence sharded across the mesh's `seq` axis.
+    # Tensor parallelism (--model_devices N): heads/hidden sharded over a
+    # `model` axis (mutually exclusive with seq parallelism for now —
+    # enforced by validate_args). Both derive from the REALIZED mesh: the
+    # policy warns and degrades to fewer axes on small hosts, and the
+    # model must not reference an axis the mesh lacks.
+    from commefficient_tpu.parallel.mesh import default_client_mesh
+
+    mesh = default_client_mesh(
+        args.num_workers, args.num_devices,
+        seq_devices=(args.seq_devices if args.seq_parallel != "none" else 1),
+        model_devices=args.model_devices)
+    sp = args.seq_parallel != "none" and "seq" in mesh.axis_names
+    tp = "model" in mesh.axis_names
+    if args.seq_parallel != "none" and not sp:
+        print(f"--seq_parallel {args.seq_parallel} disabled: "
+              f"mesh has no seq axis ({dict(mesh.shape)})")
+        args.seq_parallel = "none"
     geometry = dict(attn_impl=args.seq_parallel) if sp else {}
+    if tp:
+        geometry["model_axis"] = "model"
 
     # model geometry: tiny when smoke-testing or using the byte fallback
     if args.do_test or os.environ.get("COMMEFFICIENT_TINY_MODEL"):
@@ -214,6 +232,12 @@ def train(argv=None):
     if sp and args.seq_parallel == "ulysses":
         assert model.n_head % args.seq_devices == 0, \
             "ulysses needs n_head divisible by --seq_devices"
+    if tp:
+        nm = mesh.shape["model"]  # realized size, possibly reduced
+        assert model.n_head % nm == 0, \
+            f"--model_devices (realized {nm}) must divide n_head"
+        assert (4 * model.n_embd) % nm == 0, \
+            f"--model_devices (realized {nm}) must divide the MLP hidden dim"
 
     compute_loss_train, compute_loss_val = make_gpt2_losses(
         model, args.lm_coef, args.mc_coef,
@@ -233,9 +257,14 @@ def train(argv=None):
         "input_ids": jnp.zeros((1, args.num_candidates, args.max_seq_len),
                                jnp.int32),
     }
-    # init with a dense-attention twin: same parameter structure, but usable
-    # outside shard_map (ring/ulysses need the `seq` axis bound)
-    init_model = model.copy(attn_impl="dense") if sp else model
+    # init with a non-parallel twin: same parameter structure, but usable
+    # outside shard_map (ring/ulysses need the `seq` axis bound; TPDense
+    # needs the `model` axis bound)
+    init_model = model
+    if sp:
+        init_model = init_model.copy(attn_impl="dense")
+    if tp:
+        init_model = init_model.copy(model_axis=None)
     variables = init_model.init(jax.random.key(args.seed), x0["input_ids"],
                                 token_type_ids=x0["input_ids"],
                                 mc_token_ids=jnp.zeros((1, args.num_candidates),
@@ -264,7 +293,7 @@ def train(argv=None):
     args.num_results_val = 2
     fed_model = FedModel(model, compute_loss_train, args, compute_loss_val,
                          num_clients=train_loader.dataset.num_clients,
-                         init_params=init_params)
+                         init_params=init_params, mesh=mesh)
     opt = FedOptimizer(fed_model, args)
     spe = train_loader.steps_per_epoch()
     print("Steps per epoch", spe)
