@@ -3,6 +3,7 @@
 # startups through the TPU tunnel claim) and forces an 8-device virtual CPU
 # mesh. Usage: scripts/test.sh [pytest args]
 cd "$(dirname "$0")/.."
+if [ $# -eq 0 ]; then set -- tests/ -x -q; fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-  python -m pytest "${@:-tests/ -x -q}"
+  python -m pytest "$@"

@@ -17,6 +17,8 @@ log() { echo "[tpu_batch $(date +%H:%M:%S)] $*" | tee -a "$OUT/batch.log"; }
 log "probe: small matmul + scalar fetch (timeout 120s)"
 if ! timeout 120 python -c "
 import jax, jax.numpy as jnp
+assert jax.default_backend() in ('tpu', 'axon'), \
+    f'backend {jax.default_backend()} is not a TPU'
 x = jnp.ones((512, 512), jnp.bfloat16)
 print('alive:', float((x @ x).ravel()[0]))
 " >>"$OUT/batch.log" 2>&1; then

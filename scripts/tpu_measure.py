@@ -136,93 +136,138 @@ def gpt2_phase_split(steps, ps, cs, batch, round_ms, tag):
           f"{round_ms - t_client:.2f} ms", flush=True)
 
 
-def main():
-    print("backend:", jax.default_backend(), flush=True)
-    matmul_peak_probe()
+def leg(name, fn, *a, **kw):
+    """Run one measurement leg, printing its result immediately; a failed
+    leg (tunnel flake, compile blowup) reports and is skipped instead of
+    killing the rest of the batch."""
+    try:
+        return fn(*a, **kw)
+    except Exception as e:  # noqa: BLE001
+        print(f"LEG FAILED [{name}]: {type(e).__name__}: "
+              f"{str(e)[:300]}", flush=True)
+        return None
 
+
+def cifar_leg():
     steps, ps, ss, cs, batch = B.build(tiny=False)
     dt, rtt, _ = time_rounds(steps, (ps, ss, cs, {}), batch)
     print(f"CIFAR round: {dt * 1e3:.2f} ms ({1 / dt:.1f} r/s), "
           f"rtt {rtt * 1e3:.0f} ms", flush=True)
-    del steps, ps, ss, cs, batch
 
-    for d in (6_568_640, 124_444_417):
-        geo = sk.make_sketch(d, c=500_000, r=5, seed=42, num_blocks=20)
-        v = jnp.asarray(np.random.RandomState(0).randn(d).astype(np.float32))
-        tbl = sk.sketch_vec(geo, v)
-        est = sk.estimates(geo, tbl)
-        upd = topk(est, 50_000)
-        drain(upd)
-        t_resk = chained(
-            lambda u: u + sk.sketch_vec(geo, u)[0, 0] * 1e-38, upd)
-        t_tc = chained(
-            lambda u: u + touched_cells(geo, u, 50_064)[0, 0] * 1e-38, upd)
-        t_topk = chained(lambda x: topk(x, 50_000), est)
 
-        # single radix pass in isolation: 15 compares + count over d.
-        # Ideal = one HBM read (4B*d); if measured GB/s is far below the
-        # ~800 GB/s class, XLA is materializing the (d,15) broadcast and a
-        # Pallas count kernel is worth writing (topk is 8 of these passes).
-        ts = jnp.arange(1, 16, dtype=jnp.int32) << 24
+def sketch_ops_leg(d):
+    geo = sk.make_sketch(d, c=500_000, r=5, seed=42, num_blocks=20)
+    v = jnp.asarray(np.random.RandomState(0).randn(d).astype(np.float32))
+    tbl = sk.sketch_vec(geo, v)
+    est = sk.estimates(geo, tbl)
+    upd = topk(est, 50_000)
+    drain(upd)
+    t_resk = leg("resketch", chained,
+                 lambda u: u + sk.sketch_vec(geo, u)[0, 0] * 1e-38, upd)
+    if t_resk is not None:
+        print(f"d={d}: resketch {t_resk:.2f} ms", flush=True)
+    t_tc = leg("touched-cells", chained,
+               lambda u: u + touched_cells(geo, u, 50_064)[0, 0] * 1e-38, upd)
+    if t_tc is not None:
+        print(f"d={d}: touched-cells {t_tc:.2f} ms", flush=True)
+    # topk's radix descent is a while_loop — chain a SHORT unroll (K=4);
+    # the K=20 unroll produced an HLO big enough to kill the tunnel's
+    # remote compile
+    t_topk = leg("topk", chained, lambda x: topk(x, 50_000), est, K=4)
+    if t_topk:
+        print(f"d={d}: topk {t_topk:.2f} ms", flush=True)
 
-        def one_pass(x):
-            m = x.view(jnp.int32) & 0x7FFFFFFF
-            counts = jnp.sum(m[:, None] >= ts[None, :], axis=0)
-            return x + counts[0].astype(jnp.float32) * 1e-38
+    # single radix pass in isolation: 15 compares + count over d.
+    # Ideal = one HBM read (4B*d); if measured GB/s is far below the
+    # ~800 GB/s class, XLA is materializing the (d,15) broadcast and a
+    # Pallas count kernel is worth writing (topk is 8 of these passes).
+    ts = jnp.arange(1, 16, dtype=jnp.int32) << 24
 
-        t_pass = chained(one_pass, est)
+    def one_pass(x):
+        m = x.view(jnp.int32) & 0x7FFFFFFF
+        counts = jnp.sum(m[:, None] >= ts[None, :], axis=0)
+        return x + counts[0].astype(jnp.float32) * 1e-38
+
+    t_pass = leg("radix-pass", chained, one_pass, est)
+    if t_pass:
         print(f"d={d}: one radix count pass {t_pass:.2f} ms = "
               f"{4 * d / (t_pass * 1e-3) / 1e9:.0f} GB/s effective",
               flush=True)
 
-        # Pallas count-pass A/B (kernel is default-off; flip
-        # COMMEFFICIENT_PALLAS_TOPK=1 in bench/entrypoints if this wins
-        # and the outputs match exactly)
-        from commefficient_tpu.ops.topk import _topk_threshold_1d_pallas
+    # Pallas count-pass A/B (kernel is default-off; flip
+    # COMMEFFICIENT_PALLAS_TOPK=1 in bench/entrypoints if this wins
+    # and the outputs match exactly)
+    from commefficient_tpu.ops.topk import _topk_threshold_1d_pallas
 
-        try:
-            same = bool(jnp.all(_topk_threshold_1d_pallas(est, 50_000)
-                                == topk(est, 50_000)))
-            t_ptopk = chained(
-                lambda x: _topk_threshold_1d_pallas(x, 50_000), est)
-            print(f"d={d}: pallas topk {t_ptopk:.2f} ms vs XLA {t_topk:.2f} "
-                  f"ms | outputs equal: {same}", flush=True)
-        except Exception as e:  # noqa: BLE001
-            print(f"d={d}: pallas topk failed: {e}", flush=True)
-        t_sv = chained(lambda x: x + sk.sketch_vec(geo, x)[0, 0] * 1e-38, v)
-        t_es = chained(lambda t: sk.sketch_vec(geo, sk.estimates(geo, t)),
-                       tbl)
-        print(f"d={d}: resketch {t_resk:.2f} | touched-cells {t_tc:.2f} | "
-              f"topk {t_topk:.2f} | sketch_vec {t_sv:.2f} | "
-              f"est+sketch {t_es:.2f} ms", flush=True)
-        del geo, v, tbl, est, upd
+    try:
+        same = bool(jnp.all(_topk_threshold_1d_pallas(est, 50_000)
+                            == topk(est, 50_000)))
+        t_ptopk = chained(
+            lambda x: _topk_threshold_1d_pallas(x, 50_000), est, K=4)
+        print(f"d={d}: pallas topk {t_ptopk:.2f} ms vs XLA "
+              f"{t_topk if t_topk else float('nan'):.2f} "
+              f"ms | outputs equal: {same}", flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"d={d}: pallas topk failed: {str(e)[:300]}", flush=True)
+    t_sv = leg("sketch_vec", chained,
+               lambda x: x + sk.sketch_vec(geo, x)[0, 0] * 1e-38, v)
+    if t_sv is not None:
+        print(f"d={d}: sketch_vec {t_sv:.2f} ms", flush=True)
+    t_es = leg("est+sketch", chained,
+               lambda t: sk.sketch_vec(geo, sk.estimates(geo, t)), tbl)
+    if t_es is not None:
+        print(f"d={d}: est+sketch {t_es:.2f} ms", flush=True)
 
-    for bf16 in (False, True):
-        steps, ps, ss, cs, batch, tokens = B.build_gpt2(bf16=bf16)
-        # train_step donates ps/client_states: after this call the local
-        # ps/cs buffers are dead — every later leg must use `st`
-        dt, _, st = time_rounds(steps, (ps, ss, cs, {}), batch, iters=10)
-        tag = "bf16" if bf16 else "f32 "
-        print(f"GPT-2 {tag} round: {dt * 1e3:.2f} ms = "
-              f"{tokens / dt:,.0f} tokens/s", flush=True)
-        if not bf16:
-            # dropout-PRNG A/B: the round generates ~113M random dropout
-            # values (3 masks x 12 layers x 4096 x 768); threefry is
-            # ALU-bound on TPU while rbg uses the hardware RNG. Same jit,
-            # different key impl -> isolates mask-generation cost.
-            for impl in ("rbg", "unsafe_rbg"):
-                try:
-                    dt2, _, st = time_rounds(steps, st, batch, iters=10,
-                                             rng=jax.random.key(0,
-                                                               impl=impl))
-                    print(f"GPT-2 f32 round ({impl} dropout keys): "
-                          f"{dt2 * 1e3:.2f} ms = {tokens / dt2:,.0f} "
-                          f"tokens/s", flush=True)
-                except Exception as e:  # noqa: BLE001
-                    print(f"GPT-2 {impl} leg failed: {e}", flush=True)
-        gpt2_phase_split(steps, st[0], st[2], batch, dt * 1e3,
-                         "bf16" if bf16 else "f32")
-        del steps, ps, ss, cs, batch, st
+
+def gpt2_leg(bf16):
+    steps, ps, ss, cs, batch, tokens = B.build_gpt2(bf16=bf16)
+    # train_step donates ps/client_states: after this call the local
+    # ps/cs buffers are dead — every later leg must use `st`
+    dt, _, st = time_rounds(steps, (ps, ss, cs, {}), batch, iters=10)
+    tag = "bf16" if bf16 else "f32 "
+    print(f"GPT-2 {tag} round: {dt * 1e3:.2f} ms = "
+          f"{tokens / dt:,.0f} tokens/s", flush=True)
+    if not bf16:
+        # dropout-PRNG A/B: the round generates ~113M random dropout
+        # values (3 masks x 12 layers x 4096 x 768); threefry is
+        # ALU-bound on TPU while rbg uses the hardware RNG. Same jit,
+        # different key impl -> isolates mask-generation cost.
+        for impl in ("rbg", "unsafe_rbg"):
+            try:
+                dt2, _, st = time_rounds(steps, st, batch, iters=10,
+                                         rng=jax.random.key(0, impl=impl))
+                print(f"GPT-2 f32 round ({impl} dropout keys): "
+                      f"{dt2 * 1e3:.2f} ms = {tokens / dt2:,.0f} "
+                      f"tokens/s", flush=True)
+            except Exception as e:  # noqa: BLE001
+                print(f"GPT-2 {impl} leg failed: {e}", flush=True)
+    leg(f"gpt2-{tag.strip()}-phase-split", gpt2_phase_split,
+        steps, st[0], st[2], batch, dt * 1e3, tag.strip())
+
+
+def main():
+    """Leg names via argv select a subset (default: all)."""
+    known = {"matmul", "cifar", "ops", "gpt2"}
+    want = set(sys.argv[1:])
+    unknown = want - known
+    if unknown:
+        sys.exit(f"unknown legs {sorted(unknown)}; choose from "
+                 f"{sorted(known)}")
+
+    def sel(name):
+        return not want or name in want
+
+    print("backend:", jax.default_backend(), flush=True)
+    if sel("matmul"):
+        leg("matmul", matmul_peak_probe)
+    if sel("cifar"):
+        leg("cifar", cifar_leg)
+    if sel("ops"):
+        leg("ops-6.5M", sketch_ops_leg, 6_568_640)
+        leg("ops-124M", sketch_ops_leg, 124_444_417)
+    if sel("gpt2"):
+        leg("gpt2-f32", gpt2_leg, False)
+        leg("gpt2-bf16", gpt2_leg, True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Tunnel-revival watcher: probes the axon chip every POLL seconds and fires
+# scripts/tpu_batch.sh on the first success. The bench chip's tunnel wedges
+# for long stretches (rounds 1-3 all saw it); this converts any revival
+# window into captured measurements without a human in the loop.
+#
+# Usage: nohup bash scripts/tpu_watch.sh >runs/tpu_watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+POLL=${TPU_WATCH_POLL:-180}
+LOCK=/tmp/tpu_watch.lock
+if ! mkdir "$LOCK" 2>/dev/null; then
+  echo "another tpu_watch holds $LOCK; exiting"
+  exit 1
+fi
+trap 'rmdir "$LOCK"' EXIT
+
+while true; do
+  if timeout 120 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() in ('tpu', 'axon'), \
+    f'backend {jax.default_backend()} is not a TPU'
+x = jnp.ones((512, 512), jnp.bfloat16)
+print('alive:', float((x @ x).ravel()[0]))
+" 2>/dev/null; then
+    echo "[tpu_watch $(date +%H:%M:%S)] tunnel ALIVE -> running batch"
+    bash scripts/tpu_batch.sh
+    echo "[tpu_watch $(date +%H:%M:%S)] batch done; exiting"
+    exit 0
+  fi
+  echo "[tpu_watch $(date +%H:%M:%S)] tunnel still wedged; retry in ${POLL}s"
+  sleep "$POLL"
+done
