@@ -134,6 +134,17 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--model_devices", type=int, default=1,
                         help="Size of the `model` (tensor-parallel) mesh "
                              "axis for GPT-2 (1 disables).")
+    # Pipeline parallelism (TPU-first extension, GPT-2 only): GPipe-style
+    # contiguous layer ranges over a `stage` mesh axis, microbatched clock
+    # schedule with ppermute activation hops (parallel/pipeline.py).
+    # Parameters stay full-shape/replicated, like --model_devices.
+    parser.add_argument("--pipeline_devices", type=int, default=1,
+                        help="Size of the `stage` (pipeline-parallel) mesh "
+                             "axis for GPT-2 (1 disables).")
+    parser.add_argument("--pp_microbatches", type=int, default=4,
+                        help="GPipe microbatches per client batch when "
+                             "--pipeline_devices > 1 (auto-reduced to a "
+                             "divisor of the batch).")
     # TPU-first extension: dropout/DP mask PRNG. threefry (JAX default) is
     # counter-based ALU work; rbg uses the TPU hardware RNG and is much
     # cheaper at GPT-2 mask volumes. unsafe_rbg additionally relaxes
@@ -196,6 +207,12 @@ def validate_args(args):
     if args.model_devices > 1:
         assert args.seq_parallel == "none", (
             "--model_devices > 1 currently requires --seq_parallel none")
+    assert args.pipeline_devices >= 1, "--pipeline_devices must be >= 1"
+    assert args.pp_microbatches >= 1, "--pp_microbatches must be >= 1"
+    if args.pipeline_devices > 1:
+        assert args.seq_parallel == "none" and args.model_devices == 1, (
+            "--pipeline_devices > 1 currently requires --seq_parallel none "
+            "and --model_devices 1")
     if args.device:
         # select the JAX platform before the backend initializes (the
         # reference's --device picks the torch device; here e.g.

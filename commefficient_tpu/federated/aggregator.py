@@ -85,11 +85,14 @@ def worker_config_from_args(args, mesh=None) -> WorkerConfig:
     seq_axis = "seq" if getattr(args, "seq_parallel", "none") != "none" \
         else None
     model_axis = "model" if getattr(args, "model_devices", 1) > 1 else None
+    pp_axis = "stage" if getattr(args, "pipeline_devices", 1) > 1 else None
     if mesh is not None:
         if seq_axis is not None and seq_axis not in mesh.axis_names:
             seq_axis = None
         if model_axis is not None and model_axis not in mesh.axis_names:
             model_axis = None
+        if pp_axis is not None and pp_axis not in mesh.axis_names:
+            pp_axis = None
     return WorkerConfig(
         mode=args.mode,
         error_type=args.error_type,
@@ -109,6 +112,7 @@ def worker_config_from_args(args, mesh=None) -> WorkerConfig:
         do_topk_down=args.do_topk_down,
         seq_axis=seq_axis,
         model_axis=model_axis,
+        pp_axis=pp_axis,
     )
 
 

@@ -76,6 +76,15 @@ def make_cv_losses(model, has_batch_stats: bool = False,
     return compute, compute
 
 
+def _mc_ce_acc(mc_logits, mc_labels):
+    """Multiple-choice CE + accuracy over the candidate axis (shared by the
+    dense and pipeline-parallel GPT-2 loss paths)."""
+    logp = jax.nn.log_softmax(mc_logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, mc_labels[..., None], axis=-1)[..., 0]
+    acc = (jnp.argmax(mc_logits, axis=-1) == mc_labels).astype(jnp.float32)
+    return ce, acc
+
+
 def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
                      seq_axis: str | None = None,
                      compute_dtype: Optional[Any] = None):
@@ -123,12 +132,6 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
             nll_sum = jax.lax.psum(nll_sum, seq_axis)
             n_valid = jax.lax.psum(n_valid, seq_axis)
         return nll_sum / jnp.maximum(n_valid, 1)
-
-    def _mc_ce_acc(mc_logits, mc_labels):
-        logp = jax.nn.log_softmax(mc_logits, axis=-1)
-        ce = -jnp.take_along_axis(logp, mc_labels[..., None], axis=-1)[..., 0]
-        acc = (jnp.argmax(mc_logits, axis=-1) == mc_labels).astype(jnp.float32)
-        return ce, acc
 
     def compute_train(params, model_state, batch, rng, train):
         if seq_axis is not None:

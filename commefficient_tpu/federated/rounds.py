@@ -234,6 +234,16 @@ def build_round_step(
         assert tp_scale.size == cfg.grad_size, \
             "tp_scale layout does not match the flat vector"
 
+    # Pipeline parallelism (parallel/pipeline.py): the loss callbacks carry
+    # the GPipe schedule; the round only needs the one-gradient psum over
+    # the stage axis (see worker.WorkerConfig.pp_axis).
+    if wcfg.pp_axis is not None:
+        assert mesh is not None and wcfg.pp_axis in mesh.axis_names, \
+            f"pp_axis {wcfg.pp_axis!r} not in mesh axes"
+        assert wcfg.seq_axis is None and wcfg.model_axis is None, \
+            "pipeline parallelism cannot combine with seq/tensor " \
+            "parallelism (v1)"
+
     def fused_clients(ps_weights, model_state, batch, rng_keys, worker_mask):
         """One-gradient client phase for a shard's W client slots. Returns
         (local_dense_sum incl. weight decay and seq psum, stacked per-client
@@ -287,6 +297,9 @@ def build_round_step(
         if wcfg.model_axis is not None:
             # reconcile sliced/replicated segments (see worker.forward_grad)
             g_sum = jax.lax.psum(g_sum, wcfg.model_axis) * tp_scale
+        if wcfg.pp_axis is not None:
+            # disjoint stage-local gradient segments -> full gradient
+            g_sum = jax.lax.psum(g_sum, wcfg.pp_axis)
         if wcfg.weight_decay != 0:
             # per-client (wd/num_workers)·w scaled by the client's datum
             # count (worker.forward_grad + local_step ×count)
@@ -553,9 +566,10 @@ def build_round_step(
             sharded = shard_map(_val, mesh=mesh, in_specs=(P(), P(), bspec),
                                 out_specs=P(), check_vma=False)
             return sharded(ps_weights, model_state, batch)
-        if mesh is not None and wcfg.model_axis is not None:
-            # tensor-parallel model: the apply must run inside a shard_map
-            # that binds model_axis; everything is replicated, the blocks'
+        if mesh is not None and (wcfg.model_axis is not None
+                                 or wcfg.pp_axis is not None):
+            # tensor-/pipeline-parallel model: the apply must run inside a
+            # shard_map that binds the axis; everything is replicated, the
             # internal psums make the outputs replicated too
             sharded = shard_map(_val, mesh=mesh, in_specs=(P(), P(), P()),
                                 out_specs=P(), check_vma=False)

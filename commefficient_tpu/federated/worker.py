@@ -94,6 +94,14 @@ class WorkerConfig:
     # any nonlinear transform — every compression mode again sees the
     # full gradient, replicated across model shards.
     model_axis: Optional[str] = None
+    # Pipeline-parallel mesh axis (GPipe-style, GPT-2 only; no reference
+    # equivalent — parallel/pipeline.py). Each stage shard backpropagates
+    # only its own layer range (plus embeddings on stage 0, heads on the
+    # last stage), producing zero gradient segments elsewhere, so
+    # forward_grad reconciles with ONE psum and no rescale — again before
+    # any nonlinear transform, so every compression mode sees the full
+    # gradient, replicated across stage shards.
+    pp_axis: Optional[str] = None
 
     @property
     def has_velocity(self) -> bool:
@@ -229,6 +237,10 @@ def forward_grad(compute_loss, params_flat, unravel, ravel, model_state,
         # holds the full identical grad → psum overcounts by nm, fixed by
         # the 1/nm entries of tp_scale (see WorkerConfig.model_axis)
         grad = jax.lax.psum(grad, cfg.model_axis) * tp_scale
+    if cfg.pp_axis is not None:
+        # pipeline stages hold disjoint gradient segments (zero elsewhere);
+        # one psum reassembles the full gradient (see WorkerConfig.pp_axis)
+        grad = jax.lax.psum(grad, cfg.pp_axis)
     # weight decay (reference utils.py:254-259)
     if cfg.weight_decay != 0:
         grad = grad + (cfg.weight_decay / cfg.num_workers) * params_flat
@@ -319,6 +331,9 @@ def fedavg_local(compute_loss, params_flat, unravel, ravel, model_state,
             # reconcile sliced/replicated grads (see forward_grad) so the
             # local SGD weights stay replicated across model shards
             g = jax.lax.psum(g, cfg.model_axis) * tp_scale
+        if cfg.pp_axis is not None:
+            # disjoint stage-local gradient segments -> full gradient
+            g = jax.lax.psum(g, cfg.pp_axis)
         return g, loss_sum, msums, count, new_ms
 
     n_metrics = probe_n_metrics(

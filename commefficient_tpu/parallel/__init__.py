@@ -1,9 +1,10 @@
 """Parallelism toolkit: mesh construction, sequence/context parallelism.
 
-- ``mesh``    — named-mesh builders and sharding helpers (clients/seq axes,
-  multihost hybrid DCN×ICI meshes);
-- ``ring``    — ring attention (ppermute KV rotation, exact, O(T/n) memory);
-- ``ulysses`` — all-to-all head-scatter sequence parallelism.
+- ``mesh``     — named-mesh builders and sharding helpers (clients/seq/
+  model/stage axes, multihost hybrid DCN×ICI meshes);
+- ``ring``     — ring attention (ppermute KV rotation, exact, O(T/n) memory);
+- ``ulysses``  — all-to-all head-scatter sequence parallelism;
+- ``pipeline`` — GPipe-style pipeline parallelism over a ``stage`` axis.
 
 The federated client axis itself is driven by federated/rounds.py; this
 package holds the reusable mesh plumbing plus the long-context machinery.
@@ -16,6 +17,11 @@ from commefficient_tpu.parallel.mesh import (
     make_mesh,
     replicated_sharding,
 )
+from commefficient_tpu.parallel.pipeline import (
+    STAGE_AXIS,
+    make_gpt2_pp_losses,
+    pp_layer_ranges,
+)
 from commefficient_tpu.parallel.ring import make_ring_attention, ring_attention
 from commefficient_tpu.parallel.ulysses import (
     make_ulysses_attention,
@@ -25,6 +31,9 @@ from commefficient_tpu.parallel.ulysses import (
 __all__ = [
     "CLIENTS_AXIS",
     "SEQ_AXIS",
+    "STAGE_AXIS",
+    "make_gpt2_pp_losses",
+    "pp_layer_ranges",
     "client_sharding",
     "make_mesh",
     "replicated_sharding",

@@ -42,16 +42,19 @@ __all__ = [
     "CLIENTS_AXIS",
     "SEQ_AXIS",
     "MODEL_AXIS",
+    "STAGE_AXIS",
 ]
 
 CLIENTS_AXIS = "clients"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
+STAGE_AXIS = "stage"
 
 
 def default_client_mesh(num_workers: int, num_devices: int = -1,
                         devices=None, seq_devices: int = 1,
-                        model_devices: int = 1) -> Mesh:
+                        model_devices: int = 1,
+                        pipeline_devices: int = 1) -> Mesh:
     """The entrypoints' mesh policy (replaces the reference's device counting,
     fed_aggregator.py:131-134): a 1-D ``clients`` mesh over
     ``min(--num_devices, available)`` devices, reduced to the largest divisor
@@ -60,8 +63,10 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
 
     ``seq_devices > 1`` appends a ``seq`` axis of that size (sequence
     parallelism, ``--seq_parallel``); ``model_devices > 1`` appends a
-    ``model`` axis (tensor parallelism, ``--model_devices``). The
-    ``clients`` axis shrinks to fit ``available // (seq·model)`` devices.
+    ``model`` axis (tensor parallelism, ``--model_devices``);
+    ``pipeline_devices > 1`` appends a ``stage`` axis (pipeline
+    parallelism, ``--pipeline_devices``). The ``clients`` axis shrinks to
+    fit ``available // (seq·model·stage)`` devices.
     ``model`` is the *minor-most* (fastest-varying) axis — its two
     activation psums per transformer block are the highest-rate collective
     traffic, so they ride neighboring ICI links; ``seq`` comes next for
@@ -77,27 +82,35 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
     if model_devices > nm:
         warnings.warn(f"--model_devices {model_devices} reduced to {nm} "
                       f"(only {n_avail} devices available)", stacklevel=2)
-    ns = max(1, min(seq_devices, n_avail // nm))
+    npp = max(1, min(pipeline_devices, n_avail // nm))
+    if pipeline_devices > npp:
+        warnings.warn(f"--pipeline_devices {pipeline_devices} reduced to "
+                      f"{npp} (only {n_avail} devices available)",
+                      stacklevel=2)
+    ns = max(1, min(seq_devices, n_avail // (nm * npp)))
     if seq_devices > ns:
         warnings.warn(f"--seq_devices {seq_devices} reduced to {ns} "
                       f"(only {n_avail} devices available)", stacklevel=2)
     requested = num_devices if num_devices and num_devices > 0 \
         else n_avail
-    n = max(1, min(requested, n_avail // (ns * nm)))
+    n = max(1, min(requested, n_avail // (ns * nm * npp)))
     while num_workers % n:
         n -= 1
-    if 0 < num_devices != n and num_devices != n * ns * nm:
+    if 0 < num_devices != n and num_devices != n * ns * nm * npp:
         warnings.warn(
             f"--num_devices {num_devices} reduced to {n} on the clients axis "
             f"(must divide num_workers={num_workers}; {ns} seq x {nm} model "
-            f"device(s) per client shard; {n_avail} available devices)",
+            f"x {npp} stage device(s) per client shard; {n_avail} available "
+            f"devices)",
             stacklevel=2)
     axes = [(CLIENTS_AXIS, n)]
     if ns > 1:
         axes.append((SEQ_AXIS, ns))
     if nm > 1:
         axes.append((MODEL_AXIS, nm))
-    return make_mesh(axes, devices=devices[:n * ns * nm])
+    if npp > 1:
+        axes.append((STAGE_AXIS, npp))
+    return make_mesh(axes, devices=devices[:n * ns * nm * npp])
 
 
 def make_mesh(axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,

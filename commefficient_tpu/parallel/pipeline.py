@@ -1,0 +1,244 @@
+"""GPipe-style pipeline parallelism for GPT-2 over a ``stage`` mesh axis.
+
+Extension beyond the reference (its only model-scaling lever is more GPUs
+per worker process, fed_aggregator.py:131-164); together with the ``seq``
+(ring/Ulysses) and ``model`` (Megatron) axes this completes the framework's
+dp/sp/tp/pp parallelism surface. Same design philosophy as tensor
+parallelism (models/gpt2.py TPDense): parameters stay **full-shape and
+replicated** on every shard, so the federated flat vector, the compression
+pipeline, checkpoints, and the HF conversion never see pipelining — only
+*compute* is partitioned.
+
+How it maps to the TPU/SPMD model:
+
+- the ``n_layer`` transformer blocks are split into ``n_stages`` contiguous
+  ranges; each shard of the ``stage`` axis executes ONLY its range, selected
+  by ``lax.switch`` on ``lax.axis_index`` (an XLA conditional: one branch
+  executes per device at runtime, even though all branches are traced and
+  every shard holds every parameter);
+- the client batch is split into ``n_micro`` microbatches and run on the
+  classic GPipe clock: tick ``t`` has stage ``s`` working on microbatch
+  ``t - s``; activations hop stage→stage+1 through ``lax.ppermute`` inside
+  one ``lax.scan`` over the ``n_micro + n_stages - 1`` ticks;
+- stage 0 additionally embeds, the last stage additionally runs ``ln_f``,
+  the (weight-tied) LM head, the per-token NLL reduction, and the MC head —
+  producing only SMALL per-example outputs (nll sums, valid counts, mc
+  logits), so the (tokens × vocab) logits are never materialized globally
+  nor collectively transferred;
+- those per-example outputs are stage-masked and reassembled with
+  ``_psum_repct`` (psum forward, identity backward — models/gpt2.py), so the
+  loss value is replicated across the stage axis while its cotangent enters
+  the pipeline ONLY on the last stage. Reverse-mode AD through the scan then
+  runs the pipeline backward automatically: ``ppermute`` transposes to the
+  reverse hop, ``switch`` routes cotangents into the owning stage's layers.
+
+Consequently every parameter's gradient contribution lives on exactly the
+shard(s) whose stage computed with it (embeddings on stage 0, its block
+range per stage, ln_f + heads + the wte.attend tie on the last stage), and
+one plain ``lax.psum`` over the stage axis — no rescale mask — reassembles
+the exact dense gradient before any compression (federated/worker.py
+forward_grad, federated/rounds.py fused_clients). Every compression mode
+therefore composes with pipelining unchanged.
+
+v1 restrictions (asserted): dense attention only (no seq axis), no tensor
+parallelism on the same model, float32 or bf16 compute via
+``compute_dtype``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from commefficient_tpu.federated.losses import _cast_tree, _mc_ce_acc
+from commefficient_tpu.models.gpt2 import Block, GPT2DoubleHeads, _psum_repct
+
+__all__ = ["STAGE_AXIS", "pp_layer_ranges", "make_gpt2_pp_losses"]
+
+STAGE_AXIS = "stage"
+
+
+def pp_layer_ranges(n_layer: int, n_stages: int):
+    """Balanced contiguous layer ranges, one per stage; the first
+    ``n_layer % n_stages`` stages take the extra layer."""
+    assert 1 <= n_stages <= n_layer, \
+        f"need 1 <= n_stages ({n_stages}) <= n_layer ({n_layer})"
+    base, rem = divmod(n_layer, n_stages)
+    ranges, lo = [], 0
+    for s in range(n_stages):
+        hi = lo + base + (1 if s < rem else 0)
+        ranges.append((lo, hi))
+        lo = hi
+    return ranges
+
+
+def _layer_norm(p, x, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def _dropout(rng, x, rate, deterministic):
+    if deterministic or rate == 0.0:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def _auto_micro(n_examples: int, n_micro: int) -> int:
+    """Largest divisor of the (static) example count that is <= n_micro, so
+    odd validation batch sizes degrade to fewer microbatches instead of
+    failing."""
+    m = max(1, min(n_micro, n_examples))
+    while n_examples % m:
+        m -= 1
+    return m
+
+
+def make_gpt2_pp_losses(model: GPT2DoubleHeads, n_stages: int,
+                        n_micro: int = 4, lm_coef: float = 1.0,
+                        mc_coef: float = 1.0, axis: str = STAGE_AXIS,
+                        compute_dtype: Optional[Any] = None):
+    """Pipeline-parallel twin of ``losses.make_gpt2_losses``: identical
+    ``(loss_sum, metric_sums, count, model_state)`` contract and identical
+    math (per-example token-mean NLL + candidate CE, reference
+    gpt2_train.py:55-99), with the forward/backward run on the GPipe
+    schedule described in the module docstring. Must be traced inside a
+    shard_map binding ``axis`` with ``n_stages`` shards; the batch and
+    params replicated across it."""
+    assert model.attn_impl == "dense", \
+        "pipeline parallelism requires attn_impl='dense' (v1)"
+    assert model.model_axis is None, \
+        "pipeline parallelism cannot combine with tensor parallelism (v1)"
+    ranges = pp_layer_ranges(model.n_layer, n_stages)
+    blk = Block(model.n_embd, model.n_head, model.dropout)
+    dt = compute_dtype or jnp.float32
+
+    def _pipeline(params, batch, rng, train):
+        ids = batch["input_ids"]
+        assert ids.ndim == 3, \
+            f"expected (batch, candidates, seq) input_ids, got {ids.shape}"
+        E0, C, T = ids.shape
+        nm = _auto_micro(E0, n_micro)
+        me = E0 // nm
+        R = me * C  # transformer rows per microbatch
+        if compute_dtype is not None:
+            params = _cast_tree(params, compute_dtype)
+        wte = params["wte"]["embedding"]
+        wpe = params["wpe"]["embedding"]
+
+        def mb(x):  # (E0, ...) -> (nm, me, ...)
+            return x.reshape((nm, me) + x.shape[1:])
+
+        ids_m = mb(ids)
+        tt_m = mb(batch["token_type_ids"])
+        lab_m = mb(batch["lm_labels"])
+        mcid_m = mb(batch["mc_token_ids"])
+        causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+        s_idx = lax.axis_index(axis)
+        S = n_stages
+
+        def make_branch(stage_id):
+            lo, hi = ranges[stage_id]
+
+            def branch(op):
+                ids_mb, tt_mb, lab_mb, mcid_mb, h_in, rng_mb = op
+                if stage_id == 0:
+                    x = wte[ids_mb.reshape(R, T)] + wpe[jnp.arange(T)][None]
+                    x = x + wte[tt_mb.reshape(R, T)]
+                    x = _dropout(jax.random.fold_in(rng_mb, model.n_layer),
+                                 x, model.dropout, not train)
+                else:
+                    x = h_in
+                for l in range(lo, hi):
+                    rngs = {"dropout": jax.random.fold_in(rng_mb, l)} \
+                        if train else None
+                    x = blk.apply({"params": params[f"h{l}"]}, x, causal,
+                                  not train, rngs=rngs)
+                if stage_id == S - 1:
+                    x = _layer_norm(params["ln_f"], x)
+                    lm_logits = (x @ wte.T).reshape(me, C, T, -1)
+                    # shift: predict token t+1 from position t
+                    logits = lm_logits[..., :-1, :]
+                    labels = lab_mb[..., 1:]
+                    valid = labels != -1
+                    safe = jnp.where(valid, labels, 0)
+                    lse = jax.nn.logsumexp(logits.astype(jnp.float32), -1)
+                    picked = jnp.take_along_axis(
+                        logits, safe[..., None],
+                        axis=-1)[..., 0].astype(jnp.float32)
+                    tok_nll = (lse - picked) * valid
+                    nll_sum = tok_nll.sum(axis=(-2, -1))
+                    n_valid = valid.sum(axis=(-2, -1)).astype(jnp.float32)
+                    xr = x.reshape(me, C, T, model.n_embd)
+                    cls = jnp.take_along_axis(
+                        xr, mcid_mb[:, :, None, None], axis=2)[:, :, 0]
+                    mc = (cls @ params["mc_head"]["kernel"]
+                          + params["mc_head"]["bias"])[..., 0]
+                    mc = mc.astype(jnp.float32)
+                else:
+                    nll_sum = jnp.zeros((me,), jnp.float32)
+                    n_valid = jnp.zeros((me,), jnp.float32)
+                    mc = jnp.zeros((me, C), jnp.float32)
+                return x, nll_sum, n_valid, mc
+
+            return branch
+
+        branches = [make_branch(s) for s in range(S)]
+        perm = [(i, i + 1) for i in range(S - 1)]
+
+        def tick(carry, t):
+            buf, nll_acc, nv_acc, mc_acc = carry
+            m = jnp.clip(t - s_idx, 0, nm - 1)  # this stage's microbatch
+
+            def take(a):
+                return lax.dynamic_index_in_dim(a, m, 0, keepdims=False)
+
+            rng_mb = jax.random.fold_in(rng, m)
+            h, nll, nv, mc = lax.switch(
+                s_idx, branches,
+                (take(ids_m), take(tt_m), take(lab_m), take(mcid_m), buf,
+                 rng_mb))
+            active = ((t >= s_idx) & (t - s_idx < nm))
+            w = (active & (s_idx == S - 1)).astype(jnp.float32)
+            nll_acc = nll_acc.at[m].add(nll * w)
+            nv_acc = nv_acc.at[m].add(nv * w)
+            mc_acc = mc_acc.at[m].add(mc * w)
+            buf = lax.ppermute(h * active.astype(h.dtype), axis, perm)
+            return (buf, nll_acc, nv_acc, mc_acc), None
+
+        init = (jnp.zeros((R, T, model.n_embd), dt),
+                jnp.zeros((nm, me), jnp.float32),
+                jnp.zeros((nm, me), jnp.float32),
+                jnp.zeros((nm, me, C), jnp.float32))
+        (_, nll_acc, nv_acc, mc_acc), _ = lax.scan(
+            tick, init, jnp.arange(nm + S - 1))
+
+        # stage-masked accumulators -> replicated values; identity backward
+        # sends the cotangent into the last stage only (see module docstring)
+        nll_sum = _psum_repct(nll_acc, axis).reshape(E0)
+        n_valid = _psum_repct(nv_acc, axis).reshape(E0)
+        mc_logits = _psum_repct(mc_acc, axis).reshape(E0, C)
+        lm_nll = nll_sum / jnp.maximum(n_valid, 1)
+        return lm_nll, mc_logits
+
+    def compute_train(params, model_state, batch, rng, train):
+        lm_nll, mc_logits = _pipeline(params, batch, rng, train)
+        mc_ce, _ = _mc_ce_acc(mc_logits, batch["mc_labels"])
+        mask = batch["mask"]
+        loss_sum = jnp.sum((lm_coef * lm_nll + mc_coef * mc_ce) * mask)
+        return loss_sum, (), jnp.sum(mask), model_state
+
+    def compute_val(params, model_state, batch, rng, train):
+        lm_nll, mc_logits = _pipeline(params, batch, rng, False)
+        _, acc = _mc_ce_acc(mc_logits, batch["mc_labels"])
+        mask = batch["mask"]
+        return (jnp.sum(lm_nll * mask), (jnp.sum(acc * mask),),
+                jnp.sum(mask), model_state)
+
+    return compute_train, compute_val

@@ -276,6 +276,9 @@ def main(argv=None):
     assert args.model_devices == 1, (
         "--model_devices (tensor parallelism) is GPT-2 only; the CV models "
         "have no model axis — use gpt2_train.py")
+    assert args.pipeline_devices == 1, (
+        "--pipeline_devices (pipeline parallelism) is GPT-2 only; the CV "
+        "models have no stage axis — use gpt2_train.py")
     if args.lr_scale is None:
         args.lr_scale = 0.4  # cifar10-fast default peak LR
     print(args)

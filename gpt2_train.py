@@ -209,9 +209,11 @@ def train(argv=None):
     mesh = default_client_mesh(
         args.num_workers, args.num_devices,
         seq_devices=(args.seq_devices if args.seq_parallel != "none" else 1),
-        model_devices=args.model_devices)
+        model_devices=args.model_devices,
+        pipeline_devices=args.pipeline_devices)
     sp = args.seq_parallel != "none" and "seq" in mesh.axis_names
     tp = "model" in mesh.axis_names
+    pp = "stage" in mesh.axis_names
     if args.seq_parallel != "none" and not sp:
         print(f"--seq_parallel {args.seq_parallel} disabled: "
               f"mesh has no seq axis ({dict(mesh.shape)})")
@@ -238,11 +240,24 @@ def train(argv=None):
             f"--model_devices (realized {nm}) must divide n_head"
         assert (4 * model.n_embd) % nm == 0, \
             f"--model_devices (realized {nm}) must divide the MLP hidden dim"
+    if pp:
+        # pipeline parallelism (--pipeline_devices): the loss callbacks
+        # carry the GPipe schedule (parallel/pipeline.py); the model object
+        # itself stays the plain dense one
+        n_stages = mesh.shape["stage"]  # realized size, possibly reduced
+        assert model.n_layer >= n_stages, \
+            f"--pipeline_devices (realized {n_stages}) must be <= n_layer"
+        from commefficient_tpu.parallel.pipeline import make_gpt2_pp_losses
 
-    compute_loss_train, compute_loss_val = make_gpt2_losses(
-        model, args.lm_coef, args.mc_coef,
-        seq_axis="seq" if sp else None,
-        compute_dtype=jnp.bfloat16 if args.do_bf16 else None)
+        compute_loss_train, compute_loss_val = make_gpt2_pp_losses(
+            model, n_stages, n_micro=args.pp_microbatches,
+            lm_coef=args.lm_coef, mc_coef=args.mc_coef,
+            compute_dtype=jnp.bfloat16 if args.do_bf16 else None)
+    else:
+        compute_loss_train, compute_loss_val = make_gpt2_losses(
+            model, args.lm_coef, args.mc_coef,
+            seq_axis="seq" if sp else None,
+            compute_dtype=jnp.bfloat16 if args.do_bf16 else None)
 
     log_dir = make_logdir(args)
     os.makedirs(log_dir, exist_ok=True)

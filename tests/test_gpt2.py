@@ -253,6 +253,38 @@ class TestEndToEnd:
         assert np.isfinite(stats["val_nll"])
         assert np.isfinite(stats["val_ppl"])
 
+    def test_gpt2_train_pipeline_parallel(self, tmp_path):
+        """--pipeline_devices runs the full train+val loop with the layer
+        stack staged over a 2-wide `stage` mesh axis (pipeline parallelism,
+        tests/test_pipeline.py pins the math; this pins the CLI wiring
+        end-to-end incl. the sketch pipeline on the one-psum gradient)."""
+        if len(jax.devices()) < 4:
+            pytest.skip("needs a 4-device mesh (2 clients x 2 stage)")
+        import gpt2_train
+
+        stats = gpt2_train.train(argv=[
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "1",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "sketch",
+            "--error_type", "virtual",
+            "--local_momentum", "0",
+            "--k", "64",
+            "--num_cols", "2048",
+            "--num_rows", "3",
+            "--num_blocks", "2",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+            "--pipeline_devices", "2",
+            "--pp_microbatches", "2",
+        ])
+        assert np.isfinite(stats["val_nll"])
+        assert np.isfinite(stats["val_ppl"])
+
 
 class TestResume:
     def test_checkpoint_and_resume(self, tmp_path):

@@ -1,0 +1,268 @@
+"""Pipeline parallelism (GPipe-style `stage` mesh axis, GPT-2 only).
+
+Extension beyond the reference (its only model-scaling lever is more GPUs
+per worker process): transformer layers are split into contiguous stage
+ranges selected per shard by ``lax.switch``; microbatches flow on the GPipe
+clock through ``lax.ppermute`` hops inside one ``lax.scan``; the loss is
+computed on the last stage only and reassembled stage-masked, so a single
+``psum`` over the stage axis reconstitutes the exact dense gradient
+(parallel/pipeline.py; federated/worker.py pp_axis).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from commefficient_tpu.federated.losses import make_gpt2_losses
+from commefficient_tpu.federated.rounds import (
+    RoundConfig,
+    build_round_step,
+    init_client_states,
+)
+from commefficient_tpu.federated.server import ServerConfig, init_server_state
+from commefficient_tpu.federated.worker import WorkerConfig
+from commefficient_tpu.models.gpt2 import GPT2DoubleHeads
+from commefficient_tpu.ops.flat import ravel_pytree
+from commefficient_tpu.parallel.mesh import make_mesh
+from commefficient_tpu.parallel.pipeline import (
+    make_gpt2_pp_losses,
+    pp_layer_ranges,
+)
+
+V, T, E, L, H = 128, 16, 32, 3, 4
+
+
+def _model():
+    return GPT2DoubleHeads(vocab_size=V, n_positions=T, n_embd=E,
+                           n_layer=L, n_head=H, dropout=0.0)
+
+
+def _ids(seed, shape, hi=V):
+    return jnp.asarray(np.random.RandomState(seed).randint(0, hi, shape),
+                       jnp.int32)
+
+
+def _batch(B, C):
+    rs = np.random.RandomState(7)
+    return {
+        "input_ids": _ids(0, (B, C, T)),
+        "token_type_ids": _ids(1, (B, C, T)),
+        "lm_labels": jnp.asarray(rs.randint(-1, V, (B, C, T)), jnp.int32),
+        "mc_token_ids": _ids(2, (B, C), hi=T),
+        "mc_labels": jnp.asarray(rs.randint(0, C, (B,)), jnp.int32),
+        "mask": jnp.ones((B,), jnp.float32),
+    }
+
+
+def _params(model, batch):
+    return model.init(jax.random.key(0), batch["input_ids"],
+                      token_type_ids=batch["token_type_ids"],
+                      mc_token_ids=batch["mc_token_ids"],
+                      train=False)["params"]
+
+
+class TestLayerRanges:
+    def test_balanced_contiguous(self):
+        assert pp_layer_ranges(12, 4) == [(0, 3), (3, 6), (6, 9), (9, 12)]
+        # uneven: the first n_layer % n_stages stages take the extra layer
+        assert pp_layer_ranges(3, 2) == [(0, 2), (2, 3)]
+        assert pp_layer_ranges(5, 3) == [(0, 2), (2, 4), (4, 5)]
+
+    def test_rejects_more_stages_than_layers(self):
+        with pytest.raises(AssertionError):
+            pp_layer_ranges(2, 3)
+
+
+class TestPPLosses:
+    """The pipelined loss callbacks match the dense ones exactly — value,
+    metrics, and the psum-reassembled gradient."""
+
+    @pytest.mark.parametrize("S,n_micro", [(2, 2), (3, 2), (2, 1), (2, 4)])
+    def test_train_loss_and_grad_match_dense(self, S, n_micro):
+        model = _model()
+        batch = _batch(4, 2)
+        params = _params(model, batch)
+        lt_d, _ = make_gpt2_losses(model)
+        loss_d, _, cnt_d, _ = lt_d(params, {}, batch, jax.random.key(1), True)
+        g_d = jax.grad(
+            lambda p: lt_d(p, {}, batch, jax.random.key(1), True)[0])(params)
+
+        mesh = make_mesh([("stage", S)])
+        lt_p, _ = make_gpt2_pp_losses(model, S, n_micro=n_micro)
+
+        def f(p, b):
+            loss, _, cnt, _ = lt_p(p, {}, b, jax.random.key(1), True)
+            g = jax.grad(
+                lambda q: lt_p(q, {}, b, jax.random.key(1), True)[0])(p)
+            g = jax.tree_util.tree_map(
+                lambda x: jax.lax.psum(x, "stage"), g)
+            return loss, cnt, g
+
+        loss_p, cnt_p, g_p = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))(params, batch)
+        np.testing.assert_allclose(float(loss_p), float(loss_d), rtol=1e-5)
+        assert float(cnt_p) == float(cnt_d)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5),
+            g_p, g_d)
+
+    def test_val_matches_dense_odd_batch(self):
+        """Validation batches with sizes that don't divide n_micro degrade
+        to the largest divisor instead of failing (auto microbatching)."""
+        model = _model()
+        batch = _batch(5, 2)  # 5 examples, n_micro=4 -> auto-reduced to 1
+        params = _params(model, batch)
+        _, lv_d = make_gpt2_losses(model)
+        nll_d, (acc_d,), cnt_d, _ = lv_d(params, {}, batch,
+                                         jax.random.key(2), False)
+        mesh = make_mesh([("stage", 2)])
+        _, lv_p = make_gpt2_pp_losses(model, 2, n_micro=4)
+        nll_p, (acc_p,), cnt_p, _ = jax.jit(shard_map(
+            lambda p, b: lv_p(p, {}, b, jax.random.key(2), False),
+            mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))(params, batch)
+        np.testing.assert_allclose(float(nll_p), float(nll_d), rtol=1e-5)
+        assert float(acc_p) == float(acc_d)
+        assert float(cnt_p) == float(cnt_d)
+
+    def test_train_dropout_runs_and_is_finite(self):
+        """With dropout active the pipelined loss is finite and the rng
+        protocol (per-microbatch fold_in) compiles; exact parity with the
+        dense path is not expected (different mask derivation)."""
+        model = _model().copy(dropout=0.2)
+        batch = _batch(4, 2)
+        params = _params(model, batch)
+        mesh = make_mesh([("stage", 2)])
+        lt_p, _ = make_gpt2_pp_losses(model, 2, n_micro=2)
+        loss, _, cnt, _ = jax.jit(shard_map(
+            lambda p, b: lt_p(p, {}, b, jax.random.key(3), True),
+            mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))(params, batch)
+        assert np.isfinite(float(loss)) and float(cnt) == 4.0
+
+    def test_rejects_illegal_combos(self):
+        with pytest.raises(AssertionError, match="attn_impl"):
+            make_gpt2_pp_losses(_model().copy(attn_impl="ring"), 2)
+        with pytest.raises(AssertionError, match="tensor"):
+            make_gpt2_pp_losses(_model().copy(model_axis="model"), 2)
+
+
+class TestPPRound:
+    def _build(self, mesh, pp_axis, losses, fuse=None):
+        W, B, C = 2, 2, 2
+        model = _model()
+        ids0 = jnp.zeros((1, C, T), jnp.int32)
+        params = model.init(jax.random.key(0), ids0, token_type_ids=ids0,
+                            mc_token_ids=jnp.zeros((1, C), jnp.int32),
+                            train=False)["params"]
+        flat, unravel = ravel_pytree(params)
+        d = int(flat.size)
+
+        def ravel(tree):
+            return ravel_pytree(tree)[0]
+
+        wcfg = WorkerConfig(mode="uncompressed", error_type="virtual",
+                            num_workers=W, pp_axis=pp_axis)
+        scfg = ServerConfig(mode="uncompressed", error_type="virtual",
+                            grad_size=d, virtual_momentum=0.9)
+        cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
+                          fuse_gradients=fuse)
+        lt, lv = losses(model)
+        steps = build_round_step(lt, lv, unravel, ravel, cfg, mesh=mesh)
+        rng = np.random.RandomState(3)
+        batch = {
+            "input_ids": _ids(4, (W, B, C, T)),
+            "token_type_ids": _ids(5, (W, B, C, T)),
+            "lm_labels": _ids(6, (W, B, C, T)),
+            "mc_token_ids": _ids(8, (W, B, C), hi=T),
+            "mc_labels": jnp.asarray(rng.randint(0, C, (W, B)), jnp.int32),
+            "mask": jnp.ones((W, B), jnp.float32),
+            "client_ids": jnp.arange(W, dtype=jnp.int32),
+            "worker_mask": jnp.ones(W, jnp.float32),
+        }
+        ss = init_server_state(scfg, None)
+        cs = init_client_states(4, d, wcfg)
+        return steps, flat, ss, cs, batch
+
+    @pytest.mark.parametrize("fuse", [False, True])
+    def test_round_matches_dense(self, fuse):
+        """A full federated round over a clients x stage mesh produces the
+        same new weights and metrics as the dense round over clients only —
+        the one-psum gradient reconciliation is exact up to float summation
+        order. Covers both the per-client and fused-gradient phases."""
+        mesh_d = make_mesh([("clients", 2)])
+        mesh_p = make_mesh([("clients", 2), ("stage", 2)])
+
+        def run(mesh, axis, losses):
+            steps, flat, ss, cs, batch = self._build(mesh, axis, losses,
+                                                     fuse=fuse)
+            out = steps.train_step(flat, ss, cs, {}, batch, 0.1,
+                                   jax.random.key(7))
+            return np.asarray(out[0]), [np.asarray(m) for m in out[4]]
+
+        w_d, m_d = run(mesh_d, None, lambda m: make_gpt2_losses(m))
+        w_p, m_p = run(mesh_p, "stage",
+                       lambda m: make_gpt2_pp_losses(m, 2, n_micro=2))
+        np.testing.assert_allclose(w_p, w_d, atol=2e-5, rtol=2e-5)
+        for a, b in zip(m_p, m_d):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+    def test_val_step_runs_replicated(self):
+        """val_step wraps the pipelined loss in its own shard_map."""
+        mesh_p = make_mesh([("clients", 2), ("stage", 2)])
+        steps, flat, ss, cs, batch = self._build(
+            mesh_p, "stage", lambda m: make_gpt2_pp_losses(m, 2, n_micro=2))
+        vbatch = {k: v.reshape((-1,) + v.shape[2:])
+                  for k, v in batch.items()
+                  if k not in ("client_ids", "worker_mask")}
+        metrics = steps.val_step(flat, {}, vbatch)
+        assert all(np.isfinite(np.asarray(m)).all() for m in metrics)
+
+    def test_degrades_gracefully_without_devices(self):
+        """--pipeline_devices on a host with too few devices: the mesh
+        policy warns and drops the axis, and the worker config derived from
+        the REALIZED mesh clears pp_axis."""
+        from commefficient_tpu.config import parse_args
+        from commefficient_tpu.federated.aggregator import (
+            worker_config_from_args,
+        )
+        from commefficient_tpu.parallel.mesh import default_client_mesh
+
+        with pytest.warns(UserWarning, match="--pipeline_devices 2 reduced"):
+            mesh = default_client_mesh(2, -1, devices=jax.devices()[:1],
+                                       pipeline_devices=2)
+        assert "stage" not in mesh.axis_names
+        args = parse_args(argv=["--mode", "uncompressed",
+                                "--local_momentum", "0",
+                                "--pipeline_devices", "2"])
+        wcfg = worker_config_from_args(args, mesh=mesh)
+        assert wcfg.pp_axis is None
+
+    def test_cv_entrypoint_rejects_pipeline_devices(self, tmp_path):
+        """Pipeline parallelism is GPT-2 only; the CV entrypoint must say
+        so instead of silently halving the clients axis."""
+        import cv_train
+
+        with pytest.raises(AssertionError, match="GPT-2 only"):
+            cv_train.main(["--dataset_name", "CIFAR10",
+                           "--dataset_dir", str(tmp_path / "d"),
+                           "--mode", "uncompressed", "--local_momentum", "0",
+                           "--pipeline_devices", "2"])
+
+    def test_config_rejects_combo_with_tp_and_sp(self):
+        from commefficient_tpu.config import parse_args
+
+        with pytest.raises(AssertionError, match="pipeline_devices"):
+            parse_args(argv=["--mode", "uncompressed", "--local_momentum",
+                             "0", "--pipeline_devices", "2",
+                             "--model_devices", "2"])
+        with pytest.raises(AssertionError, match="pipeline_devices"):
+            parse_args(argv=["--mode", "uncompressed", "--local_momentum",
+                             "0", "--pipeline_devices", "2",
+                             "--seq_parallel", "ring"])
