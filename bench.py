@@ -621,6 +621,16 @@ def main() -> int:
         result["extra"].update(c4 if c4 is not None else {"cifar100_error": err})
         _save_tpu_cache(result)
 
+    if result is None and os.environ.get("BENCH_REQUIRE_TPU"):
+        # batch-runner mode (scripts/tpu_batch.sh): a dead tunnel should
+        # fail fast so the next queued TPU task can run, not burn the
+        # window on a CPU fallback nobody records
+        _log(f"BENCH_REQUIRE_TPU set and TPU unavailable ({tpu_error}); "
+             f"exiting without CPU fallback")
+        print(json.dumps({"error": f"tpu unavailable: {tpu_error}",
+                          "require_tpu": True}), flush=True)
+        return 3
+
     if result is None:
         _log(f"falling back to CPU tiny geometry (timeout {cpu_timeout:.0f}s)")
         result, err = _run_child(["--run", "tiny"], _cpu_env(), cpu_timeout)

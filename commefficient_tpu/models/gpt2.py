@@ -188,8 +188,12 @@ class Block(nn.Module):
 
         q, k, v = heads(q), heads(k), heads(v)
         if self.attn_impl == "dense":
-            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(
-                C // self.n_head)
+            # python-float scale: WEAKLY typed, so bf16 activations stay
+            # bf16 (an np.sqrt scalar here is float64-strong and silently
+            # promoted the whole residual stream — and thus every later
+            # matmul — to f32, defeating --bf16 on the MXU)
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (
+                1.0 / float(np.sqrt(C // self.n_head)))
             att = jnp.where(mask, att, jnp.finfo(att.dtype).min)
             att = jax.nn.softmax(att, axis=-1)
             att = nn.Dropout(self.dropout)(att, deterministic=deterministic)

@@ -90,6 +90,13 @@ def main():
     import jax
 
     print("backend:", jax.default_backend(), flush=True)
+    if jax.default_backend() not in ("tpu", "axon") and not os.environ.get(
+            "COMMEFFICIENT_LEARNING_ALLOW_CPU"):
+        # chip-only: at d=6.5M a CPU epoch takes hours; a dead-tunnel
+        # fallback would burn the batch window for an unusable number
+        # (set COMMEFFICIENT_LEARNING_ALLOW_CPU=1 to override)
+        sys.exit("learning_fullscale: backend is not a TPU; refusing "
+                 "the full-scale run on CPU")
     out = {"epochs": EPOCHS,
            "per_class": os.environ["COMMEFFICIENT_SYNTHETIC_PER_CLASS"],
            "backend": jax.default_backend()}

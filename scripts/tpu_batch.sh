@@ -1,8 +1,11 @@
 #!/bin/bash
 # One-shot TPU task queue for a tunnel-revival window. Probes liveness,
-# then runs the round-3 measurement batch in priority order, logging to
-# runs/tpu_batch_<ts>/. Each step has its own timeout so a re-wedge mid-
-# batch cannot eat the already-captured results.
+# then runs the round-3 batch in VALUE order — the driver bench artifact
+# first, the full-scale learning run second, the GPT-2 measurement legs
+# third, and the wedge-prone chained micro-op legs last — re-probing
+# between steps so a re-wedge (or a step's unreleased chip claim) aborts
+# the rest instead of burning each step's timeout on CPU fallbacks.
+# Logs to runs/tpu_batch_<ts>/.
 #
 # Usage: bash scripts/tpu_batch.sh   (claims the single axon chip)
 set -u
@@ -14,30 +17,53 @@ echo "logging to $OUT"
 
 log() { echo "[tpu_batch $(date +%H:%M:%S)] $*" | tee -a "$OUT/batch.log"; }
 
-log "probe: small matmul + scalar fetch (timeout 120s)"
-if ! timeout 120 python -c "
+probe() {
+  timeout "${1:-120}" python -c "
 import jax, jax.numpy as jnp
 assert jax.default_backend() in ('tpu', 'axon'), \
     f'backend {jax.default_backend()} is not a TPU'
 x = jnp.ones((512, 512), jnp.bfloat16)
 print('alive:', float((x @ x).ravel()[0]))
-" >>"$OUT/batch.log" 2>&1; then
+" >>"$OUT/batch.log" 2>&1
+}
+
+# probe with one retry after a cool-down: a just-killed step may still
+# hold the chip claim for a while
+probe_or_abort() {
+  sleep 20
+  if probe 150; then return 0; fi
+  log "probe failed; cooling down 120s and retrying"
+  sleep 120
+  if probe 180; then return 0; fi
+  log "tunnel DEAD after $1 — aborting the rest of the batch"
+  exit 1
+}
+
+if ! probe 120; then
   log "tunnel DEAD — aborting batch"
   exit 1
 fi
 log "tunnel ALIVE — running the batch"
 
-log "step 1/3: scripts/tpu_measure.py (timeout 40m)"
-timeout 2400 python scripts/tpu_measure.py >"$OUT/tpu_measure.log" 2>&1
-log "step 1 rc=$? (see $OUT/tpu_measure.log)"
+log "step 1/4: full bench.py, TPU-required (timeout 75m)"
+BENCH_REQUIRE_TPU=1 timeout 4500 python bench.py \
+  >"$OUT/bench.json" 2>"$OUT/bench.log"
+log "step 1 rc=$? ($(tail -c 300 "$OUT/bench.json" 2>/dev/null))"
+probe_or_abort "bench"
 
-log "step 2/3: full bench.py (timeout 90m)"
-timeout 5400 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
-log "step 2 rc=$? ($(tail -c 300 "$OUT/bench.json" 2>/dev/null))"
+log "step 2/4: learning_fullscale.py (timeout 90m)"
+timeout 5400 python scripts/learning_fullscale.py >"$OUT/learning.log" 2>&1
+log "step 2 rc=$? (docs/learning_fullscale.json written on success)"
+probe_or_abort "learning"
 
-log "step 3/3: learning_fullscale.py (timeout 90m)"
-timeout 5400 python scripts/learning_fullscale.py \
-  >"$OUT/learning.log" 2>&1
-log "step 3 rc=$? (docs/learning_fullscale.json written on success)"
+log "step 3/4: tpu_measure.py gpt2 legs (timeout 40m)"
+timeout 2400 python scripts/tpu_measure.py gpt2 >"$OUT/tpu_measure_gpt2.log" 2>&1
+log "step 3 rc=$? (see $OUT/tpu_measure_gpt2.log)"
+probe_or_abort "gpt2 measure"
+
+log "step 4/4: tpu_measure.py matmul cifar ops (timeout 40m)"
+timeout 2400 python scripts/tpu_measure.py matmul cifar ops \
+  >"$OUT/tpu_measure.log" 2>&1
+log "step 4 rc=$? (see $OUT/tpu_measure.log)"
 
 log "batch done"

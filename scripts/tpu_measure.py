@@ -6,11 +6,12 @@ scalar materialization (the tunnel runtime is lazy; ``block_until_ready``
 alone undercounts), subtract the measured scalar-fetch round trip, and take
 best-of-N against tenancy noise.
 
-Measures: the CIFAR and GPT-2 (f32/bf16) fused federated rounds, per-op
-sketch/estimates/top-k costs at both FetchSGD geometries, and the
-touched-cells A/B — a sparse-scatter candidate replacement for the server's
-dense re-sketch of the k-sparse update (equivalent masks verified on CPU;
-integrate only if flatnonzero+scatter beats the ~2/9 ms dense re-sketch).
+Measures: the CIFAR and GPT-2 (f32/bf16) fused federated rounds and per-op
+sketch/estimates/top-k costs at both FetchSGD geometries. The touched-cells
+A/B (sparse-scatter replacement for the server's dense re-sketch) was
+DECIDED on-chip 2026-07-31: flatnonzero+scatter measured 63.8 ms vs 2.17 ms
+for the dense re-sketch at d=6.5M — dropped, the dense re-sketch stays
+(see BASELINE.md).
 
 Run on the real chip (claims the tunnel):  python scripts/tpu_measure.py
 """
@@ -30,8 +31,6 @@ import jax.numpy as jnp
 import bench as B
 from commefficient_tpu.ops import sketch as sk
 from commefficient_tpu.ops.topk import topk
-
-_LANES = 128
 
 
 def drain(x):
@@ -90,21 +89,6 @@ def chained(f, x0, n=5, K=20):
     return best
 
 
-def touched_cells(cs, update, k_max):
-    """Sparse-scatter equivalent of ``sketch_vec(cs, update) != 0``."""
-    idx = jnp.flatnonzero(update, size=k_max, fill_value=cs.d)
-    pos = (idx % cs.c_pad).astype(jnp.int32)
-    chunk = (idx // cs.c_pad).astype(jnp.int32)
-    m = cs.shift_q * _LANES + cs.shift_w
-    out = jnp.zeros((cs.r, cs.c_pad), bool)
-    oob = idx >= cs.d
-    for j in range(cs.r):
-        cell = (pos + m[j, jnp.clip(chunk, 0, cs.T - 1)]) % cs.c_pad
-        cell = jnp.where(oob, cs.c_pad, cell)
-        out = out.at[j, cell].set(True, mode="drop")
-    return out
-
-
 def matmul_peak_probe():
     """Achievable-matmul-rate ceiling on this chip, bf16 and f32: the MFU
     denominator sanity check (v5e nominal bf16 peak is 197 TFLOP/s; what a
@@ -156,20 +140,27 @@ def cifar_leg():
 
 
 def sketch_ops_leg(d):
+    """Robust-and-cheap legs first; the wedge-prone chained pieces (deep
+    while_loop HLOs, pallas A/B) last so a mid-leg tunnel abort costs the
+    least information."""
     geo = sk.make_sketch(d, c=500_000, r=5, seed=42, num_blocks=20)
     v = jnp.asarray(np.random.RandomState(0).randn(d).astype(np.float32))
     tbl = sk.sketch_vec(geo, v)
     est = sk.estimates(geo, tbl)
     upd = topk(est, 50_000)
     drain(upd)
+    t_sv = leg("sketch_vec", chained,
+               lambda x: x + sk.sketch_vec(geo, x)[0, 0] * 1e-38, v)
+    if t_sv is not None:
+        print(f"d={d}: sketch_vec {t_sv:.2f} ms", flush=True)
+    t_es = leg("est+sketch", chained,
+               lambda t: sk.sketch_vec(geo, sk.estimates(geo, t)), tbl)
+    if t_es is not None:
+        print(f"d={d}: est+sketch {t_es:.2f} ms", flush=True)
     t_resk = leg("resketch", chained,
                  lambda u: u + sk.sketch_vec(geo, u)[0, 0] * 1e-38, upd)
     if t_resk is not None:
         print(f"d={d}: resketch {t_resk:.2f} ms", flush=True)
-    t_tc = leg("touched-cells", chained,
-               lambda u: u + touched_cells(geo, u, 50_064)[0, 0] * 1e-38, upd)
-    if t_tc is not None:
-        print(f"d={d}: touched-cells {t_tc:.2f} ms", flush=True)
     # topk's radix descent is a while_loop — chain a SHORT unroll (K=4);
     # the K=20 unroll produced an HLO big enough to kill the tunnel's
     # remote compile
@@ -209,14 +200,6 @@ def sketch_ops_leg(d):
               f"ms | outputs equal: {same}", flush=True)
     except Exception as e:  # noqa: BLE001
         print(f"d={d}: pallas topk failed: {str(e)[:300]}", flush=True)
-    t_sv = leg("sketch_vec", chained,
-               lambda x: x + sk.sketch_vec(geo, x)[0, 0] * 1e-38, v)
-    if t_sv is not None:
-        print(f"d={d}: sketch_vec {t_sv:.2f} ms", flush=True)
-    t_es = leg("est+sketch", chained,
-               lambda t: sk.sketch_vec(geo, sk.estimates(geo, t)), tbl)
-    if t_es is not None:
-        print(f"d={d}: est+sketch {t_es:.2f} ms", flush=True)
 
 
 def gpt2_leg(bf16):

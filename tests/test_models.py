@@ -157,6 +157,30 @@ class TestBF16Compute:
                      * np.linalg.norm(grads["f32"]) + 1e-12))
         assert cos > 0.95, f"bf16 grad cosine {cos:.4f} vs f32"
 
+    def test_gpt2_bf16_stays_bf16_end_to_end(self):
+        """With bf16 params, the dense GPT-2 forward must produce bf16
+        logits — i.e. no hidden f32 upcast anywhere in the block stack.
+        Regression: the attention score scale was an np.float64 scalar
+        (strongly typed), which silently promoted the residual stream — and
+        every later matmul — to f32 from block 0 onward, defeating --bf16
+        on the MXU (measured round 2 as bf16 ≈ f32 tokens/sec)."""
+        from commefficient_tpu.federated.losses import _cast_tree
+        from commefficient_tpu.models.gpt2 import GPT2DoubleHeads
+
+        model = GPT2DoubleHeads(vocab_size=128, n_positions=32, n_embd=32,
+                                n_layer=2, n_head=2, dropout=0.0)
+        rng = np.random.RandomState(1)
+        ids = jnp.asarray(rng.randint(0, 128, (2, 2, 32)), jnp.int32)
+        mc = jnp.asarray(rng.randint(0, 32, (2, 2)), jnp.int32)
+        params = model.init(jax.random.key(0), ids, token_type_ids=ids,
+                            mc_token_ids=mc, train=False)["params"]
+        lm, mc_logits = model.apply(
+            {"params": _cast_tree(params, jnp.bfloat16)}, ids,
+            token_type_ids=ids, mc_token_ids=mc, train=False)
+        assert lm.dtype == jnp.bfloat16, \
+            f"hidden f32 upcast in the bf16 forward: logits {lm.dtype}"
+        assert mc_logits.dtype == jnp.bfloat16
+
     def test_gpt2_loss_close_to_f32(self):
         from commefficient_tpu.federated.losses import make_gpt2_losses
         from commefficient_tpu.models.gpt2 import GPT2DoubleHeads

@@ -146,6 +146,26 @@ class TestPPLosses:
             check_vma=False))(params, batch)
         assert np.isfinite(float(loss)) and float(cnt) == 4.0
 
+    def test_bf16_compute_tracks_f32(self):
+        """compute_dtype=bf16 runs the pipeline in bf16 (activations and
+        ppermute buffers included) with f32 loss accumulation; the loss
+        tracks the f32 pipeline to bf16 resolution."""
+        model = _model()
+        batch = _batch(4, 2)
+        params = _params(model, batch)
+        mesh = make_mesh([("stage", 2)])
+        losses = {}
+        for tag, dt in (("f32", None), ("bf16", jnp.bfloat16)):
+            lt_p, _ = make_gpt2_pp_losses(model, 2, n_micro=2,
+                                          compute_dtype=dt)
+            loss, _, _, _ = jax.jit(shard_map(
+                lambda p, b, lt=lt_p: lt(p, {}, b, jax.random.key(1), True),
+                mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+                check_vma=False))(params, batch)
+            losses[tag] = float(loss)
+        assert np.isfinite(losses["bf16"])
+        np.testing.assert_allclose(losses["bf16"], losses["f32"], rtol=0.05)
+
     def test_rejects_illegal_combos(self):
         with pytest.raises(AssertionError, match="attn_impl"):
             make_gpt2_pp_losses(_model().copy(attn_impl="ring"), 2)
