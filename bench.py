@@ -506,9 +506,11 @@ def _cpu_env() -> dict:
 
 
 def _tpu_env() -> dict:
+    from __graft_entry__ import apply_tpu_cache_env
+
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
-    return env
+    return apply_tpu_cache_env(env)
 
 
 # Deliberately tracked in git (not gitignored): the driver's round-end bench

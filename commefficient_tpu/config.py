@@ -145,6 +145,18 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                         help="GPipe microbatches per client batch when "
                              "--pipeline_devices > 1 (auto-reduced to a "
                              "divisor of the batch).")
+    # Mixture-of-Experts + expert parallelism (TPU-first extension, GPT-2
+    # only; parallel/moe.py): --n_experts > 0 gives every other transformer
+    # block a top-1-routed (Switch-style) MoE MLP; --expert_devices shards
+    # the experts over an `expert` mesh axis. Parameters stay full-shape/
+    # replicated like --model_devices, so compression and checkpoints are
+    # unchanged.
+    parser.add_argument("--n_experts", type=int, default=0,
+                        help="Experts per MoE MLP for GPT-2 (0 = dense "
+                             "MLPs, the reference architecture).")
+    parser.add_argument("--expert_devices", type=int, default=1,
+                        help="Size of the `expert` (expert-parallel) mesh "
+                             "axis for GPT-2 MoE (1 disables).")
     # TPU-first extension: dropout/DP mask PRNG. threefry (JAX default) is
     # counter-based ALU work; rbg uses the TPU hardware RNG and is much
     # cheaper at GPT-2 mask volumes. unsafe_rbg additionally relaxes
@@ -213,6 +225,23 @@ def validate_args(args):
         assert args.seq_parallel == "none" and args.model_devices == 1, (
             "--pipeline_devices > 1 currently requires --seq_parallel none "
             "and --model_devices 1")
+    assert args.n_experts >= 0, "--n_experts must be >= 0"
+    assert args.expert_devices >= 1, "--expert_devices must be >= 1"
+    if args.n_experts > 0:
+        assert args.model_devices == 1, (
+            "--n_experts > 0 currently requires --model_devices 1")
+        assert args.pipeline_devices == 1, (
+            "--n_experts > 0 currently requires --pipeline_devices 1 "
+            "(the pipeline stage blocks are dense)")
+    if args.expert_devices > 1:
+        assert args.n_experts > 0, "--expert_devices > 1 requires --n_experts"
+        assert args.n_experts % args.expert_devices == 0, (
+            f"--n_experts {args.n_experts} must divide by "
+            f"--expert_devices {args.expert_devices}")
+        assert (args.seq_parallel == "none" and args.model_devices == 1
+                and args.pipeline_devices == 1), (
+            "--expert_devices > 1 currently requires --seq_parallel none, "
+            "--model_devices 1 and --pipeline_devices 1")
     if args.device:
         # select the JAX platform before the backend initializes (the
         # reference's --device picks the torch device; here e.g.

@@ -86,6 +86,8 @@ def worker_config_from_args(args, mesh=None) -> WorkerConfig:
         else None
     model_axis = "model" if getattr(args, "model_devices", 1) > 1 else None
     pp_axis = "stage" if getattr(args, "pipeline_devices", 1) > 1 else None
+    expert_axis = "expert" if getattr(args, "expert_devices", 1) > 1 \
+        else None
     if mesh is not None:
         if seq_axis is not None and seq_axis not in mesh.axis_names:
             seq_axis = None
@@ -93,6 +95,8 @@ def worker_config_from_args(args, mesh=None) -> WorkerConfig:
             model_axis = None
         if pp_axis is not None and pp_axis not in mesh.axis_names:
             pp_axis = None
+        if expert_axis is not None and expert_axis not in mesh.axis_names:
+            expert_axis = None
     return WorkerConfig(
         mode=args.mode,
         error_type=args.error_type,
@@ -113,6 +117,7 @@ def worker_config_from_args(args, mesh=None) -> WorkerConfig:
         seq_axis=seq_axis,
         model_axis=model_axis,
         pp_axis=pp_axis,
+        expert_axis=expert_axis,
     )
 
 
@@ -161,7 +166,11 @@ class FedModel:
                                        getattr(args, "num_devices", -1),
                                        seq_devices=seq_devices,
                                        model_devices=getattr(
-                                           args, "model_devices", 1))
+                                           args, "model_devices", 1),
+                                       expert_devices=getattr(
+                                           args, "expert_devices", 1),
+                                       n_experts=getattr(
+                                           args, "n_experts", 0))
         self.mesh = mesh
         self.training = True
 
@@ -201,8 +210,14 @@ class FedModel:
             from commefficient_tpu.models.gpt2 import tp_sliced_param
 
             tp_sliced = tp_sliced_param
+        ep_sliced = None
+        if wcfg.expert_axis is not None:
+            from commefficient_tpu.parallel.moe import ep_sliced_param
+
+            ep_sliced = ep_sliced_param
         cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=self.grad_size,
-                          do_test=args.do_test, tp_sliced=tp_sliced)
+                          do_test=args.do_test, tp_sliced=tp_sliced,
+                          ep_sliced=ep_sliced)
         from commefficient_tpu.federated.losses import make_cv_losses  # noqa: F401
 
         self.steps = build_round_step(

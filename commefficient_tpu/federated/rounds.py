@@ -146,6 +146,11 @@ class RoundConfig:
     # models.gpt2.tp_sliced_param). Required when worker.model_axis is set;
     # used to build the flat grad-rescale mask (1 sliced, 1/nm replicated).
     tp_sliced: Optional[Callable[[str], bool]] = None
+    # Expert parallelism: same contract for the `expert` axis (e.g.
+    # parallel.moe.ep_sliced_param — 1 on expert-stacked MoE weights,
+    # 1/ne on the router and every dense param). Required when
+    # worker.expert_axis is set.
+    ep_sliced: Optional[Callable[[str], bool]] = None
 
 
 class FederatedSteps(NamedTuple):
@@ -211,28 +216,41 @@ def build_round_step(
     # fused sketch mode only ever rides the sketch-after-sum path
     assert not (fused_grad and wcfg.mode == "sketch" and not sketch_after_sum)
 
-    # Tensor parallelism: flat grad-rescale mask built once, host-side —
-    # 1.0 on segments whose weights the model computes slice-locally per
-    # model shard, 1/nm where every shard computed the identical full grad
-    # (see worker.WorkerConfig.model_axis).
-    tp_scale = None
-    if wcfg.model_axis is not None:
-        assert mesh is not None and wcfg.model_axis in mesh.axis_names, \
-            f"model_axis {wcfg.model_axis!r} not in mesh axes"
-        assert cfg.tp_sliced is not None, \
-            "worker.model_axis set but RoundConfig.tp_sliced is missing"
-        nm = mesh.shape[wcfg.model_axis]
+    # Tensor/expert parallelism: flat grad-rescale masks built once,
+    # host-side — 1.0 on segments whose weights the model computes
+    # slice-locally per shard of the axis, 1/n where every shard computed
+    # the identical full grad (see worker.WorkerConfig.model_axis /
+    # .expert_axis).
+    def _flat_scale(axis_name, sliced_pred, pred_attr):
+        assert mesh is not None and axis_name in mesh.axis_names, \
+            f"axis {axis_name!r} not in mesh axes"
+        assert sliced_pred is not None, \
+            f"worker axis {axis_name!r} set but RoundConfig.{pred_attr} " \
+            f"is missing"
+        n = mesh.shape[axis_name]
         tpl = unravel(jnp.zeros(cfg.grad_size, jnp.float32))
         leaves = jax.tree_util.tree_leaves_with_path(tpl)
         segs = []
         for path, leaf in leaves:
             keys = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
                             for p in path).lower()
-            val = 1.0 if cfg.tp_sliced(keys) else 1.0 / nm
+            val = 1.0 if sliced_pred(keys) else 1.0 / n
             segs.append(jnp.full(int(np.prod(leaf.shape)), val, jnp.float32))
-        tp_scale = jnp.concatenate(segs)
-        assert tp_scale.size == cfg.grad_size, \
-            "tp_scale layout does not match the flat vector"
+        scale = jnp.concatenate(segs)
+        assert scale.size == cfg.grad_size, \
+            f"{pred_attr} scale layout does not match the flat vector"
+        return scale
+
+    tp_scale = None
+    if wcfg.model_axis is not None:
+        tp_scale = _flat_scale(wcfg.model_axis, cfg.tp_sliced, "tp_sliced")
+    ep_scale = None
+    if wcfg.expert_axis is not None:
+        assert wcfg.model_axis is None and wcfg.seq_axis is None \
+            and wcfg.pp_axis is None, \
+            "expert parallelism cannot combine with seq/tensor/pipeline " \
+            "parallelism (v1)"
+        ep_scale = _flat_scale(wcfg.expert_axis, cfg.ep_sliced, "ep_sliced")
 
     # Pipeline parallelism (parallel/pipeline.py): the loss callbacks carry
     # the GPipe schedule; the round only needs the one-gradient psum over
@@ -300,6 +318,9 @@ def build_round_step(
         if wcfg.pp_axis is not None:
             # disjoint stage-local gradient segments -> full gradient
             g_sum = jax.lax.psum(g_sum, wcfg.pp_axis)
+        if wcfg.expert_axis is not None:
+            # expert-sliced/replicated reconciliation (see worker.forward_grad)
+            g_sum = jax.lax.psum(g_sum, wcfg.expert_axis) * ep_scale
         if wcfg.weight_decay != 0:
             # per-client (wd/num_workers)·w scaled by the client's datum
             # count (worker.forward_grad + local_step ×count)
@@ -339,14 +360,16 @@ def build_round_step(
         elif wcfg.mode == "fedavg":
             res, new_ms = fedavg_local(compute_loss_train, weights_used,
                                        unravel, ravel, model_state, batch_row,
-                                       rng, lr, wcfg, tp_scale=tp_scale)
+                                       rng, lr, wcfg, tp_scale=tp_scale,
+                                       ep_scale=ep_scale)
             transmit, new_vel, new_err, metrics = (res.transmit, vel_row,
                                                    err_row, res.metrics)
         else:
             res, new_ms = local_step(compute_loss_train, weights_used,
                                      unravel, ravel, model_state, vel_row,
                                      err_row, batch_row, rng, inner_wcfg,
-                                     sketch, tp_scale=tp_scale)
+                                     sketch, tp_scale=tp_scale,
+                                     ep_scale=ep_scale)
             transmit, new_vel, new_err, metrics = (res.transmit,
                                                    res.new_velocity,
                                                    res.new_error, res.metrics)
@@ -567,10 +590,11 @@ def build_round_step(
                                 out_specs=P(), check_vma=False)
             return sharded(ps_weights, model_state, batch)
         if mesh is not None and (wcfg.model_axis is not None
-                                 or wcfg.pp_axis is not None):
-            # tensor-/pipeline-parallel model: the apply must run inside a
-            # shard_map that binds the axis; everything is replicated, the
-            # internal psums make the outputs replicated too
+                                 or wcfg.pp_axis is not None
+                                 or wcfg.expert_axis is not None):
+            # tensor-/pipeline-/expert-parallel model: the apply must run
+            # inside a shard_map that binds the axis; everything is
+            # replicated, the internal psums make the outputs replicated too
             sharded = shard_map(_val, mesh=mesh, in_specs=(P(), P(), P()),
                                 out_specs=P(), check_vma=False)
             return sharded(ps_weights, model_state, batch)

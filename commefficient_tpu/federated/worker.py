@@ -102,6 +102,14 @@ class WorkerConfig:
     # any nonlinear transform, so every compression mode sees the full
     # gradient, replicated across stage shards.
     pp_axis: Optional[str] = None
+    # Expert-parallel mesh axis (GShard/Switch-style MoE, GPT-2 only; no
+    # reference equivalent — parallel/moe.py). Each shard computes only
+    # its E/ne experts, so expert-sliced params get slice-local grads
+    # (zero outside the slice) while the router and all dense params get
+    # identical replicated grads; forward_grad reconciles with one psum +
+    # a flat rescale mask (1 on expert segments, 1/ne elsewhere), exactly
+    # the model_axis scheme.
+    expert_axis: Optional[str] = None
 
     @property
     def has_velocity(self) -> bool:
@@ -211,7 +219,7 @@ def _microbatch_grads(compute_loss, params, model_state, batch, rng,
 
 def forward_grad(compute_loss, params_flat, unravel, ravel, model_state,
                  batch, rng, cfg: WorkerConfig, sketch: Optional[CountSketch],
-                 compute_grad: bool = True, tp_scale=None):
+                 compute_grad: bool = True, tp_scale=None, ep_scale=None):
     """reference fed_worker.py:249-335 as a pure function.
 
     Returns (transmit_or_None, (loss_mean, *metric_means, count),
@@ -241,6 +249,11 @@ def forward_grad(compute_loss, params_flat, unravel, ravel, model_state,
         # pipeline stages hold disjoint gradient segments (zero elsewhere);
         # one psum reassembles the full gradient (see WorkerConfig.pp_axis)
         grad = jax.lax.psum(grad, cfg.pp_axis)
+    if cfg.expert_axis is not None:
+        # expert-sliced segments assemble across shards; the replicated
+        # rest is overcounted by ne, fixed by the 1/ne entries of ep_scale
+        # (see WorkerConfig.expert_axis)
+        grad = jax.lax.psum(grad, cfg.expert_axis) * ep_scale
     # weight decay (reference utils.py:254-259)
     if cfg.weight_decay != 0:
         grad = grad + (cfg.weight_decay / cfg.num_workers) * params_flat
@@ -274,11 +287,11 @@ def forward_grad(compute_loss, params_flat, unravel, ravel, model_state,
 def local_step(compute_loss, params_flat, unravel, ravel, model_state,
                velocity, error, batch, rng, cfg: WorkerConfig,
                sketch: Optional[CountSketch],
-               tp_scale=None) -> Tuple[ClientResult, Any]:
+               tp_scale=None, ep_scale=None) -> Tuple[ClientResult, Any]:
     """One client's training contribution (reference fed_worker.py:184-230)."""
     g, metrics, new_state, _ = forward_grad(
         compute_loss, params_flat, unravel, ravel, model_state, batch, rng,
-        cfg, sketch, tp_scale=tp_scale)
+        cfg, sketch, tp_scale=tp_scale, ep_scale=ep_scale)
     count = metrics[-1]
     # sum-of-example-gradients scaling (fed_worker.py:190); linear, so it
     # applies to sketch tables too
@@ -309,7 +322,7 @@ def local_step(compute_loss, params_flat, unravel, ravel, model_state,
 
 def fedavg_local(compute_loss, params_flat, unravel, ravel, model_state,
                  batch, rng, lr, cfg: WorkerConfig,
-                 tp_scale=None) -> Tuple[ClientResult, Any]:
+                 tp_scale=None, ep_scale=None) -> Tuple[ClientResult, Any]:
     """FedAvg local training (reference fed_worker.py:61-113): local SGD over
     chunked whole-client batch, transmit (w₀ − w_final)·dataset_size."""
     B = batch["mask"].shape[0]
@@ -334,6 +347,9 @@ def fedavg_local(compute_loss, params_flat, unravel, ravel, model_state,
         if cfg.pp_axis is not None:
             # disjoint stage-local gradient segments -> full gradient
             g = jax.lax.psum(g, cfg.pp_axis)
+        if cfg.expert_axis is not None:
+            # expert-sliced/replicated reconciliation (see forward_grad)
+            g = jax.lax.psum(g, cfg.expert_axis) * ep_scale
         return g, loss_sum, msums, count, new_ms
 
     n_metrics = probe_n_metrics(

@@ -171,6 +171,11 @@ class Block(nn.Module):
     # mask pattern across shards' disjoint head slices — a documented,
     # statistically mild deviation).
     model_axis: Optional[str] = None
+    # Mixture-of-Experts (parallel/moe.py): n_experts > 0 replaces this
+    # block's dense MLP with a top-1-routed MoE MLP; expert_axis shards
+    # the experts over that mesh axis (expert parallelism).
+    n_experts: int = 0
+    expert_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask, deterministic: bool):
@@ -215,10 +220,16 @@ class Block(nn.Module):
         x = x + nn.Dropout(self.dropout)(out, deterministic=deterministic)
 
         h = nn.LayerNorm(epsilon=1e-5, name="ln_2")(x)
-        h = TPDense(4 * C, self.model_axis, mode="col",
-                    name="mlp_fc")(h)
-        h = nn.gelu(h, approximate=True)
-        h = TPDense(C, self.model_axis, mode="row", name="mlp_proj")(h)
+        if self.n_experts > 0:
+            from commefficient_tpu.parallel.moe import MoEMLP
+
+            h = MoEMLP(C, self.n_experts, expert_axis=self.expert_axis,
+                       name="moe")(h)
+        else:
+            h = TPDense(4 * C, self.model_axis, mode="col",
+                        name="mlp_fc")(h)
+            h = nn.gelu(h, approximate=True)
+            h = TPDense(C, self.model_axis, mode="row", name="mlp_proj")(h)
         return x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
 
 
@@ -245,6 +256,19 @@ class GPT2DoubleHeads(nn.Module):
     # worker — see federated/rounds.py tp_grad_scale). v1 restriction:
     # combine with attn_impl "dense" only.
     model_axis: Optional[str] = None
+    # Mixture-of-Experts + expert parallelism (GShard/Switch-style; no
+    # reference equivalent — parallel/moe.py): n_experts > 0 replaces the
+    # dense MLP of every ``moe_every``-th block (indices moe_every-1,
+    # 2·moe_every-1, …; the GShard "every other layer" pattern by default)
+    # with a top-1-routed MoE MLP. ``expert_axis`` shards the experts over
+    # that mesh axis; parameters stay full-shape/replicated, so the
+    # federated flat vector, compression, and checkpoints are unchanged.
+    # Expert-sliced grads are reconciled via psum + ep_scale in the worker
+    # (see parallel.moe.ep_sliced_param). v1 restriction: expert_axis
+    # requires attn_impl "dense" and no model_axis.
+    n_experts: int = 0
+    moe_every: int = 2
+    expert_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, mc_token_ids=None,
@@ -258,6 +282,11 @@ class GPT2DoubleHeads(nn.Module):
         sp = self.attn_impl != "dense"
         assert not (sp and self.model_axis is not None), \
             "tensor parallelism currently requires attn_impl='dense'"
+        if self.expert_axis is not None:
+            assert self.n_experts > 0, "expert_axis requires n_experts > 0"
+            assert not sp and self.model_axis is None, \
+                "expert parallelism currently requires attn_impl='dense' " \
+                "and no model_axis"
         orig_shape = input_ids.shape
         T = orig_shape[-1]
         flat_ids = input_ids.reshape(-1, T)
@@ -281,9 +310,13 @@ class GPT2DoubleHeads(nn.Module):
 
         mask = None if sp else jnp.tril(jnp.ones((T, T), bool))[None, None]
         for i in range(self.n_layer):
+            use_moe = (self.n_experts > 0
+                       and i % self.moe_every == self.moe_every - 1)
             x = Block(self.n_embd, self.n_head, self.dropout,
                       attn_impl=self.attn_impl, seq_axis=self.seq_axis,
                       model_axis=self.model_axis,
+                      n_experts=self.n_experts if use_moe else 0,
+                      expert_axis=self.expert_axis if use_moe else None,
                       name=f"h{i}")(x, mask, deterministic=not train)
         x = nn.LayerNorm(epsilon=1e-5, name="ln_f")(x)
 
@@ -388,6 +421,11 @@ def load_hf_gpt2(params_template, checkpoint_dir: str):
     put(("wte", "embedding"), state["transformer.wte.weight"])
     put(("wpe", "embedding"), state["transformer.wpe.weight"])
     n_layer = sum(1 for k in out if k.startswith("h"))
+    moe_blocks = [i for i in range(n_layer) if "moe" in out[f"h{i}"]]
+    if moe_blocks:
+        print(f"load_hf_gpt2: blocks {moe_blocks} are MoE — their expert "
+              f"MLPs have no HF equivalent and stay freshly initialized "
+              f"(attention/LN weights still load)")
     for i in range(n_layer):
         p = f"transformer.h.{i}."
         blk = out[f"h{i}"]
@@ -399,6 +437,10 @@ def load_hf_gpt2(params_template, checkpoint_dir: str):
         blk["attn_proj"]["bias"] = np.asarray(state[p + "attn.c_proj.bias"])
         blk["ln_2"]["scale"] = np.asarray(state[p + "ln_2.weight"])
         blk["ln_2"]["bias"] = np.asarray(state[p + "ln_2.bias"])
+        if "moe" in blk:
+            # MoE block (parallel/moe.py): no HF equivalent — experts stay
+            # freshly initialized; attention/LN above still load
+            continue
         blk["mlp_fc"]["kernel"] = np.asarray(state[p + "mlp.c_fc.weight"])
         blk["mlp_fc"]["bias"] = np.asarray(state[p + "mlp.c_fc.bias"])
         blk["mlp_proj"]["kernel"] = np.asarray(state[p + "mlp.c_proj.weight"])

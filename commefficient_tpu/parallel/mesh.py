@@ -43,18 +43,22 @@ __all__ = [
     "SEQ_AXIS",
     "MODEL_AXIS",
     "STAGE_AXIS",
+    "EXPERT_AXIS",
 ]
 
 CLIENTS_AXIS = "clients"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 STAGE_AXIS = "stage"
+EXPERT_AXIS = "expert"
 
 
 def default_client_mesh(num_workers: int, num_devices: int = -1,
                         devices=None, seq_devices: int = 1,
                         model_devices: int = 1,
-                        pipeline_devices: int = 1) -> Mesh:
+                        pipeline_devices: int = 1,
+                        expert_devices: int = 1,
+                        n_experts: int = 0) -> Mesh:
     """The entrypoints' mesh policy (replaces the reference's device counting,
     fed_aggregator.py:131-134): a 1-D ``clients`` mesh over
     ``min(--num_devices, available)`` devices, reduced to the largest divisor
@@ -65,8 +69,10 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
     parallelism, ``--seq_parallel``); ``model_devices > 1`` appends a
     ``model`` axis (tensor parallelism, ``--model_devices``);
     ``pipeline_devices > 1`` appends a ``stage`` axis (pipeline
-    parallelism, ``--pipeline_devices``). The ``clients`` axis shrinks to
-    fit ``available // (seq·model·stage)`` devices.
+    parallelism, ``--pipeline_devices``); ``expert_devices > 1`` appends
+    an ``expert`` axis (expert parallelism for MoE models,
+    ``--expert_devices``). The ``clients`` axis shrinks to fit
+    ``available // (seq·model·stage·expert)`` devices.
     ``model`` is the *minor-most* (fastest-varying) axis — its two
     activation psums per transformer block are the highest-rate collective
     traffic, so they ride neighboring ICI links; ``seq`` comes next for
@@ -87,21 +93,34 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
         warnings.warn(f"--pipeline_devices {pipeline_devices} reduced to "
                       f"{npp} (only {n_avail} devices available)",
                       stacklevel=2)
-    ns = max(1, min(seq_devices, n_avail // (nm * npp)))
+    ne = max(1, min(expert_devices, n_avail // (nm * npp)))
+    if n_experts > 0:
+        # keep the degrade graceful: the expert axis must divide the
+        # expert count (the shard slice is E/ne), so clamp to the largest
+        # divisor like the clients axis does for num_workers
+        while n_experts % ne:
+            ne -= 1
+    if expert_devices > ne:
+        warnings.warn(f"--expert_devices {expert_devices} reduced to "
+                      f"{ne} (only {n_avail} devices available"
+                      + (f"; must divide --n_experts {n_experts}"
+                         if n_experts > 0 else "") + ")",
+                      stacklevel=2)
+    ns = max(1, min(seq_devices, n_avail // (nm * npp * ne)))
     if seq_devices > ns:
         warnings.warn(f"--seq_devices {seq_devices} reduced to {ns} "
                       f"(only {n_avail} devices available)", stacklevel=2)
     requested = num_devices if num_devices and num_devices > 0 \
         else n_avail
-    n = max(1, min(requested, n_avail // (ns * nm * npp)))
+    n = max(1, min(requested, n_avail // (ns * nm * npp * ne)))
     while num_workers % n:
         n -= 1
-    if 0 < num_devices != n and num_devices != n * ns * nm * npp:
+    if 0 < num_devices != n and num_devices != n * ns * nm * npp * ne:
         warnings.warn(
             f"--num_devices {num_devices} reduced to {n} on the clients axis "
             f"(must divide num_workers={num_workers}; {ns} seq x {nm} model "
-            f"x {npp} stage device(s) per client shard; {n_avail} available "
-            f"devices)",
+            f"x {npp} stage x {ne} expert device(s) per client shard; "
+            f"{n_avail} available devices)",
             stacklevel=2)
     axes = [(CLIENTS_AXIS, n)]
     if ns > 1:
@@ -110,7 +129,9 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
         axes.append((MODEL_AXIS, nm))
     if npp > 1:
         axes.append((STAGE_AXIS, npp))
-    return make_mesh(axes, devices=devices[:n * ns * nm * npp])
+    if ne > 1:
+        axes.append((EXPERT_AXIS, ne))
+    return make_mesh(axes, devices=devices[:n * ns * nm * npp * ne])
 
 
 def make_mesh(axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,

@@ -1,0 +1,123 @@
+"""Mixture-of-Experts MLP with expert parallelism over an ``expert`` mesh axis.
+
+Extension beyond the reference (SURVEY.md §2.3: the reference's only
+parallelism is data-parallel client simulation; MoE/expert parallelism is
+explicitly absent there). This gives the GPT-2 workload a GShard/Switch-style
+sparsely-activated MLP whose experts shard across TPU cores:
+
+- **Routing**: top-1 (Switch) — a linear router scores every token against
+  every expert; each token is combined with its argmax expert's output,
+  weighted by that expert's softmax probability (so the router receives
+  gradient through the selected probability).
+- **Dispatch**: dense ("einsum dispatch") — every expert evaluates all
+  tokens and the combine weights zero the non-routed ones. No token
+  dropping, no capacity factor, and the per-expert work is one big batched
+  einsum the MXU tiles well. With expert parallelism each shard only
+  evaluates its ``E/ne`` local experts, so per-shard FLOPs scale down
+  1/ne exactly like sparse dispatch would.
+- **Expert parallelism** (``expert_axis``): parameters stay FULL-SHAPE and
+  replicated — identical tree/layout whether or not the mesh has an
+  ``expert`` axis — so the federated flat vector, compression, and
+  checkpoints never see expert parallelism (same contract as
+  ``models.gpt2.TPDense``). Each shard dynamic-slices its expert block,
+  computes the partial combine over its local experts, and one
+  ``psum`` reassembles the full MoE output. Gradients: expert-sliced
+  params get slice-local grads (zero outside the shard's slice — the psum
+  in the worker reassembles them, scale 1); the router and all non-MoE
+  params are computed identically on every shard (scale 1/ne). See
+  ``ep_sliced_param`` and ``federated/rounds.py`` ``ep_scale``.
+
+Documented deviations from production MoE stacks: no auxiliary
+load-balancing loss (dense dispatch makes load imbalance a routing-quality
+concern, not a compute-skew one) and no capacity-factor token dropping.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["MoEMLP", "ep_sliced_param"]
+
+
+def ep_sliced_param(path: str) -> bool:
+    """True for parameters whose per-shard gradients SUM to the full
+    gradient across expert shards (psum with scale 1): the expert-stacked
+    MLP weights/biases (leading expert dim sliced, disjoint) AND the
+    router — each shard's router grad is the backprop of only its local
+    experts' combine weights (disjoint cotangent slices in prob space, so
+    the per-shard contributions are partial and sum exactly; the softmax
+    Jacobian makes them dense but not replicated). ``path`` is the
+    '/'-joined lowercase flat-param path."""
+    return "/moe/" in path or path.startswith("moe/")
+
+
+class MoEMLP(nn.Module):
+    """Top-1-routed mixture-of-experts MLP (drop-in for a transformer
+    block's dense MLP; see module docstring for routing/dispatch/sharding
+    semantics)."""
+
+    n_embd: int
+    n_experts: int
+    expert_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        # x: (B, T, C)
+        C, E = self.n_embd, self.n_experts
+        router = self.param("router", nn.initializers.normal(0.02), (C, E))
+        w_fc = self.param("w_fc", nn.initializers.normal(0.02),
+                          (E, C, 4 * C))
+        b_fc = self.param("b_fc", nn.initializers.zeros, (E, 4 * C))
+        w_proj = self.param("w_proj", nn.initializers.normal(0.02),
+                            (E, 4 * C, C))
+        b_proj = self.param("b_proj", nn.initializers.zeros, (E, C))
+
+        if self.expert_axis is not None:
+            # Megatron f operator BEFORE the router so that the input
+            # cotangent from BOTH consumers of x (router path and expert
+            # path) rides the backward psum — everything upstream then
+            # sees the same replicated gradient as the unsharded module
+            from commefficient_tpu.models.gpt2 import _ident_psumct
+
+            x = _ident_psumct(x, self.expert_axis)
+
+        # routing in f32 for a stable softmax regardless of compute dtype
+        logits = x.astype(jnp.float32) @ router.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)            # (B, T, E)
+        top = jnp.argmax(probs, axis=-1)                   # (B, T)
+        # top-1 combine weights: the selected expert's probability (router
+        # grad flows through the selected prob; the argmax one-hot is a
+        # constant, the Switch-transformer estimator)
+        combine = (jax.nn.one_hot(top, E, dtype=probs.dtype)
+                   * probs).astype(x.dtype)                # (B, T, E)
+
+        if self.expert_axis is None:
+            e0, e_loc = 0, E
+        else:
+            ne = jax.lax.psum(1, self.expert_axis)
+            assert E % ne == 0, \
+                f"n_experts {E} must divide by the expert axis size {ne}"
+            e_loc = E // ne
+            e0 = jax.lax.axis_index(self.expert_axis) * e_loc
+
+        def sl(p, axis=0):
+            return jax.lax.dynamic_slice_in_dim(p, e0, e_loc, axis=axis)
+
+        # dense dispatch over the shard's local experts: (E_loc, B, T, ·)
+        h = jnp.einsum("btc,ecf->ebtf", x, sl(w_fc)) \
+            + sl(b_fc)[:, None, None, :]
+        h = nn.gelu(h, approximate=True)
+        y = jnp.einsum("ebtf,efc->ebtc", h, sl(w_proj)) \
+            + sl(b_proj)[:, None, None, :]
+        out = jnp.einsum("bte,ebtc->btc", sl(combine, axis=2), y)
+        if self.expert_axis is not None:
+            # g operator: psum fwd (partial combines -> full MoE output),
+            # identity bwd (the output cotangent is replicated)
+            from commefficient_tpu.models.gpt2 import _psum_repct
+
+            out = _psum_repct(out, self.expert_axis)
+        return out

@@ -279,6 +279,9 @@ def main(argv=None):
     assert args.pipeline_devices == 1, (
         "--pipeline_devices (pipeline parallelism) is GPT-2 only; the CV "
         "models have no stage axis — use gpt2_train.py")
+    assert args.n_experts == 0, (
+        "--n_experts (MoE / expert parallelism) is GPT-2 only; the CV "
+        "models have no expert axis — use gpt2_train.py")
     if args.lr_scale is None:
         args.lr_scale = 0.4  # cifar10-fast default peak LR
     print(args)

@@ -210,17 +210,31 @@ def train(argv=None):
         args.num_workers, args.num_devices,
         seq_devices=(args.seq_devices if args.seq_parallel != "none" else 1),
         model_devices=args.model_devices,
-        pipeline_devices=args.pipeline_devices)
+        pipeline_devices=args.pipeline_devices,
+        expert_devices=(args.expert_devices if args.n_experts else 1),
+        n_experts=args.n_experts)
     sp = args.seq_parallel != "none" and "seq" in mesh.axis_names
     tp = "model" in mesh.axis_names
     pp = "stage" in mesh.axis_names
+    ep = "expert" in mesh.axis_names
     if args.seq_parallel != "none" and not sp:
         print(f"--seq_parallel {args.seq_parallel} disabled: "
               f"mesh has no seq axis ({dict(mesh.shape)})")
         args.seq_parallel = "none"
+    if args.expert_devices > 1 and not ep:
+        print(f"--expert_devices {args.expert_devices} disabled: "
+              f"mesh has no expert axis ({dict(mesh.shape)})")
+        args.expert_devices = 1
     geometry = dict(attn_impl=args.seq_parallel) if sp else {}
     if tp:
         geometry["model_axis"] = "model"
+    if args.n_experts:
+        # MoE GPT-2 (--n_experts N): every other block gets a Switch-style
+        # MoE MLP; with --expert_devices the experts shard over the
+        # `expert` mesh axis (parallel/moe.py)
+        geometry["n_experts"] = args.n_experts
+        if ep:
+            geometry["expert_axis"] = "expert"
 
     # model geometry: tiny when smoke-testing or using the byte fallback
     if args.do_test or os.environ.get("COMMEFFICIENT_TINY_MODEL"):
@@ -240,6 +254,10 @@ def train(argv=None):
             f"--model_devices (realized {nm}) must divide n_head"
         assert (4 * model.n_embd) % nm == 0, \
             f"--model_devices (realized {nm}) must divide the MLP hidden dim"
+    if ep:
+        ne = mesh.shape["expert"]  # realized size, possibly reduced
+        assert args.n_experts % ne == 0, \
+            f"--expert_devices (realized {ne}) must divide --n_experts"
     if pp:
         # pipeline parallelism (--pipeline_devices): the loss callbacks
         # carry the GPipe schedule (parallel/pipeline.py); the model object
@@ -280,6 +298,8 @@ def train(argv=None):
         init_model = init_model.copy(attn_impl="dense")
     if tp:
         init_model = init_model.copy(model_axis=None)
+    if ep:
+        init_model = init_model.copy(expert_axis=None)
     variables = init_model.init(jax.random.key(args.seed), x0["input_ids"],
                                 token_type_ids=x0["input_ids"],
                                 mc_token_ids=jnp.zeros((1, args.num_candidates),

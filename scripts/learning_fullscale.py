@@ -21,6 +21,10 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from __graft_entry__ import apply_tpu_cache_env  # noqa: E402
+
+apply_tpu_cache_env(os.environ)
+
 # 512 images/class -> 5,120 train images, 10 rounds/epoch at the FetchSGD
 # batch of 512 (8 workers x 64). Test split stays at the fallback default.
 os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "512")

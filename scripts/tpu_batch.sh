@@ -45,25 +45,44 @@ if ! probe 120; then
 fi
 log "tunnel ALIVE — running the batch"
 
-log "step 1/4: full bench.py, TPU-required (timeout 75m)"
-BENCH_REQUIRE_TPU=1 timeout 4500 python bench.py \
-  >"$OUT/bench.json" 2>"$OUT/bench.log"
-log "step 1 rc=$? ($(tail -c 300 "$OUT/bench.json" 2>/dev/null))"
-probe_or_abort "bench"
-
-log "step 2/4: learning_fullscale.py (timeout 90m)"
-timeout 5400 python scripts/learning_fullscale.py >"$OUT/learning.log" 2>&1
-log "step 2 rc=$? (docs/learning_fullscale.json written on success)"
-probe_or_abort "learning"
-
-log "step 3/4: tpu_measure.py gpt2 legs (timeout 40m)"
-timeout 2400 python scripts/tpu_measure.py gpt2 >"$OUT/tpu_measure_gpt2.log" 2>&1
-log "step 3 rc=$? (see $OUT/tpu_measure_gpt2.log)"
-probe_or_abort "gpt2 measure"
-
-log "step 4/4: tpu_measure.py matmul cifar ops (timeout 40m)"
-timeout 2400 python scripts/tpu_measure.py matmul cifar ops \
-  >"$OUT/tpu_measure.log" 2>&1
-log "step 4 rc=$? (see $OUT/tpu_measure.log)"
+# Steps may be selected (and ordered) via argv, e.g.
+#   bash scripts/tpu_batch.sh learning gpt2 ops
+# after a window that already captured bench; default runs everything.
+STEPS=${*:-"bench learning gpt2 ops"}
+i=0
+for step in $STEPS; do
+  i=$((i + 1))
+  case "$step" in
+    bench)
+      log "step $i: full bench.py, TPU-required (timeout 75m)"
+      BENCH_REQUIRE_TPU=1 timeout 4500 python bench.py \
+        >"$OUT/bench.json" 2>"$OUT/bench.log"
+      log "step $i rc=$? ($(tail -c 300 "$OUT/bench.json" 2>/dev/null))"
+      ;;
+    learning)
+      log "step $i: learning_fullscale.py (timeout 90m)"
+      timeout 5400 python scripts/learning_fullscale.py \
+        >"$OUT/learning.log" 2>&1
+      log "step $i rc=$? (docs/learning_fullscale.json written on success)"
+      ;;
+    gpt2)
+      log "step $i: tpu_measure.py gpt2 legs (timeout 40m)"
+      timeout 2400 python scripts/tpu_measure.py gpt2 \
+        >"$OUT/tpu_measure_gpt2.log" 2>&1
+      log "step $i rc=$? (see $OUT/tpu_measure_gpt2.log)"
+      ;;
+    ops)
+      log "step $i: tpu_measure.py matmul cifar ops (timeout 40m)"
+      timeout 2400 python scripts/tpu_measure.py matmul cifar ops \
+        >"$OUT/tpu_measure.log" 2>&1
+      log "step $i rc=$? (see $OUT/tpu_measure.log)"
+      ;;
+    *)
+      log "unknown step '$step' — skipping"
+      continue
+      ;;
+  esac
+  probe_or_abort "$step"
+done
 
 log "batch done"

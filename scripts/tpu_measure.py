@@ -24,6 +24,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from __graft_entry__ import apply_tpu_cache_env  # noqa: E402
+
+apply_tpu_cache_env(os.environ)
+
 import numpy as np
 import jax
 import jax.numpy as jnp

@@ -24,7 +24,7 @@ x = jnp.ones((512, 512), jnp.bfloat16)
 print('alive:', float((x @ x).ravel()[0]))
 " 2>/dev/null; then
     echo "[tpu_watch $(date +%H:%M:%S)] tunnel ALIVE -> running batch"
-    bash scripts/tpu_batch.sh
+    bash scripts/tpu_batch.sh "$@"
     echo "[tpu_watch $(date +%H:%M:%S)] batch done; exiting"
     exit 0
   fi
