@@ -66,13 +66,26 @@ class TestTopk:
         out = topk(v, 4)
         np.testing.assert_allclose(out, [0.0, 2.0, 0.0, -1.0, 0.0])
 
+    def test_k_exceeds_d(self):
+        """k > d keeps every coordinate on both methods (the threshold
+        search resolves p=0, the sort path clamps k)."""
+        v = jnp.asarray(np.random.RandomState(1).randn(7).astype(np.float32))
+        np.testing.assert_allclose(topk(v, 12), v)
+        np.testing.assert_allclose(topk(v, 12, method="sort"), v)
+
     def test_randomized_vs_sort_across_scales(self):
         """Threshold search equals lax.top_k selection over 60 orders of
-        magnitude (allowed difference: tie inclusion at the k-th value)."""
+        magnitude (allowed difference: tie inclusion at the k-th value).
+        The property under test is about VALUE scales, so the shapes cycle
+        through a fixed set (each fresh (d, k) pair costs two jit compiles
+        — 40 compiles dominated this test's runtime) while every trial
+        draws a fresh magnitude distribution; the set keeps the tiny-d,
+        k=1, k>d, and large-d regimes (k>d additionally pinned by
+        test_k_exceeds_d below)."""
         rng = np.random.RandomState(0)
-        for _ in range(20):
-            d = int(rng.randint(10, 20000))
-            k = int(rng.randint(1, d + 5))
+        shapes = [(10, 3), (257, 260), (1024, 1), (8192, 500), (19997, 4096)]
+        for t in range(20):
+            d, k = shapes[t % len(shapes)]
             scale = 10.0 ** rng.randint(-30, 30)
             v = (rng.randn(d) * scale
                  * (rng.rand(d) ** rng.randint(0, 6))).astype(np.float32)
