@@ -176,9 +176,17 @@ def make_mesh(axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,
             raise ValueError(
                 f"multihost mesh: leading axis {names[0]}={sizes[0]} must be "
                 f"divisible by process_count={n_proc}")
+        # process_is_granule: this mesh's contract is "the leading axis
+        # spans HOSTS over DCN" (the divisibility check above is per
+        # process), so each OS process is one DCN granule. The helper's
+        # default granule — the TPU slice_index — is only equivalent when
+        # slices == processes, and fails outright where they differ (CPU
+        # fleets have no slice_index; a one-slice multi-host pod has
+        # fewer slices than processes).
         dev_array = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=(sizes[0] // n_proc, *sizes[1:]),
             dcn_mesh_shape=(n_proc,) + (1,) * (len(sizes) - 1),
+            process_is_granule=True,
         )
         return Mesh(dev_array, tuple(names))
     dev_array = np.asarray(devices).reshape(sizes)

@@ -1,0 +1,173 @@
+"""Real multi-process (DCN-path) validation of the distributed backend.
+
+The reference's distributed substrate is single-host by construction
+(MASTER_ADDR hard-coded to 127.0.0.1, reference fed_aggregator.py:161-162);
+this framework's replacement — a ``jax.sharding.Mesh`` whose leading axis
+spans hosts over DCN (``parallel/mesh.py`` multihost branch) — was until now
+validated only by a monkeypatched unit test of the mesh construction
+(tests/test_parallel.py). This script runs the REAL thing on one machine:
+
+  - two OS processes, each a JAX "host" with 4 virtual CPU devices,
+    joined through ``jax.distributed.initialize`` (TCP coordinator —
+    the same wire path a TPU pod's hosts use over DCN);
+  - ``make_mesh`` takes its multihost branch (``process_count() == 2``)
+    and builds the hybrid 8-device ``clients`` mesh via
+    ``create_hybrid_device_mesh`` (process-granule fallback on CPU);
+  - one fused sketched federated round (the tiny dry-run geometry —
+    literally the same code, __graft_entry__.run_tiny_sketched_round)
+    executes with the transmit-psum crossing the process boundary;
+  - each process prints a checksum of the (replicated) new PS weights;
+    the parent also computes the single-process 8-device reference and
+    asserts the cross-process round matches it numerically.
+
+Usage:  python scripts/multihost_demo.py           (parent; spawns children)
+        python scripts/multihost_demo.py --child I PORT   (internal)
+
+Exercised by tests/test_multihost.py.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+N_PROC = 2
+DEV_PER_PROC = 4
+W = N_PROC * DEV_PER_PROC  # one client slot per device
+CHILD_TIMEOUT = 420        # < the outer test timeout, so children die first
+
+
+def _global_put(x, sharding):
+    """Host-uniform numpy -> global jax.Array under ``sharding`` (every
+    process holds the full value; the callback hands each addressable
+    device its shard)."""
+    import numpy as np
+
+    import jax
+
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding,
+                                        lambda idx: x[idx])
+
+
+def child(proc_id: int, port: int) -> None:
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=N_PROC,
+        process_id=proc_id,
+    )
+    assert jax.process_count() == N_PROC
+    assert len(jax.devices()) == W, \
+        f"expected {W} global devices, got {len(jax.devices())}"
+    assert len(jax.local_devices()) == DEV_PER_PROC
+
+    from __graft_entry__ import run_tiny_sketched_round
+    from commefficient_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh([("clients", W)])
+    new_ps, _ = run_tiny_sketched_round(mesh, W=W, put=_global_put)
+    print(f"CHILD {proc_id} RESULT "
+          f"sum={float(new_ps.sum()):.10e} "
+          f"absmax={float(abs(new_ps).max()):.10e} d={new_ps.size}",
+          flush=True)
+
+
+def _sanitized_env(n_devices: int) -> dict:
+    """CPU-only env with the axon TPU plugin disabled. The empty-string
+    POOL_IPS convention (scripts/test.sh) and the device-count flag must be
+    in place BEFORE the python interpreter starts — the plugin is imported
+    at interpreter startup, so in-process ``os.environ`` edits are too late
+    (measured: a parent that sanitized itself still registered the plugin
+    and wedged on the dead tunnel)."""
+    from __graft_entry__ import sanitized_cpu_env
+
+    env = sanitized_cpu_env(n_devices)
+    # empty string, not absent: an absent var can send the plugin into
+    # endpoint discovery that blocks the import for minutes
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    return env
+
+
+def parent() -> None:
+    import socket
+
+    if os.environ.get("PALLAS_AXON_POOL_IPS", None) != "" or \
+            f"device_count={W}" not in os.environ.get("XLA_FLAGS", ""):
+        # re-exec with the sanitized env (see _sanitized_env docstring)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=_sanitized_env(W), cwd=_REPO)
+        sys.exit(proc.returncode)
+
+    import numpy as np
+
+    with socket.socket() as s:  # free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = _sanitized_env(DEV_PER_PROC)
+
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", str(i),
+         str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(N_PROC)]
+    outs = []
+    # one SHARED deadline across both children (not per-child): the outer
+    # test timeout must always fire after this one, so a hang is cleaned
+    # up here with the children's output still captured
+    import time
+
+    deadline = time.monotonic() + CHILD_TIMEOUT
+    try:
+        for i, p in enumerate(procs):
+            remaining = max(1.0, deadline - time.monotonic())
+            out, _ = p.communicate(timeout=remaining)
+            outs.append(out)
+            print(f"--- child {i} ---\n{out}")
+            assert p.returncode == 0, f"child {i} failed rc={p.returncode}"
+    finally:
+        # a child that crashed or hung must not orphan its sibling (it
+        # would sit in jax.distributed.initialize burning CPU forever)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    results = {}
+    for i, out in enumerate(outs):
+        for line in out.splitlines():
+            if line.startswith(f"CHILD {i} RESULT"):
+                parts = dict(kv.split("=") for kv in line.split()[3:])
+                results[i] = (float(parts["sum"]), float(parts["absmax"]),
+                              int(parts["d"]))
+    assert set(results) == set(range(N_PROC)), \
+        f"missing child results: {results.keys()}"
+    assert results[0] == results[1], \
+        f"processes disagree on the replicated result: {results}"
+
+    # single-process 8-device reference in THIS process
+    from __graft_entry__ import run_tiny_sketched_round
+    from commefficient_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh([("clients", W)])
+    ref, _ = run_tiny_sketched_round(mesh, W=W, put=_global_put)
+    ref_sum, ref_absmax = float(ref.sum()), float(np.abs(ref).max())
+    got_sum, got_absmax, got_d = results[0]
+    assert got_d == ref.size
+    np.testing.assert_allclose(got_sum, ref_sum, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(got_absmax, ref_absmax, rtol=1e-4, atol=1e-7)
+    print(f"MULTIHOST OK: 2-process hybrid mesh round == single-process "
+          f"round (sum {got_sum:.6e} vs {ref_sum:.6e})")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--child":
+        child(int(sys.argv[2]), int(sys.argv[3]))
+    else:
+        parent()

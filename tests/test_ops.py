@@ -81,7 +81,7 @@ class TestTopk:
         — 40 compiles dominated this test's runtime) while every trial
         draws a fresh magnitude distribution; the set keeps the tiny-d,
         k=1, k>d, and large-d regimes (k>d additionally pinned by
-        test_k_exceeds_d below)."""
+        test_k_exceeds_d above)."""
         rng = np.random.RandomState(0)
         shapes = [(10, 3), (257, 260), (1024, 1), (8192, 500), (19997, 4096)]
         for t in range(20):

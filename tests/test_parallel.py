@@ -160,9 +160,10 @@ class TestMultihostMesh:
 
         calls = {}
 
-        def fake_hybrid(mesh_shape, dcn_mesh_shape):
+        def fake_hybrid(mesh_shape, dcn_mesh_shape, process_is_granule):
             calls["mesh_shape"] = tuple(mesh_shape)
             calls["dcn"] = tuple(dcn_mesh_shape)
+            calls["process_is_granule"] = process_is_granule
             n = int(np.prod(mesh_shape)) * int(np.prod(dcn_mesh_shape))
             return np.array(jax.devices()[:n]).reshape(
                 tuple(np.array(mesh_shape) * np.array(dcn_mesh_shape)))
@@ -173,6 +174,10 @@ class TestMultihostMesh:
         m = mesh_mod.make_mesh([("clients", 8)])
         assert calls["mesh_shape"] == (4,)   # 8 clients / 2 hosts
         assert calls["dcn"] == (2,)
+        # each OS process is one DCN granule — the real 2-process execution
+        # (test_multihost.py) depends on it, and slice-granule fails where
+        # slices != processes
+        assert calls["process_is_granule"] is True
         assert m.shape["clients"] == 8
 
     def test_hybrid_mesh_divisibility_error(self, monkeypatch):
