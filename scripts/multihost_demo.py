@@ -128,7 +128,16 @@ def parent() -> None:
     try:
         for i, p in enumerate(procs):
             remaining = max(1.0, deadline - time.monotonic())
-            out, _ = p.communicate(timeout=remaining)
+            try:
+                out, _ = p.communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                # kill and drain, so the hung child's partial output still
+                # reaches the log (TimeoutExpired itself carries none)
+                p.kill()
+                out, _ = p.communicate()
+                print(f"--- child {i} (TIMED OUT after {remaining:.0f}s) "
+                      f"---\n{out}")
+                raise
             outs.append(out)
             print(f"--- child {i} ---\n{out}")
             assert p.returncode == 0, f"child {i} failed rc={p.returncode}"

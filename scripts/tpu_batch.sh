@@ -48,34 +48,69 @@ log "tunnel ALIVE — running the batch"
 # Steps may be selected (and ordered) via argv, e.g.
 #   bash scripts/tpu_batch.sh learning gpt2 ops
 # after a window that already captured bench; default runs everything.
+# Completed steps are recorded in runs/.tpu_steps_done and skipped on the
+# next invocation, so successive tunnel-revival windows ACCUMULATE results
+# instead of restarting from scratch (three straight windows have died
+# mid-batch). Delete the state file to force a full re-run.
+STATE="runs/.tpu_steps_done"
+touch "$STATE"
+is_done() { grep -qx "$1" "$STATE" 2>/dev/null; }
+mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
+
 STEPS=${*:-"bench learning gpt2 ops"}
 i=0
 for step in $STEPS; do
   i=$((i + 1))
+  if is_done "$step"; then
+    log "step $i: '$step' already done — skipping"
+    continue
+  fi
   case "$step" in
     bench)
       log "step $i: full bench.py, TPU-required (timeout 75m)"
       BENCH_REQUIRE_TPU=1 timeout 4500 python bench.py \
         >"$OUT/bench.json" 2>"$OUT/bench.log"
       log "step $i rc=$? ($(tail -c 300 "$OUT/bench.json" 2>/dev/null))"
+      # done = the headline artifact is on-chip. bench.py isolates the
+      # gpt2/config-4 legs in their own children precisely so they cannot
+      # cost the headline; tying completion to them would re-burn the
+      # whole bench every window while e.g. the gpt2 leg keeps timing
+      # out (GPT-2 tokens/sec also comes from the separate 'gpt2' step,
+      # and the driver re-runs bench.py at round end with a warm cache)
+      if grep -q '"platform": "tpu"' "$OUT/bench.json" 2>/dev/null; then
+        mark_done bench
+        grep -q '_error' "$OUT/bench.json" \
+          && log "note: bench extras carried leg errors (see bench.json)"
+      fi
       ;;
     learning)
       log "step $i: learning_fullscale.py (timeout 90m)"
       timeout 5400 python scripts/learning_fullscale.py \
         >"$OUT/learning.log" 2>&1
-      log "step $i rc=$? (docs/learning_fullscale.json written on success)"
+      rc=$?
+      log "step $i rc=$rc (docs/learning_fullscale.json written on success)"
+      # the script writes the json after EACH mode; require the second
+      # (sketch) trajectory before calling the step done
+      if [ $rc -eq 0 ] && grep -q '"sketch"' docs/learning_fullscale.json \
+          2>/dev/null; then
+        mark_done learning
+      fi
       ;;
     gpt2)
       log "step $i: tpu_measure.py gpt2 legs (timeout 40m)"
       timeout 2400 python scripts/tpu_measure.py gpt2 \
         >"$OUT/tpu_measure_gpt2.log" 2>&1
-      log "step $i rc=$? (see $OUT/tpu_measure_gpt2.log)"
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_gpt2.log)"
+      [ $rc -eq 0 ] && mark_done gpt2
       ;;
     ops)
       log "step $i: tpu_measure.py matmul cifar ops (timeout 40m)"
       timeout 2400 python scripts/tpu_measure.py matmul cifar ops \
         >"$OUT/tpu_measure.log" 2>&1
-      log "step $i rc=$? (see $OUT/tpu_measure.log)"
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure.log)"
+      [ $rc -eq 0 ] && mark_done ops
       ;;
     *)
       log "unknown step '$step' — skipping"

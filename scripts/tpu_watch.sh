@@ -15,7 +15,25 @@ if ! mkdir "$LOCK" 2>/dev/null; then
 fi
 trap 'rmdir "$LOCK"' EXIT
 
+# one explicit step list, resolved ONCE here and passed verbatim to every
+# tpu_batch.sh invocation, so the two scripts cannot disagree on defaults
+STEPS=${*:-"bench learning gpt2 ops"}
+MAX_BATCHES=${TPU_WATCH_MAX_BATCHES:-6}
+batches=0
+
+all_steps_done() {
+  local s
+  for s in $STEPS; do
+    grep -qx "$s" runs/.tpu_steps_done 2>/dev/null || return 1
+  done
+  return 0
+}
+
 while true; do
+  if all_steps_done; then
+    echo "[tpu_watch $(date +%H:%M:%S)] all steps recorded done; exiting"
+    exit 0
+  fi
   if timeout 120 python -c "
 import jax, jax.numpy as jnp
 assert jax.default_backend() in ('tpu', 'axon'), \
@@ -24,9 +42,17 @@ x = jnp.ones((512, 512), jnp.bfloat16)
 print('alive:', float((x @ x).ravel()[0]))
 " 2>/dev/null; then
     echo "[tpu_watch $(date +%H:%M:%S)] tunnel ALIVE -> running batch"
-    bash scripts/tpu_batch.sh "$@"
-    echo "[tpu_watch $(date +%H:%M:%S)] batch done; exiting"
-    exit 0
+    # shellcheck disable=SC2086  # word-splitting STEPS is intended
+    bash scripts/tpu_batch.sh $STEPS
+    batches=$((batches + 1))
+    if [ "$batches" -ge "$MAX_BATCHES" ]; then
+      echo "[tpu_watch $(date +%H:%M:%S)] $batches batches without" \
+           "completing all steps ($(cat runs/.tpu_steps_done 2>/dev/null |
+           tr '\n' ' ')done) — giving up so a persistently failing step" \
+           "cannot burn the chip forever"
+      exit 1
+    fi
+    continue  # re-check done-set immediately, no pointless poll sleep
   fi
   echo "[tpu_watch $(date +%H:%M:%S)] tunnel still wedged; retry in ${POLL}s"
   sleep "$POLL"
