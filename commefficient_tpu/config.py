@@ -157,6 +157,10 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--expert_devices", type=int, default=1,
                         help="Size of the `expert` (expert-parallel) mesh "
                              "axis for GPT-2 MoE (1 disables).")
+    parser.add_argument("--moe_aux_coef", type=float, default=0.01,
+                        help="Switch load-balancing auxiliary loss "
+                             "coefficient for MoE GPT-2 (0 disables; only "
+                             "meaningful with --n_experts > 0).")
     # TPU-first extension: dropout/DP mask PRNG. threefry (JAX default) is
     # counter-based ALU work; rbg uses the TPU hardware RNG and is much
     # cheaper at GPT-2 mask volumes. unsafe_rbg additionally relaxes

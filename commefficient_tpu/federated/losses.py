@@ -87,7 +87,8 @@ def _mc_ce_acc(mc_logits, mc_labels):
 
 def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
                      seq_axis: str | None = None,
-                     compute_dtype: Optional[Any] = None):
+                     compute_dtype: Optional[Any] = None,
+                     moe_aux_coef: float = 0.0):
     """GPT-2 double-heads losses (reference gpt2_train.py:55-99).
 
     Train: ``lm_coef·lm_loss + mc_coef·mc_loss`` per example; no extra
@@ -105,6 +106,11 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
     shift crosses shard boundaries, so it happens host-side in the
     collate), and per-example token sums/counts are psum'ed over the axis
     so the loss value is replicated across seq shards.
+
+    ``moe_aux_coef``: adds ``coef · Σ_layers aux`` per example to the
+    training loss, where each MoE layer's Switch load-balancing aux
+    (parallel/moe.py) is collected from the model's sown ``moe_losses``.
+    Training-only; the val metrics stay pure NLL/accuracy.
     """
 
     def _lm_nll_per_example(lm_logits, batch):
@@ -140,11 +146,21 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
             rng = jax.random.fold_in(rng, jax.lax.axis_index(seq_axis))
         if compute_dtype is not None:
             params = _cast_tree(params, compute_dtype)
-        lm_logits, mc_logits = model.apply(
-            {"params": params}, batch["input_ids"],
+        apply_kwargs = dict(
             token_type_ids=batch["token_type_ids"],
             mc_token_ids=batch["mc_token_ids"], train=train,
             rngs={"dropout": rng} if train else None)
+        aux_total = 0.0
+        if moe_aux_coef:
+            (lm_logits, mc_logits), sown = model.apply(
+                {"params": params}, batch["input_ids"],
+                mutable=["moe_losses"], **apply_kwargs)
+            aux_total = sum(
+                jnp.sum(jnp.asarray(leaf)) for leaf in
+                jax.tree_util.tree_leaves(sown.get("moe_losses", {})))
+        else:
+            lm_logits, mc_logits = model.apply(
+                {"params": params}, batch["input_ids"], **apply_kwargs)
         # lm_logits stay in compute dtype; the nll reductions accumulate
         # in f32 internally (see _lm_nll_per_example)
         mc_logits = mc_logits.astype(jnp.float32)
@@ -152,6 +168,9 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
         mc_ce, _ = _mc_ce_acc(mc_logits, batch["mc_labels"])
         mask = batch["mask"]
         loss_sum = jnp.sum((lm_coef * lm_nll + mc_coef * mc_ce) * mask)
+        if moe_aux_coef:
+            # batch-level aux weighted like a per-example term
+            loss_sum = loss_sum + moe_aux_coef * aux_total * jnp.sum(mask)
         return loss_sum, (), jnp.sum(mask), model_state
 
     def compute_val(params, model_state, batch, rng, train):

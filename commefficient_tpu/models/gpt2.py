@@ -224,6 +224,8 @@ class Block(nn.Module):
             from commefficient_tpu.parallel.moe import MoEMLP
 
             h = MoEMLP(C, self.n_experts, expert_axis=self.expert_axis,
+                       seq_axis=(self.seq_axis
+                                 if self.attn_impl != "dense" else None),
                        name="moe")(h)
         else:
             h = TPDense(4 * C, self.model_axis, mode="col",

@@ -27,9 +27,12 @@ sparsely-activated MLP whose experts shard across TPU cores:
   params are computed identically on every shard (scale 1/ne). See
   ``ep_sliced_param`` and ``federated/rounds.py`` ``ep_scale``.
 
-Documented deviations from production MoE stacks: no auxiliary
-load-balancing loss (dense dispatch makes load imbalance a routing-quality
-concern, not a compute-skew one) and no capacity-factor token dropping.
+The Switch auxiliary load-balancing loss (E·Σ f·P) is sown into the
+``moe_losses`` collection per MoE layer and added to the training loss by
+``losses.make_gpt2_losses`` when ``--moe_aux_coef`` > 0 (dense dispatch
+makes imbalance a routing-quality concern rather than a compute-skew one,
+so the aux is optional). Documented deviation from production MoE stacks:
+no capacity-factor token dropping.
 """
 
 from __future__ import annotations
@@ -63,6 +66,15 @@ class MoEMLP(nn.Module):
     n_embd: int
     n_experts: int
     expert_axis: Optional[str] = None
+    # Bound sequence-parallel mesh axis, when the block runs inside a
+    # seq shard_map (Block passes it for ring/ulysses attention). Routing
+    # and dispatch are per-token and need no communication, but the
+    # load-balancing aux must use GLOBAL routing statistics: f/P are
+    # pmean'ed over this axis so the sown aux is replicated across seq
+    # shards (the loss contract of losses.make_gpt2_losses) and its psum'ed
+    # gradient is exact. Mutually exclusive with expert_axis (config.py
+    # forbids --expert_devices > 1 with --seq_parallel).
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
@@ -89,11 +101,11 @@ class MoEMLP(nn.Module):
         logits = x.astype(jnp.float32) @ router.astype(jnp.float32)
         probs = jax.nn.softmax(logits, axis=-1)            # (B, T, E)
         top = jnp.argmax(probs, axis=-1)                   # (B, T)
+        oh = jax.nn.one_hot(top, E, dtype=probs.dtype)     # (B, T, E)
         # top-1 combine weights: the selected expert's probability (router
         # grad flows through the selected prob; the argmax one-hot is a
         # constant, the Switch-transformer estimator)
-        combine = (jax.nn.one_hot(top, E, dtype=probs.dtype)
-                   * probs).astype(x.dtype)                # (B, T, E)
+        combine = (oh * probs).astype(x.dtype)             # (B, T, E)
 
         if self.expert_axis is None:
             e0, e_loc = 0, E
@@ -106,6 +118,34 @@ class MoEMLP(nn.Module):
 
         def sl(p, axis=0):
             return jax.lax.dynamic_slice_in_dim(p, e0, e_loc, axis=axis)
+
+        # Switch load-balancing auxiliary loss, aux = E·Σ_e f_e·P_e
+        # (f_e: fraction of tokens argmax-routed to expert e; P_e: mean
+        # router probability of e; minimum 1.0 at perfect balance).
+        # Computed from the LOCAL expert slice and psum'ed so that under
+        # expert parallelism its router gradients are disjoint partial
+        # contributions — exactly the scale-1 contract of ep_sliced_param
+        # (a replicated aux would overcount the aux grads by ne).
+        # Sown into the "moe_losses" collection: free unless the caller
+        # applies with mutable=["moe_losses"] (losses.make_gpt2_losses
+        # does when moe_aux_coef > 0).
+        f_loc = jnp.mean(sl(oh, axis=2), axis=(0, 1))          # (E_loc,)
+        p_loc = jnp.mean(sl(probs, axis=2), axis=(0, 1))       # (E_loc,)
+        if self.seq_axis is not None:
+            assert self.expert_axis is None, \
+                "seq_axis and expert_axis cannot combine (config.py)"
+            # global routing stats: each seq shard sees T/nsq of the
+            # tokens, so the global means are the pmean of the local ones;
+            # aux becomes replicated across seq shards and its psum'ed
+            # gradient (federated/rounds.py seq-axis grad psum) is exact
+            f_loc = jax.lax.pmean(f_loc, self.seq_axis)
+            p_loc = jax.lax.pmean(p_loc, self.seq_axis)
+        aux = float(E) * jnp.sum(f_loc * p_loc)
+        if self.expert_axis is not None:
+            from commefficient_tpu.models.gpt2 import _psum_repct
+
+            aux = _psum_repct(aux, self.expert_axis)
+        self.sow("moe_losses", "aux", aux)
 
         # dense dispatch over the shard's local experts: (E_loc, B, T, ·)
         h = jnp.einsum("btc,ecf->ebtf", x, sl(w_fc)) \

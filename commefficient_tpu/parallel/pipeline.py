@@ -115,6 +115,9 @@ def make_gpt2_pp_losses(model: GPT2DoubleHeads, n_stages: int,
         "pipeline parallelism requires attn_impl='dense' (v1)"
     assert model.model_axis is None, \
         "pipeline parallelism cannot combine with tensor parallelism (v1)"
+    assert model.n_experts == 0, \
+        "pipeline parallelism cannot combine with MoE (v1); config.py " \
+        "forbids --n_experts with --pipeline_devices > 1"
     ranges = pp_layer_ranges(model.n_layer, n_stages)
     blk = Block(model.n_embd, model.n_head, model.dropout)
     dt = compute_dtype or jnp.float32

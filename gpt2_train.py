@@ -275,7 +275,8 @@ def train(argv=None):
         compute_loss_train, compute_loss_val = make_gpt2_losses(
             model, args.lm_coef, args.mc_coef,
             seq_axis="seq" if sp else None,
-            compute_dtype=jnp.bfloat16 if args.do_bf16 else None)
+            compute_dtype=jnp.bfloat16 if args.do_bf16 else None,
+            moe_aux_coef=args.moe_aux_coef if args.n_experts else 0.0)
 
     log_dir = make_logdir(args)
     os.makedirs(log_dir, exist_ok=True)

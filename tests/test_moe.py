@@ -80,6 +80,69 @@ class TestMoEMLP:
         np.testing.assert_allclose(np.asarray(out), expected,
                                    atol=1e-5, rtol=1e-5)
 
+    def test_aux_loss_matches_manual(self):
+        """The sown Switch aux equals E * sum_e f_e * P_e computed by hand,
+        and equals 1.0 exactly at perfectly balanced hard routing."""
+        C, nexp = 8, 4
+        mod = MoEMLP(C, nexp)
+        x = jnp.asarray(np.random.RandomState(5).randn(2, 6, C), jnp.float32)
+        params = mod.init(jax.random.key(6), x)["params"]
+        _, sown = mod.apply({"params": params}, x, mutable=["moe_losses"])
+        (aux,) = sown["moe_losses"]["aux"]
+
+        router = np.asarray(params["router"])
+        probs = np.asarray(jax.nn.softmax(
+            jnp.asarray(np.asarray(x) @ router), axis=-1)).reshape(-1, nexp)
+        top = probs.argmax(-1)
+        f = np.bincount(top, minlength=nexp) / probs.shape[0]
+        P = probs.mean(0)
+        np.testing.assert_allclose(float(aux), nexp * float((f * P).sum()),
+                                   rtol=1e-6)
+        assert float(aux) >= 1.0 - 1e-6  # E*sum(f*P) is minimized at 1
+
+    def test_aux_loss_seq_sharded_matches_global(self):
+        """With the token dimension sharded over a `seq` axis, the sown aux
+        equals the aux of the full sequence (global routing stats, not
+        per-shard ones) and is replicated across seq shards."""
+        C, nexp, nsq = 8, 4, 2
+        dense = MoEMLP(C, nexp)
+        seqmod = MoEMLP(C, nexp, seq_axis="seq")
+        x = jnp.asarray(np.random.RandomState(9).randn(2, 8, C), jnp.float32)
+        params = dense.init(jax.random.key(10), x)["params"]
+        _, sown = dense.apply({"params": params}, x, mutable=["moe_losses"])
+        (aux_d,) = sown["moe_losses"]["aux"]
+        mesh = make_mesh([("seq", nsq)])
+
+        def f(p, xx):
+            _, s = seqmod.apply({"params": p}, xx, mutable=["moe_losses"])
+            return s["moe_losses"]["aux"][0][None]  # (1,) per shard
+
+        aux_s = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(), P(None, "seq", None)),
+            out_specs=P("seq"), check_vma=False))(params, x)
+        # every shard's sown aux equals the global (full-sequence) aux
+        np.testing.assert_allclose(np.asarray(aux_s),
+                                   np.full(nsq, float(aux_d)), rtol=1e-6)
+
+    def test_aux_loss_sharded_matches_unsharded(self):
+        C, nexp, ne = 8, 4, 2
+        dense = MoEMLP(C, nexp)
+        sharded = MoEMLP(C, nexp, expert_axis="expert")
+        x = jnp.asarray(np.random.RandomState(7).randn(2, 6, C), jnp.float32)
+        params = dense.init(jax.random.key(8), x)["params"]
+        _, sown = dense.apply({"params": params}, x, mutable=["moe_losses"])
+        (aux_d,) = sown["moe_losses"]["aux"]
+        mesh = make_mesh([("expert", ne)])
+
+        def f(p, xx):
+            out, s = sharded.apply({"params": p}, xx,
+                                   mutable=["moe_losses"])
+            return s["moe_losses"]["aux"][0]
+
+        aux_s = jax.jit(shard_map(f, mesh=mesh, in_specs=(P(), P()),
+                                  out_specs=P(), check_vma=False))(params, x)
+        np.testing.assert_allclose(float(aux_s), float(aux_d), rtol=1e-6)
+
     @pytest.mark.parametrize("ne", [2, 4])
     def test_sharded_matches_unsharded(self, ne):
         """Expert-sharded MoEMLP inside a shard_map equals the unsharded
@@ -171,7 +234,9 @@ class TestEPRound:
         cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
                           ep_sliced=ep_sliced_param if expert_axis else None,
                           fuse_gradients=fuse)
-        lt, lv = make_gpt2_losses(model)
+        # aux active: the round parity below then also pins the sliced-aux
+        # router gradients under expert parallelism
+        lt, lv = make_gpt2_losses(model, moe_aux_coef=0.01)
         steps = build_round_step(lt, lv, unravel, ravel, cfg, mesh=mesh)
         rng = np.random.RandomState(3)
         batch = {
@@ -380,6 +445,36 @@ class TestEPEndToEnd:
             "--seed", "0",
             "--n_experts", "2",
             "--expert_devices", "2",
+        ])
+        assert np.isfinite(stats["val_nll"])
+        assert np.isfinite(stats["val_ppl"])
+
+    def test_gpt2_train_moe_seq_parallel(self, tmp_path, monkeypatch):
+        """--n_experts with --seq_parallel (legal per config.py: only
+        --expert_devices > 1 excludes seq parallelism): the MoE aux is
+        computed from pmean'ed global routing stats over the `seq` axis
+        (parallel/moe.py seq_axis), pinned unit-side by
+        test_aux_loss_seq_sharded_matches_global; this pins the CLI
+        wiring end-to-end."""
+        if len(jax.devices()) < 4:
+            pytest.skip("needs a 4-device mesh (2 clients x 2 seq)")
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
+        import gpt2_train
+
+        stats = gpt2_train.train(argv=[
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "1",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "uncompressed",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+            "--n_experts", "2",
+            "--seq_parallel", "ring",
+            "--seq_devices", "2",
         ])
         assert np.isfinite(stats["val_nll"])
         assert np.isfinite(stats["val_ppl"])
