@@ -221,8 +221,11 @@ def validate_args(args):
         f"--client_dropout {args.client_dropout} must be in [0, 1)")
     assert args.model_devices >= 1, "--model_devices must be >= 1"
     if args.model_devices > 1:
-        assert args.seq_parallel == "none", (
-            "--model_devices > 1 currently requires --seq_parallel none")
+        assert args.seq_parallel in ("none", "ring"), (
+            "--model_devices > 1 composes only with --seq_parallel ring "
+            "(ring attention is per-head; ulysses all-to-alls the head "
+            "dim over the seq axis, conflicting with model-axis head "
+            "slicing)")
     assert args.pipeline_devices >= 1, "--pipeline_devices must be >= 1"
     assert args.pp_microbatches >= 1, "--pp_microbatches must be >= 1"
     if args.pipeline_devices > 1:

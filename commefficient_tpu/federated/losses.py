@@ -135,8 +135,18 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
         nll_sum = tok_nll.sum(axis=(-2, -1))
         n_valid = valid.sum(axis=(-2, -1))
         if seq_axis is not None:
-            nll_sum = jax.lax.psum(nll_sum, seq_axis)
-            n_valid = jax.lax.psum(n_valid, seq_axis)
+            # _psum_repct, not lax.psum: the replicated loss's cotangent is
+            # identical on every seq shard, so the true VJP of this
+            # reduction is the identity. A plain psum's transpose under
+            # shard_map is another psum — measured doubling EVERY gradient
+            # of the seq-parallel round (each shard's grad came out
+            # nsq x its local-token contribution, breaking the worker's
+            # "psum the shard grads at scale 1" contract,
+            # federated/rounds.py).
+            from commefficient_tpu.ops.collectives import psum_repct
+
+            nll_sum = psum_repct(nll_sum, seq_axis)
+            n_valid = jax.lax.psum(n_valid, seq_axis)  # int count: nondiff
         return nll_sum / jnp.maximum(n_valid, 1)
 
     def compute_train(params, model_state, batch, rng, train):

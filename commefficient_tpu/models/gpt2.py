@@ -61,52 +61,14 @@ class GPT2Config:
         self.dropout = dropout
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def _psum_repct(x, axis_name):
-    """``psum`` whose backward passes the cotangent through unchanged.
-
-    The cotangent of a TP row-reduction's output is replicated across the
-    model axis (the loss is computed identically on every shard), so the
-    true VJP is the identity. JAX's default transpose of ``psum`` under
-    shard_map without replication tracking is another ``psum``, which
-    would scale every gradient upstream of the reduction by nm — measured
-    as an exact nm× error on all sliced-weight grads. Pinning the VJP
-    makes the TP gradient math independent of that transpose choice."""
-    return jax.lax.psum(x, axis_name)
-
-
-def _psum_repct_fwd(x, axis_name):
-    return jax.lax.psum(x, axis_name), None
-
-
-def _psum_repct_bwd(axis_name, _, ct):
-    return (ct,)
-
-
-_psum_repct.defvjp(_psum_repct_fwd, _psum_repct_bwd)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def _ident_psumct(x, axis_name):
-    """Megatron's f operator: identity forward (x is replicated), psum
-    backward. Each shard's backward produces only its weight slice's share
-    of the input cotangent; the psum reassembles the full cotangent so
-    everything upstream (layernorms, embeddings, earlier blocks) sees the
-    same gradient as the dense model. Together with ``_psum_repct`` (the g
-    operator: psum forward, identity backward) the pair makes TP autodiff
-    exact regardless of JAX's default psum transpose under shard_map."""
-    return x
-
-
-def _ident_psumct_fwd(x, axis_name):
-    return x, None
-
-
-def _ident_psumct_bwd(axis_name, _, ct):
-    return (jax.lax.psum(ct, axis_name),)
-
-
-_ident_psumct.defvjp(_ident_psumct_fwd, _ident_psumct_bwd)
+# The Megatron f/g operator pair with pinned VJPs, shared with the other
+# parallel layers — see ops/collectives.py for the full gradient story.
+# Kept importable under the old private names for the modules that grew
+# up importing them from here.
+from commefficient_tpu.ops.collectives import (  # noqa: E402
+    ident_psumct as _ident_psumct,
+    psum_repct as _psum_repct,
+)
 
 
 class TPDense(nn.Module):
@@ -214,8 +176,11 @@ class Block(nn.Module):
 
             attn = {"ring": ring_attention,
                     "ulysses": ulysses_attention}[self.attn_impl]
+            # with tensor parallelism composed in, q/k/v hold the shard's
+            # n_head/nm local heads and the attention output is the C/nm
+            # column slice the row-parallel attn_proj expects
             out = attn(q, k, v, axis_name=self.seq_axis,
-                       causal=True).reshape(B, T, C)
+                       causal=True).reshape(B, T, C // nm if tp else C)
         out = TPDense(C, self.model_axis, mode="row", name="attn_proj")(out)
         x = x + nn.Dropout(self.dropout)(out, deterministic=deterministic)
 
@@ -255,8 +220,10 @@ class GPT2DoubleHeads(nn.Module):
     # transformer blocks compute 1/nm of the heads/hidden per shard with
     # psums at the two Megatron reduction points; embeddings, LM head and
     # mc head stay replicated (their grads are rescaled by 1/nm in the
-    # worker — see federated/rounds.py tp_grad_scale). v1 restriction:
-    # combine with attn_impl "dense" only.
+    # worker — see federated/rounds.py tp_grad_scale). Composes with
+    # attn_impl "dense" or "ring" (2-D tensor x sequence sharding of the
+    # attention: heads over `model`, tokens over `seq`); "ulysses" is
+    # excluded (it all-to-alls the head dim over the seq axis).
     model_axis: Optional[str] = None
     # Mixture-of-Experts + expert parallelism (GShard/Switch-style; no
     # reference equivalent — parallel/moe.py): n_experts > 0 replaces the
@@ -282,8 +249,16 @@ class GPT2DoubleHeads(nn.Module):
         Returns (lm_logits (..., T, vocab), mc_logits (...,)).
         """
         sp = self.attn_impl != "dense"
-        assert not (sp and self.model_axis is not None), \
-            "tensor parallelism currently requires attn_impl='dense'"
+        if sp and self.model_axis is not None:
+            # ring attention is per-head, so it composes with the model
+            # axis's head slicing (each model shard rings its n_head/nm
+            # local heads over the seq axis). Ulysses all-to-alls the HEAD
+            # dimension over the seq axis, which conflicts with slicing it
+            # over the model axis — still excluded.
+            assert self.attn_impl == "ring", (
+                "tensor parallelism composes with sequence parallelism "
+                "only for attn_impl='ring' (ulysses shards heads over the "
+                "seq axis, conflicting with model-axis head slicing)")
         if self.expert_axis is not None:
             assert self.n_experts > 0, "expert_axis requires n_experts > 0"
             assert not sp and self.model_axis is None, \
@@ -327,21 +302,34 @@ class GPT2DoubleHeads(nn.Module):
         mc_logits = None
         if mc_token_ids is not None:
             flat_mc = mc_token_ids.reshape(-1)
+            # SequenceSummary head: linear to a single logit
+            head = nn.Dense(1, name="mc_head",
+                            kernel_init=nn.initializers.normal(0.02))
             if sp:
-                # the classification token lives in exactly one seq shard:
-                # mask-select locally, then psum the (B, C) hidden state
+                # the classification token lives in exactly one seq shard.
+                # The head runs on the shard-LOCAL hidden state and the
+                # psum reassembles its scalar OUTPUT (not the hidden
+                # state): with the output masked to the owning shard,
+                # every parameter's per-shard gradient — including
+                # mc_head's kernel/bias — stays partial/disjoint, so the
+                # worker's uniform "psum the shard grads at scale 1"
+                # contract holds with no special case. (Summing the hidden
+                # state instead made the head's input replicated, whose
+                # grads each shard computed in FULL — the outer psum then
+                # overcounted them nsq x.) _psum_repct pins the psum's
+                # backward to identity (the cotangent is replicated); a
+                # plain psum's transpose under shard_map is another psum,
+                # measured doubling every gradient upstream.
                 local_pos = flat_mc - pos0
                 in_range = (local_pos >= 0) & (local_pos < T)
                 safe = jnp.clip(local_pos, 0, T - 1)
-                picked = x[jnp.arange(B), safe]
-                picked = picked * in_range[:, None].astype(x.dtype)
-                cls_h = jax.lax.psum(picked, self.seq_axis)
+                picked = x[jnp.arange(B), safe]             # (B, C) local
+                mc_local = head(picked)[..., 0] \
+                    * in_range.astype(x.dtype)
+                mc_logits = _psum_repct(mc_local, self.seq_axis)
             else:
                 cls_h = x[jnp.arange(B), flat_mc]  # (B, C)
-            # SequenceSummary head: linear to a single logit
-            mc_logits = nn.Dense(1, name="mc_head",
-                                 kernel_init=nn.initializers.normal(0.02))(
-                cls_h)[..., 0]
+                mc_logits = head(cls_h)[..., 0]
             mc_logits = mc_logits.reshape(orig_shape[:-1])
 
         lm_logits = lm_logits.reshape(orig_shape + (self.vocab_size,))

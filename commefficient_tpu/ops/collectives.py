@@ -1,0 +1,71 @@
+"""Collective operators with pinned VJPs for SPMD parallelism.
+
+Under ``shard_map`` without replication tracking, JAX transposes a plain
+``lax.psum`` to another ``psum`` — so differentiating through a forward
+reduction scales every upstream gradient by the axis size (measured as an
+exact nm×/nsq× error on tensor- and sequence-parallel gradients). The two
+operators here pin the transposes the parallel layers actually mean, the
+Megatron f/g pair:
+
+- ``psum_repct`` (the g operator): psum forward, **identity** backward —
+  for reductions whose output's cotangent is replicated across the axis
+  (the loss is computed identically on every shard downstream).
+- ``ident_psumct`` (the f operator): identity forward (the input is
+  replicated), **psum** backward — entering a sliced computation, each
+  shard's backward produces only its slice's share of the input
+  cotangent; the psum reassembles the full one.
+
+Together they make sharded autodiff exact regardless of JAX's default
+psum transpose, and keep the per-shard gradients on the contract the
+federated worker reconciliation assumes (``federated/rounds.py``: psum
+the shard grads over each axis, rescale masks only where a computation is
+replicated). Used by tensor parallelism (``models/gpt2.py`` TPDense),
+sequence parallelism (``federated/losses.py`` nll reduction, the GPT-2 mc
+head), expert parallelism and the MoE aux (``parallel/moe.py``). Lives in
+``ops`` (not ``parallel``) so ``models`` can import it without pulling in
+the ``parallel`` package's model-importing submodules (circular import).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = ["psum_repct", "ident_psumct"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_repct(x, axis_name):
+    """``psum`` whose backward passes the cotangent through unchanged
+    (correct when the output's cotangent is replicated across the axis)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _psum_repct_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _psum_repct_bwd(axis_name, _, ct):
+    return (ct,)
+
+
+psum_repct.defvjp(_psum_repct_fwd, _psum_repct_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def ident_psumct(x, axis_name):
+    """Identity forward (x is replicated across the axis); psum backward
+    (reassembles the full cotangent from the shards' partial ones)."""
+    return x
+
+
+def _ident_psumct_fwd(x, axis_name):
+    return x, None
+
+
+def _ident_psumct_bwd(axis_name, _, ct):
+    return (jax.lax.psum(ct, axis_name),)
+
+
+ident_psumct.defvjp(_ident_psumct_fwd, _ident_psumct_bwd)

@@ -93,9 +93,9 @@ class MoEMLP(nn.Module):
             # cotangent from BOTH consumers of x (router path and expert
             # path) rides the backward psum — everything upstream then
             # sees the same replicated gradient as the unsharded module
-            from commefficient_tpu.models.gpt2 import _ident_psumct
+            from commefficient_tpu.ops.collectives import ident_psumct
 
-            x = _ident_psumct(x, self.expert_axis)
+            x = ident_psumct(x, self.expert_axis)
 
         # routing in f32 for a stable softmax regardless of compute dtype
         logits = x.astype(jnp.float32) @ router.astype(jnp.float32)
@@ -135,16 +135,24 @@ class MoEMLP(nn.Module):
             assert self.expert_axis is None, \
                 "seq_axis and expert_axis cannot combine (config.py)"
             # global routing stats: each seq shard sees T/nsq of the
-            # tokens, so the global means are the pmean of the local ones;
-            # aux becomes replicated across seq shards and its psum'ed
-            # gradient (federated/rounds.py seq-axis grad psum) is exact
-            f_loc = jax.lax.pmean(f_loc, self.seq_axis)
-            p_loc = jax.lax.pmean(p_loc, self.seq_axis)
+            # tokens, so the global means are the mean of the local ones;
+            # aux becomes replicated across seq shards. _psum_repct (psum
+            # forward, identity backward) + explicit /nsq rather than
+            # pmean: each shard's gradient contribution through its local
+            # stats is then 1/nsq of the replicated cotangent, which the
+            # worker's seq-axis grad psum sums back to exactly the full
+            # gradient — independent of how JAX transposes a plain psum
+            # under shard_map (see ops/collectives.py).
+            from commefficient_tpu.ops.collectives import psum_repct
+
+            nsq = jax.lax.psum(1, self.seq_axis)
+            f_loc = psum_repct(f_loc, self.seq_axis) / nsq
+            p_loc = psum_repct(p_loc, self.seq_axis) / nsq
         aux = float(E) * jnp.sum(f_loc * p_loc)
         if self.expert_axis is not None:
-            from commefficient_tpu.models.gpt2 import _psum_repct
+            from commefficient_tpu.ops.collectives import psum_repct
 
-            aux = _psum_repct(aux, self.expert_axis)
+            aux = psum_repct(aux, self.expert_axis)
         self.sow("moe_losses", "aux", aux)
 
         # dense dispatch over the shard's local experts: (E_loc, B, T, ·)
@@ -157,7 +165,7 @@ class MoEMLP(nn.Module):
         if self.expert_axis is not None:
             # g operator: psum fwd (partial combines -> full MoE output),
             # identity bwd (the output cotangent is replicated)
-            from commefficient_tpu.models.gpt2 import _psum_repct
+            from commefficient_tpu.ops.collectives import psum_repct
 
-            out = _psum_repct(out, self.expert_axis)
+            out = psum_repct(out, self.expert_axis)
         return out

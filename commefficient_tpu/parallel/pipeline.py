@@ -56,7 +56,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from commefficient_tpu.federated.losses import _cast_tree, _mc_ce_acc
-from commefficient_tpu.models.gpt2 import Block, GPT2DoubleHeads, _psum_repct
+from commefficient_tpu.models.gpt2 import Block, GPT2DoubleHeads
+from commefficient_tpu.ops.collectives import psum_repct
 
 __all__ = ["STAGE_AXIS", "pp_layer_ranges", "make_gpt2_pp_losses"]
 
@@ -224,9 +225,9 @@ def make_gpt2_pp_losses(model: GPT2DoubleHeads, n_stages: int,
 
         # stage-masked accumulators -> replicated values; identity backward
         # sends the cotangent into the last stage only (see module docstring)
-        nll_sum = _psum_repct(nll_acc, axis).reshape(E0)
-        n_valid = _psum_repct(nv_acc, axis).reshape(E0)
-        mc_logits = _psum_repct(mc_acc, axis).reshape(E0, C)
+        nll_sum = psum_repct(nll_acc, axis).reshape(E0)
+        n_valid = psum_repct(nv_acc, axis).reshape(E0)
+        mc_logits = psum_repct(mc_acc, axis).reshape(E0, C)
         lm_nll = nll_sum / jnp.maximum(n_valid, 1)
         return lm_nll, mc_logits
 

@@ -200,8 +200,9 @@ def train(argv=None):
     # sequence parallelism (--seq_parallel ring|ulysses): attention runs
     # over the global sequence sharded across the mesh's `seq` axis.
     # Tensor parallelism (--model_devices N): heads/hidden sharded over a
-    # `model` axis (mutually exclusive with seq parallelism for now —
-    # enforced by validate_args). Both derive from the REALIZED mesh: the
+    # `model` axis. The two COMPOSE for ring attention (a clients x seq x
+    # model mesh: heads over `model`, tokens over `seq`); ulysses is
+    # excluded (validate_args). Both derive from the REALIZED mesh: the
     # policy warns and degrades to fewer axes on small hosts, and the
     # model must not reference an axis the mesh lacks.
     from commefficient_tpu.parallel.mesh import default_client_mesh

@@ -70,12 +70,24 @@ def child(proc_id: int, port: int) -> None:
     from __graft_entry__ import run_tiny_sketched_round
     from commefficient_tpu.parallel.mesh import make_mesh
 
+    def sync(tag: str) -> None:
+        # coordination-service barrier (NOT a device collective): a loaded
+        # host can skew the two children's compiles past the CPU
+        # collectives' ~30 s timeout and past the client's ~30 s shutdown
+        # barrier; syncing on compile-done and on exit makes both windows
+        # skew-free. 300 s covers a worst-case contended compile.
+        from jax._src.distributed import global_state
+
+        global_state.client.wait_at_barrier(tag, 300_000)
+
     mesh = make_mesh([("clients", W)])
-    new_ps, _ = run_tiny_sketched_round(mesh, W=W, put=_global_put)
+    new_ps, _ = run_tiny_sketched_round(mesh, W=W, put=_global_put,
+                                        sync=sync)
     print(f"CHILD {proc_id} RESULT "
           f"sum={float(new_ps.sum()):.10e} "
           f"absmax={float(abs(new_ps).max()):.10e} d={new_ps.size}",
           flush=True)
+    sync("pre_exit")
 
 
 def _sanitized_env(n_devices: int) -> dict:
