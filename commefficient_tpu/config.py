@@ -229,9 +229,10 @@ def validate_args(args):
     assert args.pipeline_devices >= 1, "--pipeline_devices must be >= 1"
     assert args.pp_microbatches >= 1, "--pp_microbatches must be >= 1"
     if args.pipeline_devices > 1:
-        assert args.seq_parallel == "none" and args.model_devices == 1, (
-            "--pipeline_devices > 1 currently requires --seq_parallel none "
-            "and --model_devices 1")
+        assert args.seq_parallel == "none", (
+            "--pipeline_devices > 1 currently requires --seq_parallel none"
+            " (it composes with --model_devices: a clients x stage x model"
+            " mesh)")
     assert args.n_experts >= 0, "--n_experts must be >= 0"
     assert args.expert_devices >= 1, "--expert_devices must be >= 1"
     if args.n_experts > 0:

@@ -258,9 +258,10 @@ def build_round_step(
     if wcfg.pp_axis is not None:
         assert mesh is not None and wcfg.pp_axis in mesh.axis_names, \
             f"pp_axis {wcfg.pp_axis!r} not in mesh axes"
-        assert wcfg.seq_axis is None and wcfg.model_axis is None, \
-            "pipeline parallelism cannot combine with seq/tensor " \
-            "parallelism (v1)"
+        assert wcfg.seq_axis is None, \
+            "pipeline parallelism cannot combine with seq parallelism " \
+            "(v1); it composes with tensor parallelism (stage psum and " \
+            "model psum x tp_scale act on orthogonal axes)"
 
     def fused_clients(ps_weights, model_state, batch, rng_keys, worker_mask):
         """One-gradient client phase for a shard's W client slots. Returns

@@ -40,9 +40,12 @@ the exact dense gradient before any compression (federated/worker.py
 forward_grad, federated/rounds.py fused_clients). Every compression mode
 therefore composes with pipelining unchanged.
 
-v1 restrictions (asserted): dense attention only (no seq axis), no tensor
-parallelism on the same model, float32 or bf16 compute via
-``compute_dtype``.
+Tensor parallelism composes (``--pipeline_devices`` with
+``--model_devices``, a clients×stage×model mesh): each stage's blocks
+slice heads/hidden over the ``model`` axis with the usual two psums, and
+the worker reconciles with the stage psum and the model psum × tp_scale
+on orthogonal axes. v1 restrictions (asserted): dense attention only (no
+seq axis), no MoE, float32 or bf16 compute via ``compute_dtype``.
 """
 
 from __future__ import annotations
@@ -114,13 +117,18 @@ def make_gpt2_pp_losses(model: GPT2DoubleHeads, n_stages: int,
     params replicated across it."""
     assert model.attn_impl == "dense", \
         "pipeline parallelism requires attn_impl='dense' (v1)"
-    assert model.model_axis is None, \
-        "pipeline parallelism cannot combine with tensor parallelism (v1)"
     assert model.n_experts == 0, \
         "pipeline parallelism cannot combine with MoE (v1); config.py " \
         "forbids --n_experts with --pipeline_devices > 1"
     ranges = pp_layer_ranges(model.n_layer, n_stages)
-    blk = Block(model.n_embd, model.n_head, model.dropout)
+    # Tensor parallelism composes: each stage's blocks slice heads/hidden
+    # over model.model_axis (both axes bound in the same shard_map). The
+    # stage-0 embedding and last-stage lm/mc heads below run replicated
+    # across the model axis; the worker's tp_scale mask (1/nm on
+    # replicated-computed params) composes with the stage psum because the
+    # two reconciliations act on orthogonal axes.
+    blk = Block(model.n_embd, model.n_head, model.dropout,
+                model_axis=model.model_axis)
     dt = compute_dtype or jnp.float32
 
     def _pipeline(params, batch, rng, train):

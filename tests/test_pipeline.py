@@ -169,12 +169,20 @@ class TestPPLosses:
     def test_rejects_illegal_combos(self):
         with pytest.raises(AssertionError, match="attn_impl"):
             make_gpt2_pp_losses(_model().copy(attn_impl="ring"), 2)
-        with pytest.raises(AssertionError, match="tensor"):
-            make_gpt2_pp_losses(_model().copy(model_axis="model"), 2)
+        # tensor parallelism COMPOSES (clients x stage x model,
+        # TestPPxTP); seq parallelism does not
+        from commefficient_tpu.config import parse_args
+
+        with pytest.raises(AssertionError, match="seq_parallel none"):
+            parse_args(argv=["--mode", "uncompressed",
+                             "--local_momentum", "0",
+                             "--pipeline_devices", "2",
+                             "--seq_parallel", "ring"])
 
 
 class TestPPRound:
-    def _build(self, mesh, pp_axis, losses, fuse=None):
+    def _build(self, mesh, pp_axis, losses, fuse=None, model_axis=None,
+               tp_sliced=None):
         W, B, C = 2, 2, 2
         model = _model()
         ids0 = jnp.zeros((1, C, T), jnp.int32)
@@ -188,11 +196,12 @@ class TestPPRound:
             return ravel_pytree(tree)[0]
 
         wcfg = WorkerConfig(mode="uncompressed", error_type="virtual",
-                            num_workers=W, pp_axis=pp_axis)
+                            num_workers=W, pp_axis=pp_axis,
+                            model_axis=model_axis)
         scfg = ServerConfig(mode="uncompressed", error_type="virtual",
                             grad_size=d, virtual_momentum=0.9)
         cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
-                          fuse_gradients=fuse)
+                          fuse_gradients=fuse, tp_sliced=tp_sliced)
         lt, lv = losses(model)
         steps = build_round_step(lt, lv, unravel, ravel, cfg, mesh=mesh)
         rng = np.random.RandomState(3)
@@ -275,14 +284,73 @@ class TestPPRound:
                            "--mode", "uncompressed", "--local_momentum", "0",
                            "--pipeline_devices", "2"])
 
-    def test_config_rejects_combo_with_tp_and_sp(self):
-        from commefficient_tpu.config import parse_args
+class TestPPxTP:
+    """Pipeline parallelism COMPOSED with tensor parallelism (a clients x
+    stage x model 3-D mesh): each stage's blocks slice heads/hidden over
+    the `model` axis; the worker reconciles with the stage psum and the
+    model psum x tp_scale on orthogonal axes (federated/rounds.py)."""
 
-        with pytest.raises(AssertionError, match="pipeline_devices"):
-            parse_args(argv=["--mode", "uncompressed", "--local_momentum",
-                             "0", "--pipeline_devices", "2",
-                             "--model_devices", "2"])
-        with pytest.raises(AssertionError, match="pipeline_devices"):
-            parse_args(argv=["--mode", "uncompressed", "--local_momentum",
-                             "0", "--pipeline_devices", "2",
-                             "--seq_parallel", "ring"])
+    @pytest.mark.parametrize("fuse", [False, True])
+    def test_round_matches_dense(self, fuse):
+        """A full federated round over clients x stage x model equals the
+        dense clients-only round, exact up to float summation order."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices (2 clients x 2 stage x 2 model)")
+        from commefficient_tpu.models.gpt2 import tp_sliced_param
+
+        helper = TestPPRound()
+        mesh_d = make_mesh([("clients", 2)])
+        mesh_3 = make_mesh([("clients", 2), ("stage", 2), ("model", 2)])
+
+        def run(mesh, pp_axis, model_axis, losses, tp_sliced=None):
+            steps, flat, ss, cs, batch = helper._build(
+                mesh, pp_axis, losses, fuse=fuse, model_axis=model_axis,
+                tp_sliced=tp_sliced)
+            out = steps.train_step(flat, ss, cs, {}, batch, 0.1,
+                                   jax.random.key(7))
+            return np.asarray(out[0]), [np.asarray(m) for m in out[4]]
+
+        w_d, m_d = run(mesh_d, None, None, lambda m: make_gpt2_losses(m))
+        w_3, m_3 = run(
+            mesh_3, "stage", "model",
+            lambda m: make_gpt2_pp_losses(m.copy(model_axis="model"), 2,
+                                          n_micro=2),
+            tp_sliced=tp_sliced_param)
+        np.testing.assert_allclose(w_3, w_d, atol=2e-5, rtol=2e-5)
+        for a, b in zip(m_3, m_d):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+    def test_gpt2_train_pp_tp_mesh(self, tmp_path, monkeypatch):
+        """CLI end-to-end on the clients x stage x model mesh:
+        --pipeline_devices 2 --model_devices 2 with 2 workers (8 devices),
+        through the sketch pipeline on the reconciled gradient."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices (2 clients x 2 stage x 2 model)")
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
+        monkeypatch.setenv("COMMEFFICIENT_TINY_MODEL", "1")
+        monkeypatch.setenv("COMMEFFICIENT_GPT2_SEQ_LEN", "64")
+        import gpt2_train
+
+        stats = gpt2_train.train(argv=[
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "1",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "sketch",
+            "--error_type", "virtual",
+            "--local_momentum", "0",
+            "--k", "64",
+            "--num_cols", "2048",
+            "--num_rows", "3",
+            "--num_blocks", "2",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+            "--pipeline_devices", "2",
+            "--pp_microbatches", "2",
+            "--model_devices", "2",
+        ])
+        assert np.isfinite(stats["val_nll"])
+        assert np.isfinite(stats["val_ppl"])
