@@ -236,7 +236,7 @@ class TestTPxSP:
         np.testing.assert_allclose(np.asarray(mc_b), np.asarray(mc_d),
                                    atol=3e-4, rtol=3e-4)
 
-    @pytest.mark.parametrize("axes", ["seq", "3d"])
+    @pytest.mark.parametrize("axes", ["seq", "seq-ulysses", "3d"])
     @pytest.mark.parametrize("fuse", [False, True])
     def test_round_matches_dense(self, fuse, axes):
         """A full federated round over the seq-sharded (clients x seq) and
@@ -301,8 +301,9 @@ class TestTPxSP:
             return np.asarray(out[0]), [np.asarray(m) for m in out[4]]
 
         w_d, m_d = run(dense, make_mesh([("clients", 2)]), None, None)
-        if axes == "seq":
-            w_b, m_b = run(dense.copy(attn_impl="ring"),
+        if axes.startswith("seq"):
+            impl = "ulysses" if axes.endswith("ulysses") else "ring"
+            w_b, m_b = run(dense.copy(attn_impl=impl),
                            make_mesh([("clients", 2), ("seq", 2)]),
                            "seq", None)
         else:
