@@ -246,10 +246,10 @@ def validate_args(args):
         assert args.n_experts % args.expert_devices == 0, (
             f"--n_experts {args.n_experts} must divide by "
             f"--expert_devices {args.expert_devices}")
-        assert (args.seq_parallel == "none" and args.model_devices == 1
-                and args.pipeline_devices == 1), (
-            "--expert_devices > 1 currently requires --seq_parallel none, "
-            "--model_devices 1 and --pipeline_devices 1")
+        assert args.model_devices == 1 and args.pipeline_devices == 1, (
+            "--expert_devices > 1 currently requires --model_devices 1 "
+            "and --pipeline_devices 1 (it composes with --seq_parallel: "
+            "a clients x seq x expert mesh)")
     if args.device:
         # select the JAX platform before the backend initializes (the
         # reference's --device picks the torch device; here e.g.

@@ -233,8 +233,10 @@ class GPT2DoubleHeads(nn.Module):
     # that mesh axis; parameters stay full-shape/replicated, so the
     # federated flat vector, compression, and checkpoints are unchanged.
     # Expert-sliced grads are reconciled via psum + ep_scale in the worker
-    # (see parallel.moe.ep_sliced_param). v1 restriction: expert_axis
-    # requires attn_impl "dense" and no model_axis.
+    # (see parallel.moe.ep_sliced_param). Composes with sequence
+    # parallelism (clients x seq x expert: each shard dispatches its
+    # local tokens to its local experts); model_axis is excluded (both
+    # would slice the same MLP).
     n_experts: int = 0
     moe_every: int = 2
     expert_axis: Optional[str] = None
@@ -261,9 +263,11 @@ class GPT2DoubleHeads(nn.Module):
                 "seq axis, conflicting with model-axis head slicing)")
         if self.expert_axis is not None:
             assert self.n_experts > 0, "expert_axis requires n_experts > 0"
-            assert not sp and self.model_axis is None, \
-                "expert parallelism currently requires attn_impl='dense' " \
-                "and no model_axis"
+            # composes with sequence parallelism (clients x seq x expert:
+            # each shard dispatches its local tokens to its local experts)
+            # but not with the model axis (both would slice the same MLP)
+            assert self.model_axis is None, \
+                "expert parallelism cannot combine with model_axis"
         orig_shape = input_ids.shape
         T = orig_shape[-1]
         flat_ids = input_ids.reshape(-1, T)

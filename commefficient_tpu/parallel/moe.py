@@ -70,10 +70,13 @@ class MoEMLP(nn.Module):
     # seq shard_map (Block passes it for ring/ulysses attention). Routing
     # and dispatch are per-token and need no communication, but the
     # load-balancing aux must use GLOBAL routing statistics: f/P are
-    # pmean'ed over this axis so the sown aux is replicated across seq
-    # shards (the loss contract of losses.make_gpt2_losses) and its psum'ed
-    # gradient is exact. Mutually exclusive with expert_axis (config.py
-    # forbids --expert_devices > 1 with --seq_parallel).
+    # globalized over this axis (psum_repct/nsq) so the sown aux is
+    # replicated across seq shards (the loss contract of
+    # losses.make_gpt2_losses) and its psum'ed gradient is exact.
+    # COMPOSES with expert_axis (a clients x seq x expert mesh): each
+    # (seq, expert) shard dispatches its local tokens to its local
+    # experts; the two reconciliations (seq psum at scale 1, expert psum
+    # x ep_scale) act on orthogonal axes.
     seq_axis: Optional[str] = None
 
     @nn.compact
@@ -132,8 +135,6 @@ class MoEMLP(nn.Module):
         f_loc = jnp.mean(sl(oh, axis=2), axis=(0, 1))          # (E_loc,)
         p_loc = jnp.mean(sl(probs, axis=2), axis=(0, 1))       # (E_loc,)
         if self.seq_axis is not None:
-            assert self.expert_axis is None, \
-                "seq_axis and expert_axis cannot combine (config.py)"
             # global routing stats: each seq shard sees T/nsq of the
             # tokens, so the global means are the mean of the local ones;
             # aux becomes replicated across seq shards. _psum_repct (psum

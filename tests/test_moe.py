@@ -450,12 +450,12 @@ class TestEPEndToEnd:
         assert np.isfinite(stats["val_ppl"])
 
     def test_gpt2_train_moe_seq_parallel(self, tmp_path, monkeypatch):
-        """--n_experts with --seq_parallel (legal per config.py: only
-        --expert_devices > 1 excludes seq parallelism): the MoE aux is
-        computed from pmean'ed global routing stats over the `seq` axis
-        (parallel/moe.py seq_axis), pinned unit-side by
+        """--n_experts with --seq_parallel: the MoE aux is computed from
+        global routing stats over the `seq` axis (psum_repct/nsq,
+        parallel/moe.py seq_axis), pinned unit-side by
         test_aux_loss_seq_sharded_matches_global; this pins the CLI
-        wiring end-to-end."""
+        wiring end-to-end. TestSPxEP covers the sharded-expert variant
+        (--expert_devices > 1 composes too)."""
         if len(jax.devices()) < 4:
             pytest.skip("needs a 4-device mesh (2 clients x 2 seq)")
         monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
@@ -475,6 +475,141 @@ class TestEPEndToEnd:
             "--n_experts", "2",
             "--seq_parallel", "ring",
             "--seq_devices", "2",
+        ])
+        assert np.isfinite(stats["val_nll"])
+        assert np.isfinite(stats["val_ppl"])
+
+
+from tests.test_tensor_parallel import _shift_labels  # noqa: E402
+
+
+class TestSPxEP:
+    """Sequence parallelism COMPOSED with expert parallelism (a clients x
+    seq x expert mesh): each (seq, expert) shard dispatches its local
+    tokens to its local experts; the worker reconciles with the seq psum
+    (token-partial grads, scale 1) and the expert psum x ep_scale on
+    orthogonal axes (federated/rounds.py)."""
+
+    def test_logits_and_aux_match_unsharded(self):
+        """MoE GPT-2 forward over a seq x expert 2x2 mesh equals the
+        unsharded forward, and the sown aux equals the global-stat aux."""
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices (2 seq x 2 expert)")
+        from commefficient_tpu.parallel.moe import MoEMLP
+
+        C, nexp = 8, 4
+        dense = MoEMLP(C, nexp)
+        both = MoEMLP(C, nexp, expert_axis="expert", seq_axis="seq")
+        x = jnp.asarray(np.random.RandomState(11).randn(2, 8, C),
+                        jnp.float32)
+        params = dense.init(jax.random.key(12), x)["params"]
+        out_d, sown = dense.apply({"params": params}, x,
+                                  mutable=["moe_losses"])
+        (aux_d,) = sown["moe_losses"]["aux"]
+        mesh = make_mesh([("seq", 2), ("expert", 2)])
+
+        def f(p, xx):
+            out, s = both.apply({"params": p}, xx, mutable=["moe_losses"])
+            return out, s["moe_losses"]["aux"][0][None]
+
+        out_b, aux_b = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(), P(None, "seq", None)),
+            out_specs=(P(None, "seq", None), P("seq")),
+            check_vma=False))(params, x)
+        np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_d),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(aux_b),
+                                   np.full(2, float(aux_d)), rtol=1e-6)
+
+    @pytest.mark.parametrize("fuse", [False, True])
+    def test_round_matches_unsharded(self, fuse):
+        """A full federated round (aux active) over clients x seq x expert
+        equals the unsharded clients-only round, exact up to float
+        summation order."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices (2 clients x 2 seq x 2 expert)")
+        dense, _ = _models()
+        both = dense.copy(expert_axis="expert", attn_impl="ring")
+        W, B, C = 2, 2, 2
+        ids0 = jnp.zeros((1, C, T), jnp.int32)
+        params = dense.init(jax.random.key(0), ids0, token_type_ids=ids0,
+                            mc_token_ids=jnp.zeros((1, C), jnp.int32),
+                            train=False)["params"]
+        flat0, unravel = ravel_pytree(params)
+        d = int(flat0.size)
+
+        def ravel(tree):
+            return ravel_pytree(tree)[0]
+
+        rng = np.random.RandomState(3)
+        lm_labels = _ids(6, (W, B, C, T))
+        batch = {
+            "input_ids": _ids(4, (W, B, C, T)),
+            "token_type_ids": _ids(5, (W, B, C, T)),
+            "lm_labels": lm_labels,
+            "mc_token_ids": jnp.asarray(rng.randint(0, T, (W, B, C)),
+                                        jnp.int32),
+            "mc_labels": jnp.asarray(rng.randint(0, C, (W, B)), jnp.int32),
+            "mask": jnp.ones((W, B), jnp.float32),
+            "client_ids": jnp.arange(W, dtype=jnp.int32),
+            "worker_mask": jnp.ones(W, jnp.float32),
+        }
+
+        def run(model, mesh, seq_axis, expert_axis):
+            wcfg = WorkerConfig(mode="uncompressed", error_type="virtual",
+                                num_workers=W, seq_axis=seq_axis,
+                                expert_axis=expert_axis)
+            scfg = ServerConfig(mode="uncompressed", error_type="virtual",
+                                grad_size=d, virtual_momentum=0.9)
+            cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
+                              ep_sliced=(ep_sliced_param if expert_axis
+                                         else None),
+                              fuse_gradients=fuse)
+            lt, lv = make_gpt2_losses(model, seq_axis=seq_axis,
+                                      moe_aux_coef=0.01)
+            steps = build_round_step(lt, lv, unravel, ravel, cfg,
+                                     mesh=mesh)
+            b = dict(batch)
+            if seq_axis is not None:
+                b["lm_labels_shifted"] = _shift_labels(lm_labels)
+                del b["lm_labels"]
+            ss = init_server_state(scfg, None)
+            cs = init_client_states(4, d, wcfg)
+            out = steps.train_step(jnp.array(flat0), ss, cs, {}, b, 0.1,
+                                   jax.random.key(7))
+            return np.asarray(out[0]), [np.asarray(m) for m in out[4]]
+
+        w_d, m_d = run(dense, make_mesh([("clients", 2)]), None, None)
+        w_b, m_b = run(both, make_mesh([("clients", 2), ("seq", 2),
+                                        ("expert", 2)]), "seq", "expert")
+        np.testing.assert_allclose(w_b, w_d, atol=2e-5, rtol=2e-5)
+        for a, b in zip(m_b, m_d):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+    def test_gpt2_train_sp_ep_mesh(self, tmp_path, monkeypatch):
+        """CLI end-to-end on the clients x seq x expert mesh:
+        --seq_parallel ring --seq_devices 2 --n_experts 2
+        --expert_devices 2 with 2 workers (8 devices)."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices (2 clients x 2 seq x 2 expert)")
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
+        import gpt2_train
+
+        stats = gpt2_train.train(argv=[
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "1",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "uncompressed",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+            "--seq_parallel", "ring",
+            "--seq_devices", "2",
+            "--n_experts", "2",
+            "--expert_devices", "2",
         ])
         assert np.isfinite(stats["val_nll"])
         assert np.isfinite(stats["val_ppl"])
