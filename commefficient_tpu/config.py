@@ -236,8 +236,6 @@ def validate_args(args):
     assert args.n_experts >= 0, "--n_experts must be >= 0"
     assert args.expert_devices >= 1, "--expert_devices must be >= 1"
     if args.n_experts > 0:
-        assert args.model_devices == 1, (
-            "--n_experts > 0 currently requires --model_devices 1")
         assert args.pipeline_devices == 1, (
             "--n_experts > 0 currently requires --pipeline_devices 1 "
             "(the pipeline stage blocks are dense)")
@@ -246,10 +244,12 @@ def validate_args(args):
         assert args.n_experts % args.expert_devices == 0, (
             f"--n_experts {args.n_experts} must divide by "
             f"--expert_devices {args.expert_devices}")
-        assert args.model_devices == 1 and args.pipeline_devices == 1, (
-            "--expert_devices > 1 currently requires --model_devices 1 "
-            "and --pipeline_devices 1 (it composes with --seq_parallel: "
-            "a clients x seq x expert mesh)")
+        assert args.pipeline_devices == 1, (
+            "--expert_devices > 1 currently requires --pipeline_devices 1;"
+            " it composes with --seq_parallel (clients x seq x expert) "
+            "and with --model_devices (clients x model x expert: the "
+            "model axis slices attention, the expert axis the MoE "
+            "experts)")
     if args.device:
         # select the JAX platform before the backend initializes (the
         # reference's --device picks the torch device; here e.g.

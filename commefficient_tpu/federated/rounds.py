@@ -246,11 +246,12 @@ def build_round_step(
         tp_scale = _flat_scale(wcfg.model_axis, cfg.tp_sliced, "tp_sliced")
     ep_scale = None
     if wcfg.expert_axis is not None:
-        assert wcfg.model_axis is None and wcfg.pp_axis is None, \
-            "expert parallelism cannot combine with tensor/pipeline " \
-            "parallelism (v1); it composes with seq parallelism (seq " \
-            "psum at scale 1 and expert psum x ep_scale act on " \
-            "orthogonal axes)"
+        assert wcfg.pp_axis is None, \
+            "expert parallelism cannot combine with pipeline parallelism" \
+            " (v1); it composes with seq parallelism (token-partial " \
+            "grads, scale 1) and with tensor parallelism (orthogonal " \
+            "param sets: each axis's scale mask marks the other's " \
+            "params replicated)"
         ep_scale = _flat_scale(wcfg.expert_axis, cfg.ep_sliced, "ep_sliced")
 
     # Pipeline parallelism (parallel/pipeline.py): the loss callbacks carry

@@ -235,8 +235,9 @@ class GPT2DoubleHeads(nn.Module):
     # Expert-sliced grads are reconciled via psum + ep_scale in the worker
     # (see parallel.moe.ep_sliced_param). Composes with sequence
     # parallelism (clients x seq x expert: each shard dispatches its
-    # local tokens to its local experts); model_axis is excluded (both
-    # would slice the same MLP).
+    # local tokens to its local experts) and with model_axis
+    # (clients x model x expert: attention TP + MoE EP on orthogonal
+    # param sets), up to the full 4-D clients x seq x model x expert.
     n_experts: int = 0
     moe_every: int = 2
     expert_axis: Optional[str] = None
@@ -265,9 +266,14 @@ class GPT2DoubleHeads(nn.Module):
             assert self.n_experts > 0, "expert_axis requires n_experts > 0"
             # composes with sequence parallelism (clients x seq x expert:
             # each shard dispatches its local tokens to its local experts)
-            # but not with the model axis (both would slice the same MLP)
-            assert self.model_axis is None, \
-                "expert parallelism cannot combine with model_axis"
+            # AND with tensor parallelism (clients x model x expert: the
+            # model axis slices attention + the dense blocks' MLPs, the
+            # expert axis slices the MoE blocks' experts — orthogonal
+            # param sets; MoE params are replicated across `model` and
+            # attention params across `expert`, which the tp_scale and
+            # ep_scale masks already classify: parallel.moe
+            # ep_sliced_param is True only on /moe/ paths, and
+            # tp_sliced_param never matches them).
         orig_shape = input_ids.shape
         T = orig_shape[-1]
         flat_ids = input_ids.reshape(-1, T)

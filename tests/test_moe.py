@@ -483,6 +483,66 @@ class TestEPEndToEnd:
 from tests.test_tensor_parallel import _shift_labels  # noqa: E402
 
 
+def _composed_round_fixtures():
+    """Shared fixtures for the composed-mesh round-parity tests (the MoE
+    GPT-2 model, its flat params, and one 2-worker batch)."""
+    dense, _ = _models()
+    W, B, C = 2, 2, 2
+    ids0 = jnp.zeros((1, C, T), jnp.int32)
+    params = dense.init(jax.random.key(0), ids0, token_type_ids=ids0,
+                        mc_token_ids=jnp.zeros((1, C), jnp.int32),
+                        train=False)["params"]
+    flat0, unravel = ravel_pytree(params)
+    d = int(flat0.size)
+    rng = np.random.RandomState(3)
+    lm_labels = _ids(6, (W, B, C, T))
+    batch = {
+        "input_ids": _ids(4, (W, B, C, T)),
+        "token_type_ids": _ids(5, (W, B, C, T)),
+        "lm_labels": lm_labels,
+        "mc_token_ids": jnp.asarray(rng.randint(0, T, (W, B, C)),
+                                    jnp.int32),
+        "mc_labels": jnp.asarray(rng.randint(0, C, (W, B)), jnp.int32),
+        "mask": jnp.ones((W, B), jnp.float32),
+        "client_ids": jnp.arange(W, dtype=jnp.int32),
+        "worker_mask": jnp.ones(W, jnp.float32),
+    }
+    return dense, flat0, unravel, d, batch, lm_labels
+
+
+def _run_composed_round(model, mesh, seq_axis, model_axis, expert_axis,
+                        fuse, flat0, unravel, d, batch, lm_labels):
+    """One full federated round (aux active) under any combination of
+    seq/model/expert axes; returns (new weights, metrics). The single
+    round-runner for every composed-mesh parity test in this file."""
+    from commefficient_tpu.models.gpt2 import tp_sliced_param
+
+    def ravel(tree):
+        return ravel_pytree(tree)[0]
+
+    wcfg = WorkerConfig(mode="uncompressed", error_type="virtual",
+                        num_workers=2, seq_axis=seq_axis,
+                        model_axis=model_axis, expert_axis=expert_axis)
+    scfg = ServerConfig(mode="uncompressed", error_type="virtual",
+                        grad_size=d, virtual_momentum=0.9)
+    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
+                      tp_sliced=(tp_sliced_param if model_axis else None),
+                      ep_sliced=(ep_sliced_param if expert_axis else None),
+                      fuse_gradients=fuse)
+    lt, lv = make_gpt2_losses(model, seq_axis=seq_axis, moe_aux_coef=0.01)
+    steps = build_round_step(lt, lv, unravel, ravel, cfg, mesh=mesh)
+    b = dict(batch)
+    if seq_axis is not None:
+        b["lm_labels_shifted"] = _shift_labels(lm_labels)
+        del b["lm_labels"]
+    ss = init_server_state(scfg, None)
+    cs = init_client_states(4, d, wcfg)
+    out = steps.train_step(jnp.array(flat0), ss, cs, {}, b, 0.1,
+                           jax.random.key(7))
+    return np.asarray(out[0]), [np.asarray(m) for m in out[4]]
+
+
+
 class TestSPxEP:
     """Sequence parallelism COMPOSED with expert parallelism (a clients x
     seq x expert mesh): each (seq, expert) shard dispatches its local
@@ -528,60 +588,14 @@ class TestSPxEP:
         summation order."""
         if len(jax.devices()) < 8:
             pytest.skip("needs 8 devices (2 clients x 2 seq x 2 expert)")
-        dense, _ = _models()
+        dense, flat0, unravel, d, batch, lm = _composed_round_fixtures()
+        w_d, m_d = _run_composed_round(
+            dense, make_mesh([("clients", 2)]), None, None, None, fuse,
+            flat0, unravel, d, batch, lm)
         both = dense.copy(expert_axis="expert", attn_impl="ring")
-        W, B, C = 2, 2, 2
-        ids0 = jnp.zeros((1, C, T), jnp.int32)
-        params = dense.init(jax.random.key(0), ids0, token_type_ids=ids0,
-                            mc_token_ids=jnp.zeros((1, C), jnp.int32),
-                            train=False)["params"]
-        flat0, unravel = ravel_pytree(params)
-        d = int(flat0.size)
-
-        def ravel(tree):
-            return ravel_pytree(tree)[0]
-
-        rng = np.random.RandomState(3)
-        lm_labels = _ids(6, (W, B, C, T))
-        batch = {
-            "input_ids": _ids(4, (W, B, C, T)),
-            "token_type_ids": _ids(5, (W, B, C, T)),
-            "lm_labels": lm_labels,
-            "mc_token_ids": jnp.asarray(rng.randint(0, T, (W, B, C)),
-                                        jnp.int32),
-            "mc_labels": jnp.asarray(rng.randint(0, C, (W, B)), jnp.int32),
-            "mask": jnp.ones((W, B), jnp.float32),
-            "client_ids": jnp.arange(W, dtype=jnp.int32),
-            "worker_mask": jnp.ones(W, jnp.float32),
-        }
-
-        def run(model, mesh, seq_axis, expert_axis):
-            wcfg = WorkerConfig(mode="uncompressed", error_type="virtual",
-                                num_workers=W, seq_axis=seq_axis,
-                                expert_axis=expert_axis)
-            scfg = ServerConfig(mode="uncompressed", error_type="virtual",
-                                grad_size=d, virtual_momentum=0.9)
-            cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
-                              ep_sliced=(ep_sliced_param if expert_axis
-                                         else None),
-                              fuse_gradients=fuse)
-            lt, lv = make_gpt2_losses(model, seq_axis=seq_axis,
-                                      moe_aux_coef=0.01)
-            steps = build_round_step(lt, lv, unravel, ravel, cfg,
-                                     mesh=mesh)
-            b = dict(batch)
-            if seq_axis is not None:
-                b["lm_labels_shifted"] = _shift_labels(lm_labels)
-                del b["lm_labels"]
-            ss = init_server_state(scfg, None)
-            cs = init_client_states(4, d, wcfg)
-            out = steps.train_step(jnp.array(flat0), ss, cs, {}, b, 0.1,
-                                   jax.random.key(7))
-            return np.asarray(out[0]), [np.asarray(m) for m in out[4]]
-
-        w_d, m_d = run(dense, make_mesh([("clients", 2)]), None, None)
-        w_b, m_b = run(both, make_mesh([("clients", 2), ("seq", 2),
-                                        ("expert", 2)]), "seq", "expert")
+        w_b, m_b = _run_composed_round(
+            both, make_mesh([("clients", 2), ("seq", 2), ("expert", 2)]),
+            "seq", None, "expert", fuse, flat0, unravel, d, batch, lm)
         np.testing.assert_allclose(w_b, w_d, atol=2e-5, rtol=2e-5)
         for a, b in zip(m_b, m_d):
             np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
@@ -608,6 +622,89 @@ class TestSPxEP:
             "--seed", "0",
             "--seq_parallel", "ring",
             "--seq_devices", "2",
+            "--n_experts", "2",
+            "--expert_devices", "2",
+        ])
+        assert np.isfinite(stats["val_nll"])
+        assert np.isfinite(stats["val_ppl"])
+
+
+class TestTPxEP:
+    """Tensor parallelism COMPOSED with expert parallelism (clients x
+    model x expert): the model axis slices attention + the dense blocks'
+    MLPs, the expert axis slices the MoE blocks' experts. Orthogonal
+    param sets — each axis's scale mask marks the other's params
+    replicated (tp_scale 1/nm on /moe/ paths, ep_scale 1/ne on
+    attention), so the existing reconciliation composes unchanged."""
+
+    _run_round = staticmethod(_run_composed_round)
+    _fixtures = staticmethod(_composed_round_fixtures)
+
+    @pytest.mark.parametrize("fuse", [False, True])
+    def test_round_matches_unsharded(self, fuse):
+        """A full federated round (aux active) over clients x model x
+        expert equals the unsharded clients-only round."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices (2 clients x 2 model x 2 expert)")
+        dense, flat0, unravel, d, batch, lm = self._fixtures()
+        w_d, m_d = self._run_round(dense, make_mesh([("clients", 2)]),
+                                   None, None, None, fuse, flat0, unravel,
+                                   d, batch, lm)
+        both = dense.copy(model_axis="model", expert_axis="expert")
+        w_b, m_b = self._run_round(
+            both, make_mesh([("clients", 2), ("model", 2), ("expert", 2)]),
+            None, "model", "expert", fuse, flat0, unravel, d, batch, lm)
+        np.testing.assert_allclose(w_b, w_d, atol=2e-5, rtol=2e-5)
+        for a, b in zip(m_b, m_d):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+    def test_round_matches_unsharded_4d(self):
+        """The FULL composition — clients x seq x model x expert (ring
+        attention TP'd over `model`, tokens over `seq`, MoE experts over
+        `expert`) — equals the unsharded round on a 1 x 2 x 2 x 2 mesh."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices (1 x 2 seq x 2 model x 2 expert)")
+        dense, flat0, unravel, d, batch, lm = self._fixtures()
+        w_d, m_d = self._run_round(dense, make_mesh([("clients", 1)]),
+                                   None, None, None, False, flat0, unravel,
+                                   d, batch, lm)
+        full = dense.copy(attn_impl="ring", model_axis="model",
+                          expert_axis="expert")
+        w_f, m_f = self._run_round(
+            full, make_mesh([("clients", 1), ("seq", 2), ("model", 2),
+                             ("expert", 2)]),
+            "seq", "model", "expert", False, flat0, unravel, d, batch, lm)
+        np.testing.assert_allclose(w_f, w_d, atol=2e-5, rtol=2e-5)
+        for a, b in zip(m_f, m_d):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+    def test_gpt2_train_tp_ep_mesh(self, tmp_path, monkeypatch):
+        """CLI end-to-end on the clients x model x expert mesh:
+        --model_devices 2 --n_experts 2 --expert_devices 2 with 2 workers
+        (8 devices), through the sketch pipeline."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices (2 clients x 2 model x 2 expert)")
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
+        import gpt2_train
+
+        stats = gpt2_train.train(argv=[
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "1",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "sketch",
+            "--error_type", "virtual",
+            "--local_momentum", "0",
+            "--k", "64",
+            "--num_cols", "2048",
+            "--num_rows", "3",
+            "--num_blocks", "2",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+            "--model_devices", "2",
             "--n_experts", "2",
             "--expert_devices", "2",
         ])
