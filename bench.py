@@ -340,16 +340,24 @@ def _time_rounds(steps, ps, server_state, client_states, batch, warmup,
     return best
 
 
-def run_gpt2_measurement() -> None:
-    """Child-process entry (--run-gpt2): prints its own JSON line with the
-    f32 number (comparable to the reference's f32 training) and the bf16
-    number (--bf16 mixed precision, the TPU-native mode)."""
+def run_gpt2_measurement(legs=(False, True)) -> None:
+    """Child-process entry (--run-gpt2 [f32|bf16]): prints its own JSON line
+    with the f32 number (comparable to the reference's f32 training) and/or
+    the bf16 number (--bf16 mixed precision, the TPU-native mode).
+
+    ``legs`` selects which to run — three straight tunnel-revival windows
+    died on the pair of d=124M compiles in one child (VERDICT r3 #1), so the
+    batch runner (scripts/tpu_batch.sh) now runs each leg as its own
+    resumable step."""
+    import jax
+
     # own process — the --run child's kernel checks (and any kill-switch env
     # they set) don't reach here, so re-verify before building
     _check_pallas_kernel()
     out = {
         "gpt2_metric": "GPT-2 PersonaChat tokens/sec/chip "
                        "(124M double-heads, 4 workers, sketch 5x500k k=50k)",
+        "platform": jax.default_backend(),
     }
     n = 10
 
@@ -360,12 +368,15 @@ def run_gpt2_measurement() -> None:
         steps, ps, server_state, client_states, batch, tokens = \
             build_gpt2(bf16=bf16)
         tag = "gpt2-bf16" if bf16 else "gpt2-f32"
+        # warmup=1: iter 1 pays the compile; the timed loop subtracts the
+        # settled rtt, and best-of-3 reps already absorbs residual warmth.
+        # A second warmup iter cost window time the d=124M legs don't have.
         dt = _time_rounds(steps, ps, server_state, client_states, batch,
-                          warmup=2, iters=n, tag=tag)
+                          warmup=1, iters=n, tag=tag)
         return tokens, dt
 
     flops_per_token = gpt2_train_flops_per_token()
-    for bf16 in (False, True):
+    for bf16 in legs:
         tokens, dt = one_leg(bf16)
         key = "gpt2_bf16" if bf16 else "gpt2"
         tok_per_sec = tokens * n / dt
@@ -543,6 +554,139 @@ def _load_tpu_cache():
         return None
 
 
+# Per-leg result cache for the secondary (extra) measurements. The tunneled
+# chip compiles server-side, so the persistent XLA compile cache never
+# carries the d=124M GPT-2 executables across windows — every window repaid
+# the full compile and three straight windows died inside it (VERDICT r3).
+# Caching the RESULT per leg means any window that ever lands a number keeps
+# it for every later artifact. Tracked in git for the same reason as
+# _TPU_CACHE.
+_EXTRAS_CACHE = os.path.join(_REPO_DIR, ".bench_extras.json")
+
+# leg name -> (child argv, env var for its timeout, default timeout s,
+#              result key that proves the leg produced its number)
+_EXTRA_LEGS = {
+    "gpt2_bf16": (["--run-gpt2", "bf16"], "BENCH_GPT2_TIMEOUT", 1500,
+                  "gpt2_bf16_tokens_per_sec"),
+    "gpt2_f32": (["--run-gpt2", "f32"], "BENCH_GPT2_TIMEOUT", 1500,
+                 "gpt2_tokens_per_sec"),
+    "c4": (["--run-c4"], "BENCH_C4_TIMEOUT", 900,
+           "cifar100_rounds_per_sec"),
+}
+
+
+def _git_head() -> str:
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             cwd=_REPO_DIR, capture_output=True, text=True,
+                             timeout=10)
+        return out.stdout.strip() or "unknown"
+    except (OSError, subprocess.TimeoutExpired):
+        return "unknown"
+
+
+def _load_extras() -> dict:
+    try:
+        with open(_EXTRAS_CACHE) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _save_extra(leg: str, result: dict) -> None:
+    if "partial" in result:
+        _log(f"not caching partial {leg} result")
+        return
+    extras = _load_extras()
+    extras[leg] = {"measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                   "head": _git_head(), "result": result}
+    try:
+        with open(_EXTRAS_CACHE, "w") as f:
+            json.dump(extras, f, indent=1)
+    except OSError as e:
+        _log(f"could not write extras cache: {e}")
+
+
+def _capture_extra(leg: str) -> int:
+    """Parent-side one-leg capture (--capture LEG): run the leg's child on
+    the TPU env and merge a success into the extras cache. Exit 0 only when
+    the leg's defining key landed — scripts/tpu_batch.sh uses the rc to
+    mark the step done, so successive tunnel windows resume, not restart."""
+    argv, tmo_var, tmo_default, key = _EXTRA_LEGS[leg]
+    timeout = float(os.environ.get(tmo_var, tmo_default))
+    _log(f"capturing extra leg {leg} (timeout {timeout:.0f}s)")
+    result, err = _run_child(argv, _tpu_env(), timeout)
+    if result is None or key not in result:
+        _log(f"leg {leg} failed: {err or f'no {key} in child output'}")
+        return 1
+    if result.get("platform") not in ("tpu", "axon"):
+        # the child reports its own backend; a silent CPU fallback (tunnel
+        # died between the batch's inter-step probe and the child's JAX
+        # init) must never be cached and published as an on-chip number
+        _log(f"leg {leg} ran on backend {result.get('platform')!r}, not a "
+             f"TPU — discarding")
+        return 1
+    _save_extra(leg, result)
+    print(json.dumps({leg: result}), flush=True)
+    return 0 if "partial" not in result else 1
+
+
+def _fresh_or_cached_extras(result: dict, run_fresh: bool = True) -> None:
+    """Populate result['extra'] from the per-leg children, falling back to
+    the extras cache for any leg that fails. A cache hit younger than
+    BENCH_EXTRAS_MAX_AGE (default 12h) skips the fresh run entirely: the
+    batch runner (scripts/tpu_batch.sh) measures each leg as its own step
+    minutes or hours earlier in the same window, the tunneled chip compiles
+    server-side so no compile cache survives into this process, and
+    re-paying a d=124M compile to reproduce a number we already hold is how
+    three straight windows died (VERDICT r3 #1). The cache stamp
+    (measured_at @ head) is copied into the artifact so provenance stays
+    explicit. Set BENCH_EXTRAS_MAX_AGE=0 to force fresh runs."""
+    max_age = float(os.environ.get("BENCH_EXTRAS_MAX_AGE", 12 * 3600))
+    extras_out = {}
+    cache = _load_extras()
+    for leg in _EXTRA_LEGS:
+        cached = cache.get(leg)
+        cache_ok = cached is not None and "result" in cached
+        if cache_ok and max_age > 0:
+            try:
+                age = time.time() - time.mktime(
+                    time.strptime(cached["measured_at"], "%Y-%m-%d %H:%M:%S"))
+            except (ValueError, KeyError):
+                age = float("inf")
+            if age < max_age:
+                _log(f"extra leg {leg}: cache hit ({age / 60:.0f} min old, "
+                     f"head {cached.get('head')}) — skipping fresh run")
+                extras_out.update(cached["result"])
+                extras_out[f"{leg}_cached"] = (f"{cached['measured_at']} @ "
+                                               f"{cached.get('head')}")
+                continue
+        fresh, err = (None, "fresh run disabled") if not run_fresh else (
+            _capture_via_child(leg))
+        if fresh is not None:
+            extras_out.update(fresh)
+        elif cache_ok:
+            stamp = (f"{cached.get('measured_at')} @ {cached.get('head')}")
+            _log(f"extra leg {leg} failed ({err}); using cached result "
+                 f"from {stamp}")
+            extras_out.update(cached["result"])
+            extras_out[f"{leg}_cached"] = f"{stamp} (fresh: {err})"
+        else:
+            extras_out[f"{leg}_error"] = err
+    result["extra"] = extras_out
+
+
+def _capture_via_child(leg: str):
+    argv, tmo_var, tmo_default, key = _EXTRA_LEGS[leg]
+    timeout = float(os.environ.get(tmo_var, tmo_default))
+    _log(f"running extra leg {leg} (timeout {timeout:.0f}s)")
+    fresh, err = _run_child(argv, _tpu_env(), timeout)
+    if fresh is not None and key in fresh:
+        _save_extra(leg, fresh)
+        return fresh, None
+    return None, err or f"no {key} in child output"
+
+
 def _last_json_line(text):
     for line in reversed((text or "").strip().splitlines()):
         line = line.strip()
@@ -608,19 +752,17 @@ def main() -> int:
         _log(f"TPU unavailable: {tpu_error}")
 
     if result is not None:
-        # secondary GPT-2 workload (BASELINE.md config 5) in its OWN child
-        # with its own timeout: a compile hang, HBM OOM, or hard libtpu
-        # abort there can never cost the already-captured headline number
-        gpt2_timeout = float(os.environ.get("BENCH_GPT2_TIMEOUT", 1500))
-        _log(f"running GPT-2 secondary bench (timeout {gpt2_timeout:.0f}s)")
-        extra, err = _run_child(["--run-gpt2"], _tpu_env(), gpt2_timeout)
-        result["extra"] = extra if extra is not None else {"gpt2_error": err}
-        # config-4 leg (non-IID CIFAR100-style sketched round), again its own
-        # child so a failure there costs neither prior number
-        c4_timeout = float(os.environ.get("BENCH_C4_TIMEOUT", 900))
-        _log(f"running config-4 bench (timeout {c4_timeout:.0f}s)")
-        c4, err = _run_child(["--run-c4"], _tpu_env(), c4_timeout)
-        result["extra"].update(c4 if c4 is not None else {"cifar100_error": err})
+        # secondary workloads (GPT-2 bf16/f32 = BASELINE.md config 5, and the
+        # config-4 non-IID CIFAR100 round), each in its OWN child with its
+        # own timeout so a compile hang, HBM OOM, or hard libtpu abort there
+        # can never cost the already-captured headline number; each leg
+        # falls back to the per-leg result cache (see _EXTRAS_CACHE).
+        # Under BENCH_REQUIRE_TPU (the batch runner's 'bench' step) fresh
+        # extra runs are disabled outright: the dedicated --capture steps
+        # that follow in scripts/tpu_batch.sh own those compiles, and this
+        # step's outer timeout does not budget for them.
+        _fresh_or_cached_extras(
+            result, run_fresh=not os.environ.get("BENCH_REQUIRE_TPU"))
         _save_tpu_cache(result)
 
     if result is None and os.environ.get("BENCH_REQUIRE_TPU"):
@@ -665,9 +807,17 @@ if __name__ == "__main__":
         run_measurement(tiny=(len(sys.argv) >= 3 and sys.argv[2] == "tiny"))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--run-gpt2":
-        run_gpt2_measurement()
+        sel = sys.argv[2] if len(sys.argv) >= 3 else "both"
+        table = {"f32": (False,), "bf16": (True,), "both": (False, True)}
+        if sel not in table:
+            # a typo silently running BOTH legs would reinstate the exact
+            # two-compiles-one-child failure mode the split exists to avoid
+            sys.exit(f"--run-gpt2: unknown leg {sel!r}; use f32|bf16|both")
+        run_gpt2_measurement(table[sel])
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--run-c4":
         run_cifar100_measurement()
         sys.exit(0)
+    if len(sys.argv) >= 3 and sys.argv[1] == "--capture":
+        sys.exit(_capture_extra(sys.argv[2]))
     sys.exit(main())

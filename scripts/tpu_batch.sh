@@ -57,7 +57,7 @@ touch "$STATE"
 is_done() { grep -qx "$1" "$STATE" 2>/dev/null; }
 mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 
-STEPS=${*:-"bench learning gpt2 ops"}
+STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 learning profile ops"}
 i=0
 for step in $STEPS; do
   i=$((i + 1))
@@ -67,21 +67,42 @@ for step in $STEPS; do
   fi
   case "$step" in
     bench)
-      log "step $i: full bench.py, TPU-required (timeout 75m)"
-      BENCH_REQUIRE_TPU=1 timeout 4500 python bench.py \
+      log "step $i: headline bench.py, TPU-required (timeout 40m)"
+      # extras come from the dedicated per-leg capture steps below (and
+      # from the per-leg result cache they fill); attempting them fresh
+      # inside this step re-paid the d=124M compiles that killed three
+      # straight round-3 windows
+      BENCH_REQUIRE_TPU=1 timeout 2400 python bench.py \
         >"$OUT/bench.json" 2>"$OUT/bench.log"
       log "step $i rc=$? ($(tail -c 300 "$OUT/bench.json" 2>/dev/null))"
       # done = the headline artifact is on-chip. bench.py isolates the
       # gpt2/config-4 legs in their own children precisely so they cannot
       # cost the headline; tying completion to them would re-burn the
-      # whole bench every window while e.g. the gpt2 leg keeps timing
-      # out (GPT-2 tokens/sec also comes from the separate 'gpt2' step,
-      # and the driver re-runs bench.py at round end with a warm cache)
+      # whole bench every window while e.g. the gpt2 leg keeps timing out
       if grep -q '"platform": "tpu"' "$OUT/bench.json" 2>/dev/null; then
         mark_done bench
         grep -q '_error' "$OUT/bench.json" \
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
+      ;;
+    gpt2_bf16|gpt2_f32|c4)
+      # one resumable capture per heavy compile: a window that lands even
+      # one leg banks it in .bench_extras.json for every later artifact
+      log "step $i: bench.py --capture $step (timeout 40m)"
+      timeout 2400 python bench.py --capture "$step" \
+        >"$OUT/capture_$step.json" 2>"$OUT/capture_$step.log"
+      rc=$?
+      log "step $i rc=$rc ($(tail -c 300 "$OUT/capture_$step.json" \
+        2>/dev/null))"
+      [ $rc -eq 0 ] && mark_done "$step"
+      ;;
+    profile)
+      log "step $i: tpu_profile.py per-op breakdown (timeout 30m)"
+      timeout 1800 python scripts/tpu_profile.py \
+        >"$OUT/profile.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (docs/measurements/tpu_profile.md on success)"
+      [ $rc -eq 0 ] && mark_done profile
       ;;
     learning)
       log "step $i: learning_fullscale.py (timeout 90m)"

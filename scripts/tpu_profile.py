@@ -1,0 +1,203 @@
+"""Per-op on-chip profile of the fused CIFAR federated round.
+
+VERDICT r3 weak #3: the round is compression-dominated (3.71 ms round vs
+2.17 ms standalone re-sketch at d=6.5M) but no committed per-op profile
+shows where the remaining ~80% of the round goes. This script captures a
+jax.profiler trace around the steady-state fused train step (the exact
+bench.py geometry: full ResNet9 d=6.5M, 8 workers, sketch 5x500k k=50k),
+parses the XLA-op plane out of the xplane.pb protobuf directly (no
+tensorboard UI in this image's loop), and writes a per-op and per-category
+breakdown to docs/measurements/tpu_profile.md.
+
+Run on the real chip (claims the tunnel):  python scripts/tpu_profile.py
+Parser self-test on CPU:  TPU_PROFILE_ALLOW_CPU=1 python scripts/tpu_profile.py
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import sys
+import time
+from collections import defaultdict
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from __graft_entry__ import apply_tpu_cache_env  # noqa: E402
+
+apply_tpu_cache_env(os.environ)
+
+ROUNDS = int(os.environ.get("TPU_PROFILE_ROUNDS", 10))
+OUT_MD = os.path.join(_REPO, "docs", "measurements", "tpu_profile.md")
+
+
+def _category(op_name: str) -> str:
+    """Bucket an XLA op span name into a coarse category. Fusion names carry
+    the fused root op after the kind tag (e.g. 'loop_fusion' wrapping adds);
+    we bucket by the leading mnemonic which is how the TPU op profiler
+    groups too."""
+    n = op_name.lower()
+    for pat, cat in (
+        (r"convolution|conv", "convolution (MXU)"),
+        (r"\bdot\b|matmul|gemm", "matmul (MXU)"),
+        (r"all-reduce|all-gather|reduce-scatter|collective|permute",
+         "collectives"),
+        (r"scatter", "scatter (sketch accumulate)"),
+        (r"gather", "gather"),
+        (r"sort", "sort"),
+        (r"while", "while (top-k radix)"),
+        (r"custom-call", "custom-call (pallas)"),
+        (r"copy|transpose|reshape|bitcast", "data movement"),
+        (r"rng|threefry", "rng"),
+        (r"reduce", "reduce"),
+        (r"fusion", "elementwise fusion"),
+    ):
+        if re.search(pat, n):
+            return cat
+    return "other"
+
+
+def aggregate_xplane(xplane_path: str):
+    """Parse one xplane.pb; return (plane_name, line_name,
+    {op_name: (count, total_ps)}) for the busiest XLA-op line found.
+
+    TPU traces carry a '/device:TPU:N' plane with lines 'XLA Modules' /
+    'XLA Ops'; CPU traces put XLA op spans on host threads. We prefer an
+    'XLA Ops' line on a device plane, then any line whose events' metadata
+    look like HLO op names, ranked by total busy time."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xspace = xplane_pb2.XSpace()
+    with open(xplane_path, "rb") as f:
+        xspace.ParseFromString(f.read())
+
+    candidates = []  # (score, plane_name, line_name, {name: [count, ps]})
+    for plane in xspace.planes:
+        meta = {mid: m.name for mid, m in plane.event_metadata.items()}
+        for line in plane.lines:
+            agg: dict = defaultdict(lambda: [0, 0])
+            for ev in line.events:
+                name = meta.get(ev.metadata_id, str(ev.metadata_id))
+                a = agg[name]
+                a[0] += 1
+                a[1] += ev.duration_ps
+            if not agg:
+                continue
+            total_ps = sum(v[1] for v in agg.values())
+            is_device = ("TPU" in plane.name or "device" in plane.name
+                         or "Device" in plane.name)
+            is_xla_line = line.name in ("XLA Ops", "XLA Modules", "XLA TraceMe")
+            score = (2 * int(is_device and line.name == "XLA Ops")
+                     + int(is_device) + int(is_xla_line))
+            candidates.append((score, total_ps, plane.name, line.name, agg))
+    if not candidates:
+        return None
+    candidates.sort(key=lambda t: (t[0], t[1]), reverse=True)
+    _, _, plane_name, line_name, agg = candidates[0]
+    return plane_name, line_name, agg
+
+
+def write_report(plane, line, agg, wall_ms_per_round, backend, d, tiny,
+                 out_md):
+    total_ps = sum(v[1] for v in agg.values())
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+    cats: dict = defaultdict(lambda: [0, 0])
+    for name, (cnt, ps) in agg.items():
+        c = cats[_category(name)]
+        c[0] += cnt
+        c[1] += ps
+    cat_rows = sorted(cats.items(), key=lambda kv: -kv[1][1])
+
+    geom = (f"tiny CPU-fallback geometry (ResNet9 d={d:,}) — parser "
+            f"self-test, NOT a perf artifact" if tiny else
+            f"full bench geometry (ResNet9 d={d:,}, 8 workers, "
+            f"sketch 5x500k k=50k)")
+    os.makedirs(os.path.dirname(out_md), exist_ok=True)
+    with open(out_md, "w") as f:
+        f.write("# Per-op profile: fused CIFAR federated round\n\n")
+        f.write(f"Captured {time.strftime('%Y-%m-%d %H:%M:%S')} on backend "
+                f"`{backend}`, {geom}, {ROUNDS} steady-state "
+                f"rounds traced.\n\n")
+        f.write(f"Wall clock: **{wall_ms_per_round:.2f} ms/round**. "
+                f"Trace plane `{plane}` line `{line}`, device busy time "
+                f"{total_ps / 1e9 / ROUNDS:.2f} ms/round "
+                f"({total_ps / 1e9:.1f} ms total).\n\n")
+        f.write("## By category\n\n")
+        f.write("| category | spans | total ms | ms/round | % busy |\n")
+        f.write("|---|---|---|---|---|\n")
+        for cat, (cnt, ps) in cat_rows:
+            f.write(f"| {cat} | {cnt} | {ps / 1e9:.2f} | "
+                    f"{ps / 1e9 / ROUNDS:.3f} | {100 * ps / total_ps:.1f}% |\n")
+        f.write("\n## Top 40 ops\n\n")
+        f.write("| op | count | total ms | ms/round | % busy |\n")
+        f.write("|---|---|---|---|---|\n")
+        for name, (cnt, ps) in rows[:40]:
+            safe = name.replace("|", "\\|")[:90]
+            f.write(f"| `{safe}` | {cnt} | {ps / 1e9:.2f} | "
+                    f"{ps / 1e9 / ROUNDS:.3f} | {100 * ps / total_ps:.1f}% |\n")
+        f.write("\nRaw trace: runs/tpu_profile_trace/ (not committed).\n")
+    print(f"wrote {out_md}", flush=True)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    if not on_tpu and not os.environ.get("TPU_PROFILE_ALLOW_CPU"):
+        print("backend is not a TPU; set TPU_PROFILE_ALLOW_CPU=1 for a "
+              "parser self-test on CPU", flush=True)
+        return 2
+
+    import bench as B
+
+    tiny = not on_tpu
+    steps, ps, ss, cs, batch = B.build(tiny=tiny)
+    d = int(ps.size)
+
+    def drain(x):
+        return float(jnp.asarray(x).ravel()[0])
+
+    state = (ps, ss, cs, {})
+    rng = jax.random.key(0)
+    print("warmup/compile...", flush=True)
+    for _ in range(3):
+        out = steps.train_step(*state, batch, 0.1, rng)
+        state = out[:4]
+        drain(state[0])
+
+    trace_dir = os.path.join(_REPO, "runs", "tpu_profile_trace")
+    print(f"tracing {ROUNDS} rounds -> {trace_dir}", flush=True)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(trace_dir):
+        for _ in range(ROUNDS):
+            out = steps.train_step(*state, batch, 0.1, rng)
+            state = out[:4]
+        drain(state[0])
+    wall_ms = (time.perf_counter() - t0) * 1e3 / ROUNDS
+
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime)
+    if not paths:
+        print("no xplane.pb produced by the trace", flush=True)
+        return 1
+    parsed = aggregate_xplane(paths[-1])
+    if parsed is None:
+        print("xplane parse found no event lines", flush=True)
+        return 1
+    plane, line, agg = parsed
+    # the committed docs path is reserved for real on-chip profiles; the
+    # CPU parser self-test writes to a scratch path so it can never
+    # clobber (or masquerade as) an on-chip report
+    out_md = OUT_MD if on_tpu else os.path.join(
+        _REPO, "runs", "tpu_profile_selftest.md")
+    write_report(plane, line, agg, wall_ms, backend, d, tiny, out_md)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
