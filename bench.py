@@ -612,21 +612,10 @@ def _capture_extra(leg: str) -> int:
     the TPU env and merge a success into the extras cache. Exit 0 only when
     the leg's defining key landed — scripts/tpu_batch.sh uses the rc to
     mark the step done, so successive tunnel windows resume, not restart."""
-    argv, tmo_var, tmo_default, key = _EXTRA_LEGS[leg]
-    timeout = float(os.environ.get(tmo_var, tmo_default))
-    _log(f"capturing extra leg {leg} (timeout {timeout:.0f}s)")
-    result, err = _run_child(argv, _tpu_env(), timeout)
-    if result is None or key not in result:
-        _log(f"leg {leg} failed: {err or f'no {key} in child output'}")
+    result, err = _run_leg(leg)
+    if result is None:
+        _log(f"leg {leg} failed: {err}")
         return 1
-    if result.get("platform") not in ("tpu", "axon"):
-        # the child reports its own backend; a silent CPU fallback (tunnel
-        # died between the batch's inter-step probe and the child's JAX
-        # init) must never be cached and published as an on-chip number
-        _log(f"leg {leg} ran on backend {result.get('platform')!r}, not a "
-             f"TPU — discarding")
-        return 1
-    _save_extra(leg, result)
     print(json.dumps({leg: result}), flush=True)
     return 0 if "partial" not in result else 1
 
@@ -662,7 +651,7 @@ def _fresh_or_cached_extras(result: dict, run_fresh: bool = True) -> None:
                                                f"{cached.get('head')}")
                 continue
         fresh, err = (None, "fresh run disabled") if not run_fresh else (
-            _capture_via_child(leg))
+            _run_leg(leg))
         if fresh is not None:
             extras_out.update(fresh)
         elif cache_ok:
@@ -676,15 +665,22 @@ def _fresh_or_cached_extras(result: dict, run_fresh: bool = True) -> None:
     result["extra"] = extras_out
 
 
-def _capture_via_child(leg: str):
+def _run_leg(leg: str):
+    """The ONE path that runs an extra-leg child, validates it, and banks a
+    success in the extras cache. Returns (result, None) or (None, err)."""
     argv, tmo_var, tmo_default, key = _EXTRA_LEGS[leg]
     timeout = float(os.environ.get(tmo_var, tmo_default))
     _log(f"running extra leg {leg} (timeout {timeout:.0f}s)")
     fresh, err = _run_child(argv, _tpu_env(), timeout)
-    if fresh is not None and key in fresh:
-        _save_extra(leg, fresh)
-        return fresh, None
-    return None, err or f"no {key} in child output"
+    if fresh is None or key not in fresh:
+        return None, err or f"no {key} in child output"
+    if fresh.get("platform") not in ("tpu", "axon"):
+        # the child reports its own backend; a silent CPU fallback (tunnel
+        # died between the liveness probe and the child's JAX init) must
+        # never be cached and published as an on-chip number
+        return None, f"ran on backend {fresh.get('platform')!r}, not a TPU"
+    _save_extra(leg, fresh)
+    return fresh, None
 
 
 def _last_json_line(text):

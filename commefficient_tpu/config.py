@@ -153,14 +153,24 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     # unchanged.
     parser.add_argument("--n_experts", type=int, default=0,
                         help="Experts per MoE MLP for GPT-2 (0 = dense "
-                             "MLPs, the reference architecture).")
+                             "MLPs, the reference architecture). NOTE: "
+                             "dispatch is dense for parity/static shapes — "
+                             "each MoE block computes all n_experts/"
+                             "expert_devices local experts per token, so an "
+                             "MoE block costs that many full MLP passes; "
+                             "there is no sparse-MoE FLOP saving unless "
+                             "expert_devices == n_experts.")
     parser.add_argument("--expert_devices", type=int, default=1,
                         help="Size of the `expert` (expert-parallel) mesh "
                              "axis for GPT-2 MoE (1 disables).")
     parser.add_argument("--moe_aux_coef", type=float, default=0.01,
                         help="Switch load-balancing auxiliary loss "
                              "coefficient for MoE GPT-2 (0 disables; only "
-                             "meaningful with --n_experts > 0).")
+                             "meaningful with --n_experts > 0). The aux is "
+                             "the mean over MoE layers of the per-token "
+                             "Switch balance term, weighted per example — "
+                             "the Switch-paper convention, so published "
+                             "values (0.01) transfer directly.")
     # TPU-first extension: dropout/DP mask PRNG. threefry (JAX default) is
     # counter-based ALU work; rbg uses the TPU hardware RNG and is much
     # cheaper at GPT-2 mask volumes. unsafe_rbg additionally relaxes

@@ -165,9 +165,14 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
             (lm_logits, mc_logits), sown = model.apply(
                 {"params": params}, batch["input_ids"],
                 mutable=["moe_losses"], **apply_kwargs)
-            aux_total = sum(
-                jnp.sum(jnp.asarray(leaf)) for leaf in
-                jax.tree_util.tree_leaves(sown.get("moe_losses", {})))
+            leaves = jax.tree_util.tree_leaves(sown.get("moe_losses", {}))
+            # mean over MoE layers (each layer sows one per-token-mean aux):
+            # the Switch-paper convention is a per-layer/per-token mean, so
+            # published coefficients (the 0.01 default) transfer regardless
+            # of how many blocks carry an MoE MLP
+            if leaves:
+                aux_total = sum(jnp.sum(jnp.asarray(leaf))
+                                for leaf in leaves) / len(leaves)
         else:
             lm_logits, mc_logits = model.apply(
                 {"params": params}, batch["input_ids"], **apply_kwargs)
@@ -179,7 +184,11 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
         mask = batch["mask"]
         loss_sum = jnp.sum((lm_coef * lm_nll + mc_coef * mc_ce) * mask)
         if moe_aux_coef:
-            # batch-level aux weighted like a per-example term
+            # weighted by the client's valid-example count so the aux enters
+            # the cross-client aggregation exactly like the per-example CE
+            # terms (the round divides by the summed mask); with the
+            # per-layer mean above the effective coefficient is then the
+            # Switch convention independent of depth and batch size
             loss_sum = loss_sum + moe_aux_coef * aux_total * jnp.sum(mask)
         return loss_sum, (), jnp.sum(mask), model_state
 

@@ -78,6 +78,13 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
     traffic, so they ride neighboring ICI links; ``seq`` comes next for
     the same reason relative to ``clients``.
 
+    Axis priority when clamping into the device budget is
+    ``model > stage > expert > seq > clients``: each axis is granted
+    devices before the ones after it, so on a small host a requested
+    ``--expert_devices`` can consume devices that ``--seq_devices`` would
+    otherwise have received (the seq reduction warning lists what the
+    earlier axes claimed).
+
     Always returns a mesh — a 1-device mesh keeps the shard_map/psum path
     live even single-chip, so the code path benchmarked and the code path
     tested are the same one.
@@ -109,7 +116,10 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
     ns = max(1, min(seq_devices, n_avail // (nm * npp * ne)))
     if seq_devices > ns:
         warnings.warn(f"--seq_devices {seq_devices} reduced to {ns} "
-                      f"(only {n_avail} devices available)", stacklevel=2)
+                      f"(only {n_avail} devices available; {nm} model x "
+                      f"{npp} stage x {ne} expert device(s) claimed first — "
+                      f"axis priority model > stage > expert > seq)",
+                      stacklevel=2)
     requested = num_devices if num_devices and num_devices > 0 \
         else n_avail
     n = max(1, min(requested, n_avail // (ns * nm * npp * ne)))
@@ -182,7 +192,13 @@ def make_mesh(axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,
         # default granule — the TPU slice_index — is only equivalent when
         # slices == processes, and fails outright where they differ (CPU
         # fleets have no slice_index; a one-slice multi-host pod has
-        # fewer slices than processes).
+        # fewer slices than processes). Tradeoff: on a pod with several
+        # processes per ICI slice this treats ICI-connected processes as
+        # DCN-separated — a device-ordering pessimization (collectives
+        # that could ride ICI get DCN-ranked placement), not a
+        # correctness issue. If such pods become a target, derive the
+        # granule from the runtime topology (slice_index when present)
+        # instead of hard-coding per-process granules.
         dev_array = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=(sizes[0] // n_proc, *sizes[1:]),
             dcn_mesh_shape=(n_proc,) + (1,) * (len(sizes) - 1),
