@@ -238,28 +238,13 @@ def validate_args(args):
             "slicing)")
     assert args.pipeline_devices >= 1, "--pipeline_devices must be >= 1"
     assert args.pp_microbatches >= 1, "--pp_microbatches must be >= 1"
-    if args.pipeline_devices > 1:
-        assert args.seq_parallel == "none", (
-            "--pipeline_devices > 1 currently requires --seq_parallel none"
-            " (it composes with --model_devices: a clients x stage x model"
-            " mesh)")
     assert args.n_experts >= 0, "--n_experts must be >= 0"
     assert args.expert_devices >= 1, "--expert_devices must be >= 1"
-    if args.n_experts > 0:
-        assert args.pipeline_devices == 1, (
-            "--n_experts > 0 currently requires --pipeline_devices 1 "
-            "(the pipeline stage blocks are dense)")
     if args.expert_devices > 1:
         assert args.n_experts > 0, "--expert_devices > 1 requires --n_experts"
         assert args.n_experts % args.expert_devices == 0, (
             f"--n_experts {args.n_experts} must divide by "
             f"--expert_devices {args.expert_devices}")
-        assert args.pipeline_devices == 1, (
-            "--expert_devices > 1 currently requires --pipeline_devices 1;"
-            " it composes with --seq_parallel (clients x seq x expert) "
-            "and with --model_devices (clients x model x expert: the "
-            "model axis slices attention, the expert axis the MoE "
-            "experts)")
     if args.device:
         # select the JAX platform before the backend initializes (the
         # reference's --device picks the torch device; here e.g.

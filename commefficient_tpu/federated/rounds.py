@@ -246,24 +246,23 @@ def build_round_step(
         tp_scale = _flat_scale(wcfg.model_axis, cfg.tp_sliced, "tp_sliced")
     ep_scale = None
     if wcfg.expert_axis is not None:
-        assert wcfg.pp_axis is None, \
-            "expert parallelism cannot combine with pipeline parallelism" \
-            " (v1); it composes with seq parallelism (token-partial " \
-            "grads, scale 1) and with tensor parallelism (orthogonal " \
-            "param sets: each axis's scale mask marks the other's " \
-            "params replicated)"
+        # composes with every other axis, each on its own mesh dimension:
+        # seq (token-partial grads, scale 1), model (orthogonal param
+        # sets: each axis's scale mask marks the other's params
+        # replicated), and stage (MoE layers live inside their owning
+        # stage's blocks; the stage psum sums disjoint segments before
+        # the expert psum x ep_scale reconciles the expert slices)
         ep_scale = _flat_scale(wcfg.expert_axis, cfg.ep_sliced, "ep_sliced")
 
     # Pipeline parallelism (parallel/pipeline.py): the loss callbacks carry
     # the GPipe schedule; the round only needs the one-gradient psum over
-    # the stage axis (see worker.WorkerConfig.pp_axis).
+    # the stage axis (see worker.WorkerConfig.pp_axis). Composes with seq
+    # (the pipelined loss computes token-partial stage-local grads; the
+    # stage and seq psums both run at scale 1 on orthogonal axes), with
+    # model (stage psum + model psum x tp_scale), and with expert (above).
     if wcfg.pp_axis is not None:
         assert mesh is not None and wcfg.pp_axis in mesh.axis_names, \
             f"pp_axis {wcfg.pp_axis!r} not in mesh axes"
-        assert wcfg.seq_axis is None, \
-            "pipeline parallelism cannot combine with seq parallelism " \
-            "(v1); it composes with tensor parallelism (stage psum and " \
-            "model psum x tp_scale act on orthogonal axes)"
 
     def fused_clients(ps_weights, model_state, batch, rng_keys, worker_mask):
         """One-gradient client phase for a shard's W client slots. Returns

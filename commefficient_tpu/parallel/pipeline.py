@@ -12,10 +12,16 @@ pipeline, checkpoints, and the HF conversion never see pipelining — only
 How it maps to the TPU/SPMD model:
 
 - the ``n_layer`` transformer blocks are split into ``n_stages`` contiguous
-  ranges; each shard of the ``stage`` axis executes ONLY its range, selected
-  by ``lax.switch`` on ``lax.axis_index`` (an XLA conditional: one branch
-  executes per device at runtime, even though all branches are traced and
-  every shard holds every parameter);
+  ranges; per-layer parameters are STACKED into homogeneous ``(L, ...)``
+  trees and each shard gathers its own range by ``lax.axis_index`` —
+  every shard then runs the SAME uniform loop of block applications over
+  its gathered weights. Uniformity is the collective-safety invariant
+  that lets sequence/expert parallelism compose: ring/Ulysses attention
+  and MoE dispatch issue collectives *inside* the layer loop, and every
+  device must issue the identical collective sequence (branch-dependent
+  collectives deadlock — measured on the CPU backend's ppermute
+  rendezvous, and illegal under SPMD in general). The stage-0 embedding
+  and last-stage heads are collective-free and stay in ``lax.cond``s;
 - the client batch is split into ``n_micro`` microbatches and run on the
   classic GPipe clock: tick ``t`` has stage ``s`` working on microbatch
   ``t - s``; activations hop stage→stage+1 through ``lax.ppermute`` inside
@@ -40,12 +46,34 @@ the exact dense gradient before any compression (federated/worker.py
 forward_grad, federated/rounds.py fused_clients). Every compression mode
 therefore composes with pipelining unchanged.
 
-Tensor parallelism composes (``--pipeline_devices`` with
-``--model_devices``, a clients×stage×model mesh): each stage's blocks
-slice heads/hidden over the ``model`` axis with the usual two psums, and
-the worker reconciles with the stage psum and the model psum × tp_scale
-on orthogonal axes. v1 restrictions (asserted): dense attention only (no
-seq axis), no MoE, float32 or bf16 compute via ``compute_dtype``.
+Compositions (each on its own orthogonal mesh axis, reconciled by the
+worker's psum chain, federated/worker.py forward_grad):
+
+- tensor parallelism (``--model_devices``, clients×stage×model): each
+  stage's blocks slice heads/hidden over the ``model`` axis with the usual
+  two psums; the worker composes the stage psum with the model psum ×
+  tp_scale;
+- sequence parallelism (``--seq_parallel ring|ulysses``,
+  clients×stage×seq): every pipeline buffer carries only the shard's
+  T/nseq slice of the sequence — the ppermute hops shrink by nseq× —
+  while attention runs over the global sequence inside each block
+  (parallel/ring.py / parallel/ulysses.py). The last stage computes
+  token-local loss sums and the seq-masked MC logit exactly like the
+  non-pipelined seq path (losses.make_gpt2_losses seq_axis /
+  models/gpt2.py MC psum), so each (stage, seq) shard's gradient is
+  stage-local AND token-partial, and the worker's stage psum + seq psum
+  (both at scale 1) reassemble the exact dense gradient;
+- Mixture-of-Experts (``--n_experts``/``--expert_devices``,
+  clients×stage×expert): MoE layers keep their Switch MLPs inside their
+  owning stage's blocks; expert-sliced parameter gradients stay disjoint
+  across the expert axis and reconcile with the usual psum × ep_scale,
+  orthogonal to the stage psum. The Switch aux is accumulated
+  stage-masked across the GPipe ticks and reassembled with one stage
+  psum — computed per MICROBATCH (mean over microbatches of per-layer
+  per-token means), the Switch-paper convention for data-parallel
+  replicas, vs the whole-batch mean of the non-pipelined path: equal at
+  ``--pp_microbatches 1``, a different (equally valid) estimator of the
+  same load-balance objective otherwise.
 """
 
 from __future__ import annotations
@@ -107,38 +135,77 @@ def _auto_micro(n_examples: int, n_micro: int) -> int:
 def make_gpt2_pp_losses(model: GPT2DoubleHeads, n_stages: int,
                         n_micro: int = 4, lm_coef: float = 1.0,
                         mc_coef: float = 1.0, axis: str = STAGE_AXIS,
-                        compute_dtype: Optional[Any] = None):
+                        compute_dtype: Optional[Any] = None,
+                        moe_aux_coef: float = 0.0):
     """Pipeline-parallel twin of ``losses.make_gpt2_losses``: identical
     ``(loss_sum, metric_sums, count, model_state)`` contract and identical
     math (per-example token-mean NLL + candidate CE, reference
     gpt2_train.py:55-99), with the forward/backward run on the GPipe
     schedule described in the module docstring. Must be traced inside a
     shard_map binding ``axis`` with ``n_stages`` shards; the batch and
-    params replicated across it."""
-    assert model.attn_impl == "dense", \
-        "pipeline parallelism requires attn_impl='dense' (v1)"
-    assert model.n_experts == 0, \
-        "pipeline parallelism cannot combine with MoE (v1); config.py " \
-        "forbids --n_experts with --pipeline_devices > 1"
+    params replicated across it.
+
+    Composes with the model's other parallel settings (module docstring):
+    ``model.attn_impl`` "ring"/"ulysses" runs sequence-parallel attention
+    over ``model.seq_axis`` (the batch's sequence dims sharded over it,
+    pre-shifted labels under ``lm_labels_shifted``); ``model.model_axis``
+    slices heads/hidden; ``model.n_experts > 0`` gives MoE blocks on the
+    ``moe_every`` pattern, optionally expert-sharded over
+    ``model.expert_axis``, with ``moe_aux_coef`` adding the per-microbatch
+    Switch aux."""
+    sp = model.attn_impl != "dense"
     ranges = pp_layer_ranges(model.n_layer, n_stages)
-    # Tensor parallelism composes: each stage's blocks slice heads/hidden
-    # over model.model_axis (both axes bound in the same shard_map). The
+    L_max = max(hi - lo for lo, hi in ranges)
+    # which global layers carry an MoE MLP (GPT2DoubleHeads.moe_every)
+    is_moe = [model.n_experts > 0
+              and l % model.moe_every == model.moe_every - 1
+              for l in range(model.n_layer)]
+    n_moe_layers = sum(is_moe)
+    if n_moe_layers:
+        # the uniform layer loop needs a stage-independent block TYPE per
+        # loop position: every stage's range must carry the same
+        # dense/MoE pattern (n_layer divisible by n_stages with the range
+        # a multiple of moe_every is the common way to satisfy this)
+        patterns = {tuple(is_moe[lo:hi]) for lo, hi in ranges}
+        assert len(patterns) == 1, (
+            f"MoE pipeline needs every stage to run the same dense/MoE "
+            f"layer pattern (moe_every={model.moe_every}), got "
+            f"{sorted(patterns)} over ranges {ranges}; use n_layer "
+            f"({model.n_layer}) divisible by n_stages ({n_stages}) with "
+            f"the per-stage range a multiple of moe_every")
+    j_is_moe = [is_moe[ranges[0][0] + j] if n_moe_layers else False
+                for j in range(L_max)]
+    # The two Block twins of GPT2DoubleHeads.__call__'s layer loop; the
     # stage-0 embedding and last-stage lm/mc heads below run replicated
-    # across the model axis; the worker's tp_scale mask (1/nm on
-    # replicated-computed params) composes with the stage psum because the
-    # two reconciliations act on orthogonal axes.
-    blk = Block(model.n_embd, model.n_head, model.dropout,
-                model_axis=model.model_axis)
+    # across the model/expert axes, so the worker's tp_scale/ep_scale masks
+    # compose with the stage psum (each reconciliation on its own axis).
+    def _block(moe):
+        return Block(model.n_embd, model.n_head, model.dropout,
+                     attn_impl=model.attn_impl, seq_axis=model.seq_axis,
+                     model_axis=model.model_axis,
+                     n_experts=model.n_experts if moe else 0,
+                     expert_axis=model.expert_axis if moe else None)
+
+    dense_block, moe_block = _block(False), _block(True)
+    # stack indices: layer l is the (dense_before[l])-th dense layer or the
+    # (moe_before[l])-th MoE layer
+    dense_before = np.cumsum([0] + [0 if m else 1 for m in is_moe])
+    moe_before = np.cumsum([0] + [1 if m else 0 for m in is_moe])
     dt = compute_dtype or jnp.float32
 
     def _pipeline(params, batch, rng, train):
         ids = batch["input_ids"]
         assert ids.ndim == 3, \
             f"expected (batch, candidates, seq) input_ids, got {ids.shape}"
-        E0, C, T = ids.shape
+        E0, C, T = ids.shape  # T is the shard-LOCAL sequence slice under sp
         nm = _auto_micro(E0, n_micro)
         me = E0 // nm
         R = me * C  # transformer rows per microbatch
+        want_aux = bool(moe_aux_coef) and n_moe_layers > 0 and train
+        if sp:
+            # distinct dropout masks per seq shard (losses.make_gpt2_losses
+            # does the same fold outside the model)
+            rng = jax.random.fold_in(rng, lax.axis_index(model.seq_axis))
         if compute_dtype is not None:
             params = _cast_tree(params, compute_dtype)
         wte = params["wte"]["embedding"]
@@ -149,86 +216,179 @@ def make_gpt2_pp_losses(model: GPT2DoubleHeads, n_stages: int,
 
         ids_m = mb(ids)
         tt_m = mb(batch["token_type_ids"])
-        lab_m = mb(batch["lm_labels"])
+        # under sp the shift crosses shard boundaries, so it happens
+        # host-side in the collate (same contract as make_gpt2_losses)
+        lab_m = mb(batch["lm_labels_shifted" if sp else "lm_labels"])
         mcid_m = mb(batch["mc_token_ids"])
-        causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+        # ring/ulysses handle global causality internally; the local mask
+        # is only for dense attention
+        causal = None if sp else jnp.tril(jnp.ones((T, T), bool))[None, None]
+        pos0 = lax.axis_index(model.seq_axis) * T if sp else 0
         s_idx = lax.axis_index(axis)
         S = n_stages
 
-        def make_branch(stage_id):
-            lo, hi = ranges[stage_id]
+        # ---- per-stage layer-parameter gather --------------------------
+        # Per-layer params are stacked into homogeneous (n_dense, ...) /
+        # (n_moe, ...) trees and each stage gathers its range ONCE (the
+        # stage index is constant across ticks). Every stage then runs the
+        # SAME L_max-iteration block loop below — the uniformity that keeps
+        # in-loop collectives (ring/ulysses hops, MoE expert psums) legal.
+        dense_ls = [l for l in range(model.n_layer) if not is_moe[l]]
+        moe_ls = [l for l in range(model.n_layer) if is_moe[l]]
 
-            def branch(op):
-                ids_mb, tt_mb, lab_mb, mcid_mb, h_in, rng_mb = op
-                if stage_id == 0:
-                    x = wte[ids_mb.reshape(R, T)] + wpe[jnp.arange(T)][None]
-                    x = x + wte[tt_mb.reshape(R, T)]
-                    x = _dropout(jax.random.fold_in(rng_mb, model.n_layer),
-                                 x, model.dropout, not train)
+        def stack(ls):
+            if not ls:
+                return None
+            return jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *[params[f"h{l}"] for l in ls])
+
+        dense_stack, moe_stack = stack(dense_ls), stack(moe_ls)
+        lo_s = jnp.asarray([lo for lo, _ in ranges])[s_idx]
+        n_loc = jnp.asarray([hi - lo for lo, hi in ranges])[s_idx]
+        d_off = jnp.asarray(dense_before)[lo_s]
+        m_off = jnp.asarray(moe_before)[lo_s]
+
+        def gather(stacked, idx, n_stacked):
+            # clip: stages with fewer than L_max layers gather a dummy row
+            # for the masked-out tail iterations
+            idx = jnp.minimum(idx, n_stacked - 1)
+            return jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, idx, 0,
+                                                   keepdims=False), stacked)
+
+        layer_params = []  # (block_def, gathered_params, global_layer_idx)
+        dj = mj = 0
+        for j in range(L_max):
+            if j_is_moe[j]:
+                layer_params.append(
+                    (moe_block, gather(moe_stack, m_off + mj, len(moe_ls)),
+                     lo_s + j))
+                mj += 1
+            else:
+                layer_params.append(
+                    (dense_block,
+                     gather(dense_stack, d_off + dj, len(dense_ls)),
+                     lo_s + j))
+                dj += 1
+
+        def run_layers(x, rng_mb):
+            """The uniform per-tick block loop; iterations past this
+            stage's range are computed-and-masked (their collectives must
+            still run — see the gather note above)."""
+            aux = jnp.zeros((), jnp.float32)
+            for j, (blk, pj, l_idx) in enumerate(layer_params):
+                rngs = {"dropout": jax.random.fold_in(rng_mb, l_idx)} \
+                    if train else None
+                if want_aux and blk.n_experts > 0:
+                    y, sown = blk.apply({"params": pj}, x, causal,
+                                        not train, rngs=rngs,
+                                        mutable=["moe_losses"])
+                    aux_j = sum(jnp.sum(jnp.asarray(v)) for v in
+                                jax.tree_util.tree_leaves(sown))
                 else:
-                    x = h_in
-                for l in range(lo, hi):
-                    rngs = {"dropout": jax.random.fold_in(rng_mb, l)} \
-                        if train else None
-                    x = blk.apply({"params": params[f"h{l}"]}, x, causal,
-                                  not train, rngs=rngs)
-                if stage_id == S - 1:
-                    x = _layer_norm(params["ln_f"], x)
-                    lm_logits = (x @ wte.T).reshape(me, C, T, -1)
-                    # shift: predict token t+1 from position t
-                    logits = lm_logits[..., :-1, :]
-                    labels = lab_mb[..., 1:]
-                    valid = labels != -1
-                    safe = jnp.where(valid, labels, 0)
-                    lse = jax.nn.logsumexp(logits.astype(jnp.float32), -1)
-                    picked = jnp.take_along_axis(
-                        logits, safe[..., None],
-                        axis=-1)[..., 0].astype(jnp.float32)
-                    tok_nll = (lse - picked) * valid
-                    nll_sum = tok_nll.sum(axis=(-2, -1))
-                    n_valid = valid.sum(axis=(-2, -1)).astype(jnp.float32)
-                    xr = x.reshape(me, C, T, model.n_embd)
-                    cls = jnp.take_along_axis(
-                        xr, mcid_mb[:, :, None, None], axis=2)[:, :, 0]
-                    mc = (cls @ params["mc_head"]["kernel"]
-                          + params["mc_head"]["bias"])[..., 0]
-                    mc = mc.astype(jnp.float32)
-                else:
-                    nll_sum = jnp.zeros((me,), jnp.float32)
-                    n_valid = jnp.zeros((me,), jnp.float32)
-                    mc = jnp.zeros((me, C), jnp.float32)
-                return x, nll_sum, n_valid, mc
+                    y = blk.apply({"params": pj}, x, causal, not train,
+                                  rngs=rngs)
+                    aux_j = jnp.zeros((), jnp.float32)
+                valid = j < n_loc
+                x = jnp.where(valid, y, x)
+                aux = aux + aux_j * valid.astype(jnp.float32)
+            return x, aux
 
-            return branch
-
-        branches = [make_branch(s) for s in range(S)]
         perm = [(i, i + 1) for i in range(S - 1)]
 
         def tick(carry, t):
-            buf, nll_acc, nv_acc, mc_acc = carry
+            buf, nll_acc, nv_acc, mc_acc, aux_acc = carry
             m = jnp.clip(t - s_idx, 0, nm - 1)  # this stage's microbatch
 
             def take(a):
                 return lax.dynamic_index_in_dim(a, m, 0, keepdims=False)
 
+            ids_mb, tt_mb = take(ids_m), take(tt_m)
+            lab_mb, mcid_mb = take(lab_m), take(mcid_m)
             rng_mb = jax.random.fold_in(rng, m)
-            h, nll, nv, mc = lax.switch(
-                s_idx, branches,
-                (take(ids_m), take(tt_m), take(lab_m), take(mcid_m), buf,
-                 rng_mb))
+
+            # embed (stage 0) / forward the hop buffer (collective-free,
+            # so a lax.cond — only stage 0 pays the embedding gathers)
+            def embed(_):
+                x = wte[ids_mb.reshape(R, T)] \
+                    + wpe[pos0 + jnp.arange(T)][None]
+                x = x + wte[tt_mb.reshape(R, T)]
+                return _dropout(jax.random.fold_in(rng_mb, model.n_layer),
+                                x, model.dropout, not train).astype(dt)
+
+            x = lax.cond(s_idx == 0, embed, lambda _: buf, None)
+            x, aux = run_layers(x, rng_mb)
+
+            # lm/mc heads (last stage; collective-free lax.cond keeps the
+            # (R, T, vocab) logits matmul off the earlier stages)
+            def head(xh):
+                xh = _layer_norm(params["ln_f"], xh)
+                lm_logits = (xh @ wte.T).reshape(me, C, T, -1)
+                if sp:
+                    # labels pre-shifted host-side (the shift crosses seq-
+                    # shard boundaries); every local position predicts
+                    logits = lm_logits
+                    labels = lab_mb
+                else:
+                    # shift: predict token t+1 from position t
+                    logits = lm_logits[..., :-1, :]
+                    labels = lab_mb[..., 1:]
+                valid = labels != -1
+                safe = jnp.where(valid, labels, 0)
+                lse = jax.nn.logsumexp(logits.astype(jnp.float32), -1)
+                picked = jnp.take_along_axis(
+                    logits, safe[..., None],
+                    axis=-1)[..., 0].astype(jnp.float32)
+                tok_nll = (lse - picked) * valid
+                nll_s = tok_nll.sum(axis=(-2, -1))
+                nv_s = valid.sum(axis=(-2, -1)).astype(jnp.float32)
+                xr = xh.reshape(me, C, T, model.n_embd)
+                if sp:
+                    # the classification token lives in exactly ONE seq
+                    # shard; the masked local logit keeps every parameter's
+                    # per-shard gradient partial, so the worker's seq psum
+                    # at scale 1 reassembles it (models/gpt2.py MC sp path)
+                    local = mcid_mb - pos0
+                    in_range = (local >= 0) & (local < T)
+                    safe_pos = jnp.clip(local, 0, T - 1)
+                    cls = jnp.take_along_axis(
+                        xr, safe_pos[:, :, None, None], axis=2)[:, :, 0]
+                    mc = (cls @ params["mc_head"]["kernel"]
+                          + params["mc_head"]["bias"])[..., 0]
+                    mc = mc.astype(jnp.float32) \
+                        * in_range.astype(jnp.float32)
+                else:
+                    cls = jnp.take_along_axis(
+                        xr, mcid_mb[:, :, None, None], axis=2)[:, :, 0]
+                    mc = (cls @ params["mc_head"]["kernel"]
+                          + params["mc_head"]["bias"])[..., 0]
+                    mc = mc.astype(jnp.float32)
+                return nll_s, nv_s, mc
+
+            def no_head(_):
+                return (jnp.zeros((me,), jnp.float32),
+                        jnp.zeros((me,), jnp.float32),
+                        jnp.zeros((me, C), jnp.float32))
+
+            nll, nv, mc = lax.cond(s_idx == S - 1, head, no_head, x)
+
             active = ((t >= s_idx) & (t - s_idx < nm))
             w = (active & (s_idx == S - 1)).astype(jnp.float32)
             nll_acc = nll_acc.at[m].add(nll * w)
             nv_acc = nv_acc.at[m].add(nv * w)
             mc_acc = mc_acc.at[m].add(mc * w)
-            buf = lax.ppermute(h * active.astype(h.dtype), axis, perm)
-            return (buf, nll_acc, nv_acc, mc_acc), None
+            # every stage owning MoE layers contributes its aux exactly
+            # once per (stage, microbatch) active pair
+            aux_acc = aux_acc + aux * active.astype(jnp.float32)
+            buf = lax.ppermute(x * active.astype(x.dtype), axis, perm)
+            return (buf, nll_acc, nv_acc, mc_acc, aux_acc), None
 
         init = (jnp.zeros((R, T, model.n_embd), dt),
                 jnp.zeros((nm, me), jnp.float32),
                 jnp.zeros((nm, me), jnp.float32),
-                jnp.zeros((nm, me, C), jnp.float32))
-        (_, nll_acc, nv_acc, mc_acc), _ = lax.scan(
+                jnp.zeros((nm, me, C), jnp.float32),
+                jnp.zeros((), jnp.float32))
+        (_, nll_acc, nv_acc, mc_acc, aux_acc), _ = lax.scan(
             tick, init, jnp.arange(nm + S - 1))
 
         # stage-masked accumulators -> replicated values; identity backward
@@ -236,18 +396,32 @@ def make_gpt2_pp_losses(model: GPT2DoubleHeads, n_stages: int,
         nll_sum = psum_repct(nll_acc, axis).reshape(E0)
         n_valid = psum_repct(nv_acc, axis).reshape(E0)
         mc_logits = psum_repct(mc_acc, axis).reshape(E0, C)
+        if sp:
+            # each seq shard contributed its local tokens' nll and the
+            # owning shard's masked MC logit; one more identity-backward
+            # psum per value replicates them across the seq axis
+            nll_sum = psum_repct(nll_sum, model.seq_axis)
+            n_valid = psum_repct(n_valid, model.seq_axis)
+            mc_logits = psum_repct(mc_logits, model.seq_axis)
         lm_nll = nll_sum / jnp.maximum(n_valid, 1)
-        return lm_nll, mc_logits
+        # per-layer per-microbatch mean (stages hold disjoint layer sets, so
+        # the stage psum sums over all MoE layers; MoEMLP already replicated
+        # each layer's aux across the seq/expert axes internally)
+        aux_total = psum_repct(aux_acc, axis) / max(n_moe_layers * nm, 1)
+        return lm_nll, mc_logits, aux_total
 
     def compute_train(params, model_state, batch, rng, train):
-        lm_nll, mc_logits = _pipeline(params, batch, rng, train)
+        lm_nll, mc_logits, aux_total = _pipeline(params, batch, rng, train)
         mc_ce, _ = _mc_ce_acc(mc_logits, batch["mc_labels"])
         mask = batch["mask"]
         loss_sum = jnp.sum((lm_coef * lm_nll + mc_coef * mc_ce) * mask)
+        if moe_aux_coef and n_moe_layers:
+            # same example-count weighting as losses.make_gpt2_losses
+            loss_sum = loss_sum + moe_aux_coef * aux_total * jnp.sum(mask)
         return loss_sum, (), jnp.sum(mask), model_state
 
     def compute_val(params, model_state, batch, rng, train):
-        lm_nll, mc_logits = _pipeline(params, batch, rng, False)
+        lm_nll, mc_logits, _ = _pipeline(params, batch, rng, False)
         _, acc = _mc_ce_acc(mc_logits, batch["mc_labels"])
         mask = batch["mask"]
         return (jnp.sum(lm_nll * mask), (jnp.sum(acc * mask),),

@@ -239,9 +239,13 @@ def train(argv=None):
 
     # model geometry: tiny when smoke-testing or using the byte fallback
     if args.do_test or os.environ.get("COMMEFFICIENT_TINY_MODEL"):
+        # COMMEFFICIENT_TINY_LAYERS: tests exercising layer-pattern
+        # constraints (e.g. MoE pipeline stage alignment) need more depth
         model = GPT2DoubleHeads(vocab_size=max(512, args.len_tokenizer),
                                 n_positions=args.max_seq_len, n_embd=64,
-                                n_layer=2, n_head=2, **geometry)
+                                n_layer=int(os.environ.get(
+                                    "COMMEFFICIENT_TINY_LAYERS", 2)),
+                                n_head=2, **geometry)
     else:
         model = GPT2DoubleHeads(vocab_size=max(50257 + 5,
                                                args.len_tokenizer),
@@ -271,7 +275,8 @@ def train(argv=None):
         compute_loss_train, compute_loss_val = make_gpt2_pp_losses(
             model, n_stages, n_micro=args.pp_microbatches,
             lm_coef=args.lm_coef, mc_coef=args.mc_coef,
-            compute_dtype=jnp.bfloat16 if args.do_bf16 else None)
+            compute_dtype=jnp.bfloat16 if args.do_bf16 else None,
+            moe_aux_coef=args.moe_aux_coef if args.n_experts else 0.0)
     else:
         compute_loss_train, compute_loss_val = make_gpt2_losses(
             model, args.lm_coef, args.mc_coef,

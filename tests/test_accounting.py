@@ -152,3 +152,44 @@ class TestDownloadRegimeA:
         # every participant charged the same updated-since-init popcount
         nupd = int(np.count_nonzero(np.asarray(fm._updated_since_init)))
         assert download[2] == download[3] == 4.0 * nupd
+
+
+class TestRevertPatternUpperBound:
+    """Quantifies the documented regime-(b) deviation (parity matrix row
+    #26): the reference diffs weight SNAPSHOTS per client
+    (fed_aggregator.py:251-289), so an update that REVERTS a coordinate to
+    the value a stale client last saw charges that client nothing; our
+    device-resident last-changed index charges every TOUCHED coordinate —
+    an upper bound, and this test pins the exact overcharge on a
+    constructed revert sequence."""
+
+    def test_revert_pattern_upper_bound(self):
+        fm = _model(_args())
+        assert not fm._simple_download
+        w0 = jnp.asarray(np.asarray(fm.ps_weights).copy())
+
+        # round 1: clients 0 and 1 download w0 (charged nothing)
+        d1, _ = fm._account_bytes(np.asarray([0, 1]))
+        assert d1[0] == d1[1] == 0.0
+
+        # round 2: the server update perturbs exactly one coordinate;
+        # only client 0 participates and is charged that coordinate
+        fm.ps_weights = w0.at[0].add(1.0)
+        d2, _ = fm._account_bytes(np.asarray([0]))
+        assert d2[0] == 4.0
+
+        # round 3: the update REVERTS the coordinate to w0 — exactly what
+        # client 1 last saw. The reference's snapshot diff charges client
+        # 1 zero bytes; the touched-coordinate index charges the one
+        # reverted coordinate: a 4-byte overcharge, the quantified bound.
+        fm.ps_weights = w0
+        d3, _ = fm._account_bytes(np.asarray([1]))
+        reference_snapshot_diff = 4.0 * np.count_nonzero(
+            np.asarray(fm.ps_weights) != np.asarray(w0))   # = 0
+        assert reference_snapshot_diff == 0.0
+        assert d3[1] == 4.0  # upper bound: 1 touched coordinate
+
+        # client 0 saw the PERTURBED value, so for it the revert is a real
+        # change — both semantics agree on 4 bytes (no overcharge)
+        d4, _ = fm._account_bytes(np.asarray([0]))
+        assert d4[0] == 4.0

@@ -353,12 +353,13 @@ class TestEPWiring:
             parse_args(argv=["--mode", "uncompressed",
                              "--local_momentum", "0",
                              "--n_experts", "3", "--expert_devices", "2"])
-        # the pipeline stage blocks are dense; combining would crash deep
-        # in tracing with a missing mlp_fc param instead of a clear message
-        with pytest.raises(AssertionError, match="pipeline_devices 1"):
-            parse_args(argv=["--mode", "uncompressed",
-                             "--local_momentum", "0",
-                             "--n_experts", "2", "--pipeline_devices", "2"])
+        # MoE composes with pipeline parallelism (clients x stage x expert,
+        # tests/test_pipeline.py TestPPxEP) — the flags must be accepted
+        args = parse_args(argv=["--mode", "uncompressed",
+                                "--local_momentum", "0",
+                                "--n_experts", "2", "--pipeline_devices", "2",
+                                "--expert_devices", "2"])
+        assert args.n_experts == 2 and args.pipeline_devices == 2
 
     def test_mesh_degrade_keeps_expert_divisibility(self):
         """Clamping the expert axis to the device budget must land on a

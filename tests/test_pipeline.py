@@ -1,11 +1,12 @@
 """Pipeline parallelism (GPipe-style `stage` mesh axis, GPT-2 only).
 
 Extension beyond the reference (its only model-scaling lever is more GPUs
-per worker process): transformer layers are split into contiguous stage
-ranges selected per shard by ``lax.switch``; microbatches flow on the GPipe
-clock through ``lax.ppermute`` hops inside one ``lax.scan``; the loss is
-computed on the last stage only and reassembled stage-masked, so a single
-``psum`` over the stage axis reconstitutes the exact dense gradient
+per worker process): per-layer parameters are stacked and each stage shard
+gathers its contiguous range by ``lax.axis_index``, then runs the same
+uniform block loop; microbatches flow on the GPipe clock through
+``lax.ppermute`` hops inside one ``lax.scan``; the loss is computed on the
+last stage only and reassembled stage-masked, so a single ``psum`` over
+the stage axis reconstitutes the exact dense gradient
 (parallel/pipeline.py; federated/worker.py pp_axis).
 """
 
@@ -166,18 +167,22 @@ class TestPPLosses:
         assert np.isfinite(losses["bf16"])
         np.testing.assert_allclose(losses["bf16"], losses["f32"], rtol=0.05)
 
-    def test_rejects_illegal_combos(self):
-        with pytest.raises(AssertionError, match="attn_impl"):
-            make_gpt2_pp_losses(_model().copy(attn_impl="ring"), 2)
-        # tensor parallelism COMPOSES (clients x stage x model,
-        # TestPPxTP); seq parallelism does not
+    def test_accepts_composed_flags(self):
+        """Pipeline composes with seq parallelism (TestPPxSP) and MoE
+        (TestPPxEP); the flags must be accepted. The one structural
+        constraint: MoE pipelines need equal stage ranges aligned to the
+        moe_every pattern (the uniform layer loop's block type per
+        position must be stage-independent)."""
         from commefficient_tpu.config import parse_args
 
-        with pytest.raises(AssertionError, match="seq_parallel none"):
-            parse_args(argv=["--mode", "uncompressed",
-                             "--local_momentum", "0",
-                             "--pipeline_devices", "2",
-                             "--seq_parallel", "ring"])
+        args = parse_args(argv=["--mode", "uncompressed",
+                                "--local_momentum", "0",
+                                "--pipeline_devices", "2",
+                                "--seq_parallel", "ring"])
+        assert args.pipeline_devices == 2 and args.seq_parallel == "ring"
+        with pytest.raises(AssertionError, match="moe_every"):
+            # 3 layers / 2 stages -> uneven ranges; MoE forbids that
+            make_gpt2_pp_losses(_model().copy(n_experts=2), 2)
 
 
 class TestPPRound:
@@ -351,6 +356,254 @@ class TestPPxTP:
             "--pipeline_devices", "2",
             "--pp_microbatches", "2",
             "--model_devices", "2",
+        ])
+        assert np.isfinite(stats["val_nll"])
+        assert np.isfinite(stats["val_ppl"])
+
+
+def _shift_labels(lab):
+    """Host-side pre-shift for the seq-parallel loss contract (see
+    tests/test_tensor_parallel.py)."""
+    shifted = np.full(lab.shape, -1, np.int32)
+    shifted[..., :-1] = np.asarray(lab)[..., 1:]
+    return jnp.asarray(shifted)
+
+
+class TestPPxSP:
+    """Pipeline parallelism COMPOSED with sequence parallelism: the GPipe
+    hops carry T/nseq activation slices while ring/ulysses attention runs
+    over the global sequence inside the uniform layer loop
+    (parallel/pipeline.py module docstring)."""
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_loss_and_grad_match_dense(self, impl):
+        """Pipelined seq-parallel loss and the stage+seq-psum-reassembled
+        gradient match the dense unsharded path exactly."""
+        model = _model()
+        batch = _batch(4, 2)
+        params = _params(model, batch)
+        lt_d, _ = make_gpt2_losses(model)
+        loss_d, _, cnt_d, _ = lt_d(params, {}, batch, jax.random.key(1), True)
+        g_d = jax.grad(
+            lambda p: lt_d(p, {}, batch, jax.random.key(1), True)[0])(params)
+
+        bs = dict(batch)
+        bs["lm_labels_shifted"] = _shift_labels(batch["lm_labels"])
+        del bs["lm_labels"]
+        mesh = make_mesh([("stage", 2), ("seq", 2)])
+        lt_p, _ = make_gpt2_pp_losses(model.copy(attn_impl=impl), 2,
+                                      n_micro=2)
+        seqk = ("input_ids", "token_type_ids", "lm_labels_shifted")
+        from jax.sharding import PartitionSpec
+        bspec = {k: (PartitionSpec(*([None] * (v.ndim - 1)), "seq")
+                     if k in seqk else P()) for k, v in bs.items()}
+
+        def f(p, b):
+            loss, _, cnt, _ = lt_p(p, {}, b, jax.random.key(1), True)
+            g = jax.grad(
+                lambda q: lt_p(q, {}, b, jax.random.key(1), True)[0])(p)
+            g = jax.tree_util.tree_map(
+                lambda x: jax.lax.psum(jax.lax.psum(x, "stage"), "seq"), g)
+            return loss, cnt, g
+
+        loss_p, cnt_p, g_p = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(), bspec), out_specs=P(),
+            check_vma=False))(params, bs)
+        np.testing.assert_allclose(float(loss_p), float(loss_d), rtol=1e-5)
+        assert float(cnt_p) == float(cnt_d)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5),
+            g_p, g_d)
+
+    def test_round_matches_dense(self):
+        """A full federated round over clients x stage x seq equals the
+        dense clients-only round, exact up to float summation order."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices (2 clients x 2 stage x 2 seq)")
+        helper = TestPPRound()
+        mesh_d = make_mesh([("clients", 2)])
+        mesh_3 = make_mesh([("clients", 2), ("stage", 2), ("seq", 2)])
+
+        def run(mesh, pp_axis, losses):
+            steps, flat, ss, cs, batch = helper._build(mesh, pp_axis, losses)
+            out = steps.train_step(flat, ss, cs, {}, batch, 0.1,
+                                   jax.random.key(7))
+            return np.asarray(out[0]), [np.asarray(m) for m in out[4]]
+
+        w_d, m_d = run(mesh_d, None, lambda m: make_gpt2_losses(m))
+        # seq-aware build: mirror TestPPRound._build but with seq_axis set
+        W, B, C = 2, 2, 2
+        model = _model().copy(attn_impl="ring")
+        ids0 = jnp.zeros((1, C, T), jnp.int32)
+        params = _model().init(jax.random.key(0), ids0, token_type_ids=ids0,
+                               mc_token_ids=jnp.zeros((1, C), jnp.int32),
+                               train=False)["params"]
+        flat, unravel = ravel_pytree(params)
+        d = int(flat.size)
+        wcfg = WorkerConfig(mode="uncompressed", error_type="virtual",
+                            num_workers=W, pp_axis="stage", seq_axis="seq")
+        scfg = ServerConfig(mode="uncompressed", error_type="virtual",
+                            grad_size=d, virtual_momentum=0.9)
+        cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d)
+        lt, lv = make_gpt2_pp_losses(model, 2, n_micro=2)
+        steps = build_round_step(lt, lv, lambda f: unravel(f),
+                                 lambda t: ravel_pytree(t)[0], cfg,
+                                 mesh=mesh_3)
+        rng = np.random.RandomState(3)
+        batch = {
+            "input_ids": _ids(4, (W, B, C, T)),
+            "token_type_ids": _ids(5, (W, B, C, T)),
+            "lm_labels_shifted": _shift_labels(_ids(6, (W, B, C, T))),
+            "mc_token_ids": _ids(8, (W, B, C), hi=T),
+            "mc_labels": jnp.asarray(rng.randint(0, C, (W, B)), jnp.int32),
+            "mask": jnp.ones((W, B), jnp.float32),
+            "client_ids": jnp.arange(W, dtype=jnp.int32),
+            "worker_mask": jnp.ones(W, jnp.float32),
+        }
+        ss = init_server_state(scfg, None)
+        cs = init_client_states(4, d, wcfg)
+        out = steps.train_step(jnp.array(flat), ss, cs, {}, batch, 0.1,
+                               jax.random.key(7))
+        w_3 = np.asarray(out[0])
+        m_3 = [np.asarray(m) for m in out[4]]
+        np.testing.assert_allclose(w_3, w_d, atol=2e-5, rtol=2e-5)
+        for a, b in zip(m_3, m_d):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+    def test_gpt2_train_pp_sp_mesh(self, tmp_path, monkeypatch):
+        """CLI end-to-end on the clients x stage x seq mesh:
+        --pipeline_devices 2 --seq_parallel ring --seq_devices 2 with 2
+        workers (8 devices), through the sketch pipeline."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices (2 clients x 2 stage x 2 seq)")
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
+        monkeypatch.setenv("COMMEFFICIENT_TINY_MODEL", "1")
+        monkeypatch.setenv("COMMEFFICIENT_GPT2_SEQ_LEN", "64")
+        import gpt2_train
+
+        stats = gpt2_train.train(argv=[
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "1",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "sketch",
+            "--error_type", "virtual",
+            "--local_momentum", "0",
+            "--k", "64",
+            "--num_cols", "2048",
+            "--num_rows", "3",
+            "--num_blocks", "2",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+            "--pipeline_devices", "2",
+            "--pp_microbatches", "2",
+            "--seq_parallel", "ring",
+            "--seq_devices", "2",
+        ])
+        assert np.isfinite(stats["val_nll"])
+        assert np.isfinite(stats["val_ppl"])
+
+
+class TestPPxEP:
+    """Pipeline parallelism COMPOSED with MoE / expert parallelism: MoE
+    layers keep their Switch MLPs inside their owning stage's blocks; the
+    worker reconciles with the stage psum and the expert psum x ep_scale
+    on orthogonal axes (parallel/pipeline.py module docstring)."""
+
+    V, T, E, L, H = 128, 16, 32, 4, 4  # L=4: equal aligned stage ranges
+
+    def _moe_model(self, **kw):
+        return GPT2DoubleHeads(vocab_size=self.V, n_positions=self.T,
+                               n_embd=self.E, n_layer=self.L, n_head=self.H,
+                               dropout=0.0, n_experts=2, **kw)
+
+    @pytest.mark.parametrize("coef,n_micro", [(0.0, 2), (0.01, 1)])
+    def test_loss_and_grad_match_unsharded_moe(self, coef, n_micro):
+        """Pipelined expert-parallel MoE loss/grad match the unsharded MoE
+        model. With the Switch aux on, parity holds at n_micro=1 (the
+        pipelined aux is a per-microbatch estimator, equal at one
+        microbatch — module docstring)."""
+        import jax.tree_util as jtu
+
+        from commefficient_tpu.parallel.moe import ep_sliced_param
+
+        model = self._moe_model()
+        batch = _batch(4, 2)
+        params = model.init(jax.random.key(0), batch["input_ids"],
+                            token_type_ids=batch["token_type_ids"],
+                            mc_token_ids=batch["mc_token_ids"],
+                            train=False)["params"]
+        lt_d, _ = make_gpt2_losses(model, moe_aux_coef=coef)
+        loss_d, _, _, _ = lt_d(params, {}, batch, jax.random.key(1), True)
+        g_d = jax.grad(
+            lambda p: lt_d(p, {}, batch, jax.random.key(1), True)[0])(params)
+
+        mesh = make_mesh([("stage", 2), ("expert", 2)])
+        lt_p, _ = make_gpt2_pp_losses(model.copy(expert_axis="expert"), 2,
+                                      n_micro=n_micro, moe_aux_coef=coef)
+
+        def f(p, b):
+            loss, _, _, _ = lt_p(p, {}, b, jax.random.key(1), True)
+            g = jax.grad(
+                lambda q: lt_p(q, {}, b, jax.random.key(1), True)[0])(p)
+            ne = jax.lax.psum(1, "expert")
+
+            def rec(path, x):
+                keys = "/".join(str(getattr(q, "key", getattr(q, "idx", q)))
+                                for q in path).lower()
+                scale = 1.0 if ep_sliced_param(keys) else 1.0 / ne
+                return jax.lax.psum(
+                    jax.lax.psum(x, "stage"), "expert") * scale
+
+            return loss, jtu.tree_map_with_path(rec, g)
+
+        loss_p, g_p = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))(params, batch)
+        np.testing.assert_allclose(float(loss_p), float(loss_d), rtol=1e-5)
+        jtu.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5),
+            g_p, g_d)
+
+    def test_gpt2_train_pp_ep_mesh(self, tmp_path, monkeypatch):
+        """CLI end-to-end on the clients x stage x expert mesh:
+        --pipeline_devices 2 --n_experts 2 --expert_devices 2 with 2
+        workers (8 devices), through the sketch pipeline."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices (2 clients x 2 stage x 2 expert)")
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
+        monkeypatch.setenv("COMMEFFICIENT_TINY_MODEL", "1")
+        monkeypatch.setenv("COMMEFFICIENT_GPT2_SEQ_LEN", "64")
+        # 4 layers so the 2 stages share the same dense/MoE pattern
+        monkeypatch.setenv("COMMEFFICIENT_TINY_LAYERS", "4")
+        import gpt2_train
+
+        stats = gpt2_train.train(argv=[
+            "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "persona"),
+            "--num_epochs", "1",
+            "--num_workers", "2",
+            "--local_batch_size", "2",
+            "--valid_batch_size", "2",
+            "--num_candidates", "2",
+            "--mode", "sketch",
+            "--error_type", "virtual",
+            "--local_momentum", "0",
+            "--k", "64",
+            "--num_cols", "2048",
+            "--num_rows", "3",
+            "--num_blocks", "2",
+            "--lr_scale", "0.001",
+            "--seed", "0",
+            "--pipeline_devices", "2",
+            "--pp_microbatches", "2",
+            "--n_experts", "2",
+            "--expert_devices", "2",
         ])
         assert np.isfinite(stats["val_nll"])
         assert np.isfinite(stats["val_ppl"])
