@@ -55,17 +55,24 @@ def _read_leaf_dir(data_dir):
 
 def _synthetic_leaf(seed=0):
     n_clients = int(os.environ.get("COMMEFFICIENT_SYNTHETIC_CLIENTS", 100))
+    # COMMEFFICIENT_SYNTHETIC_SAMPLES: mean samples/client (default 40 →
+    # the historical randint(20, 60)). Real FEMNIST averages ~230
+    # samples/writer over 800k images; scaling this up is how the
+    # sample-count ablation (scripts/femnist_ablation.py) probes the
+    # small-data overfitting regime of the fallback.
+    base = int(os.environ.get("COMMEFFICIENT_SYNTHETIC_SAMPLES", 40))
+    lo, hi = max(1, base // 2), max(2, base * 3 // 2)
     rng = np.random.RandomState(seed)
     protos = rng.rand(62, 28, 28).astype(np.float32)
     train, test = {}, {}
     for c in range(n_clients):
-        n = rng.randint(20, 60)
+        n = rng.randint(lo, hi)
         ys = rng.randint(0, 62, size=n)
         xs = np.clip(protos[ys] * 0.6 + rng.rand(n, 28, 28) * 0.4, 0, 1)
         train[f"synth_{c}"] = {"x": xs.reshape(n, -1).tolist(),
                                "y": ys.tolist()}
     for c in range(max(1, n_clients // 10)):
-        n = rng.randint(20, 60)
+        n = rng.randint(lo, hi)
         ys = rng.randint(0, 62, size=n)
         xs = np.clip(protos[ys] * 0.6 + rng.rand(n, 28, 28) * 0.4, 0, 1)
         test[f"synth_t{c}"] = {"x": xs.reshape(n, -1).tolist(),

@@ -164,3 +164,28 @@ def make_logdir(args) -> str:
         )
     root = getattr(args, "logdir_root", "runs")
     return os.path.join(root, "_".join(parts))
+
+
+def run_cv_recorded(argv, tag, echo=print):
+    """Run ``cv_train.main(argv)`` with every TableLogger row captured.
+
+    Shared harness for the learning-evidence scripts
+    (scripts/learning_fullscale.py, scripts/femnist_ablation.py): records
+    the per-epoch rows the entrypoint would print, echoing each with the
+    run's ``tag``. Restores the real TableLogger even on failure."""
+    import cv_train
+
+    rows = []
+
+    class _Recorder:
+        def append(self, row):
+            rows.append(dict(row))
+            echo(f"[{tag}] {row}")
+
+    orig = cv_train.TableLogger
+    cv_train.TableLogger = _Recorder
+    try:
+        cv_train.main(argv)
+    finally:
+        cv_train.TableLogger = orig
+    return rows

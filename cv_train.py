@@ -207,6 +207,14 @@ def build_model_and_config(args):
         args.num_cols = 10
         args.num_rows = 1
         args.k = 10
+    elif os.environ.get("COMMEFFICIENT_MODEL_CHANNELS"):
+        # explicit ResNet9 widths "prep,l1,l2,l3" — the golden-trajectory
+        # test uses 16,32,64,128 (d ≈ 0.5M: honest geometry where sketch
+        # 5x16k is genuine ~6x compression, not a capacity probe)
+        pre, l1, l2, l3 = (int(x) for x in os.environ[
+            "COMMEFFICIENT_MODEL_CHANNELS"].split(","))
+        model_config = {"channels": (("prep", pre), ("layer1", l1),
+                                     ("layer2", l2), ("layer3", l3))}
     elif os.environ.get("COMMEFFICIENT_TINY_MODEL"):
         # CPU-test scale: keeps e2e runs fast where conv throughput is low
         model_config = {"channels": (("prep", 8), ("layer1", 16),

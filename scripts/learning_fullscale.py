@@ -72,22 +72,12 @@ UNCOMPRESSED = [
 
 
 def run(tag, mode_args):
-    import cv_train
+    from commefficient_tpu.utils import run_cv_recorded
 
-    rows = []
+    def echo(msg):
+        print(msg, flush=True)
 
-    class Recorder:
-        def append(self, row):
-            rows.append(dict(row))
-            print(f"[{tag}] {row}", flush=True)
-
-    orig = cv_train.TableLogger
-    cv_train.TableLogger = Recorder
-    try:
-        cv_train.main(COMMON + mode_args)
-    finally:
-        cv_train.TableLogger = orig
-    return rows
+    return run_cv_recorded(COMMON + mode_args, tag, echo=echo)
 
 
 def main():
