@@ -53,29 +53,75 @@ def _read_leaf_dir(data_dir):
     return data
 
 
+# bump when _synthetic_leaf / _smooth_protos change what they generate:
+# consumers (scripts/femnist_ablation.py) fingerprint their prepared-data
+# cache dirs with it, since FedDataset.prepare keeps existing client files
+SYNTHETIC_GEN_VERSION = 2
+
+
+def _bilinear_upsample(p, size):
+    """(n, h, h) -> (n, size, size) bilinear resize, pure numpy."""
+    n, h, w = p.shape
+    assert h == w, f"square inputs only (the sample grid is shared): {p.shape}"
+    xs = np.linspace(0, h - 1, size)
+    i0 = np.floor(xs).astype(np.int64)
+    i1 = np.minimum(i0 + 1, h - 1)
+    f = (xs - i0).astype(np.float32)
+    rows = p[:, i0, :] * (1 - f)[None, :, None] \
+        + p[:, i1, :] * f[None, :, None]
+    out = rows[:, :, i0] * (1 - f)[None, None, :] \
+        + rows[:, :, i1] * f[None, None, :]
+    return out
+
+
+def _smooth_protos(rng, n_classes=62, size=28, lo_res=7):
+    """Class prototypes that behave like handwriting under the reference's
+    FEMNIST augmentation recipe (RandomCrop/RandomResizedCrop/rotation with
+    white fill, transforms.py): spatially SMOOTH dark strokes on a white
+    background, fading to white at the borders. The original fallback used
+    per-pixel uniform noise as the prototype — resampling augmentation
+    DECORRELATES white noise, so augmented train images carried almost none
+    of the class signal the un-augmented test images carry, and every
+    trained model looked like it memorized (measured: the same sketched run
+    goes from test acc ~0.05 with noise protos to 1.00 with the
+    augmentation stack disabled). Smooth protos preserve class evidence
+    under small shifts/zooms/rotations exactly like real strokes do."""
+    blobs = _bilinear_upsample(
+        rng.rand(n_classes, lo_res, lo_res).astype(np.float32), size)
+    # fade to white background over the outer ~5 px, matching the
+    # augmentation ops' fill=1.0
+    edge = np.minimum(np.arange(size), np.arange(size)[::-1])
+    taper = np.clip(edge / 5.0, 0, 1).astype(np.float32)
+    window = taper[:, None] * taper[None, :]
+    return 1.0 - 0.85 * blobs * window[None]
+
+
 def _synthetic_leaf(seed=0):
     n_clients = int(os.environ.get("COMMEFFICIENT_SYNTHETIC_CLIENTS", 100))
     # COMMEFFICIENT_SYNTHETIC_SAMPLES: mean samples/client (default 40 →
     # the historical randint(20, 60)). Real FEMNIST averages ~230
     # samples/writer over 800k images; scaling this up is how the
     # sample-count ablation (scripts/femnist_ablation.py) probes the
-    # small-data overfitting regime of the fallback.
+    # small-data regime of the fallback.
     base = int(os.environ.get("COMMEFFICIENT_SYNTHETIC_SAMPLES", 40))
     lo, hi = max(1, base // 2), max(2, base * 3 // 2)
     rng = np.random.RandomState(seed)
-    protos = rng.rand(62, 28, 28).astype(np.float32)
+    protos = _smooth_protos(rng)
+
+    def batch(n):
+        ys = rng.randint(0, 62, size=n)
+        xs = np.clip(protos[ys] * 0.8
+                     + rng.rand(n, 28, 28).astype(np.float32) * 0.2, 0, 1)
+        return xs, ys
+
     train, test = {}, {}
     for c in range(n_clients):
-        n = rng.randint(lo, hi)
-        ys = rng.randint(0, 62, size=n)
-        xs = np.clip(protos[ys] * 0.6 + rng.rand(n, 28, 28) * 0.4, 0, 1)
-        train[f"synth_{c}"] = {"x": xs.reshape(n, -1).tolist(),
+        xs, ys = batch(rng.randint(lo, hi))
+        train[f"synth_{c}"] = {"x": xs.reshape(len(ys), -1).tolist(),
                                "y": ys.tolist()}
     for c in range(max(1, n_clients // 10)):
-        n = rng.randint(lo, hi)
-        ys = rng.randint(0, 62, size=n)
-        xs = np.clip(protos[ys] * 0.6 + rng.rand(n, 28, 28) * 0.4, 0, 1)
-        test[f"synth_t{c}"] = {"x": xs.reshape(n, -1).tolist(),
+        xs, ys = batch(rng.randint(lo, hi))
+        test[f"synth_t{c}"] = {"x": xs.reshape(len(ys), -1).tolist(),
                                "y": ys.tolist()}
     return train, test
 

@@ -166,14 +166,20 @@ def make_logdir(args) -> str:
     return os.path.join(root, "_".join(parts))
 
 
-def run_cv_recorded(argv, tag, echo=print):
+def run_cv_recorded(argv, tag, echo=None):
     """Run ``cv_train.main(argv)`` with every TableLogger row captured.
 
     Shared harness for the learning-evidence scripts
     (scripts/learning_fullscale.py, scripts/femnist_ablation.py): records
-    the per-epoch rows the entrypoint would print, echoing each with the
-    run's ``tag``. Restores the real TableLogger even on failure."""
+    the per-epoch rows the entrypoint would print, echoing each (flushed —
+    these sweeps run for hours piped to log files) with the run's ``tag``.
+    Restores the real TableLogger even on failure."""
+    import functools
+
     import cv_train
+
+    if echo is None:
+        echo = functools.partial(print, flush=True)
 
     rows = []
 

@@ -209,8 +209,8 @@ def build_model_and_config(args):
         args.k = 10
     elif os.environ.get("COMMEFFICIENT_MODEL_CHANNELS"):
         # explicit ResNet9 widths "prep,l1,l2,l3" — the golden-trajectory
-        # test uses 16,32,64,128 (d ≈ 0.5M: honest geometry where sketch
-        # 5x16k is genuine ~6x compression, not a capacity probe)
+        # test uses 12,24,48,96 (d = 232,812: honest geometry where sketch
+        # 5x16384 is a genuine 2.84x compression, not a capacity probe)
         pre, l1, l2, l3 = (int(x) for x in os.environ[
             "COMMEFFICIENT_MODEL_CHANNELS"].split(","))
         model_config = {"channels": (("prep", pre), ("layer1", l1),

@@ -43,12 +43,19 @@ def epochs_for(samples: int) -> int:
         return int(os.environ["FEMNIST_EPOCHS"])
     return 16 if samples <= 160 else 12
 
+# FEMNIST_SKETCH_LR: lr sweep hook (non-default values get lr-tagged
+# artifact keys). Diagnosis history for the round-3 "sketched FEMNIST
+# overfits" finding: lr (0.25 vs 0.1) did NOT explain it — the root cause
+# was the old noise-prototype synthetic data decorrelating under the
+# reference's resampling augmentation (see fed_emnist._smooth_protos);
+# with augmentation disabled the same sketched config hit test acc 1.00.
+SKETCH_LR = os.environ.get("FEMNIST_SKETCH_LR", "0.25")
 SKETCH = [
     "--mode", "sketch", "--error_type", "virtual",
     "--k", "4000", "--num_cols", "16384", "--num_rows", "5",
     "--num_blocks", "2",
     "--virtual_momentum", "0.9", "--local_momentum", "0",
-    "--lr_scale", "0.25",
+    "--lr_scale", SKETCH_LR,
 ]
 UNCOMPRESSED = [
     "--mode", "uncompressed", "--error_type", "virtual",
@@ -58,15 +65,20 @@ UNCOMPRESSED = [
 
 
 def run(tag, samples, mode_args):
+    from commefficient_tpu.data_utils.fed_emnist import SYNTHETIC_GEN_VERSION
     from commefficient_tpu.utils import run_cv_recorded
 
     os.environ["COMMEFFICIENT_SYNTHETIC_SAMPLES"] = str(samples)
     ep = epochs_for(samples)
     argv = [
         "--dataset_name", "EMNIST",
-        # samples env is read at dataset PREPARE time: one dir per setting
-        "--dataset_dir", os.path.join(_REPO, "runs",
-                                      f"femnist_ablation_s{samples}"),
+        # samples env is read at dataset PREPARE time: one dir per setting,
+        # fingerprinted by the generator version — FedDataset caches
+        # prepared data, so without the version a resumed sweep after a
+        # generator change would silently train on stale data
+        "--dataset_dir", os.path.join(
+            _REPO, "runs",
+            f"femnist_ablation_g{SYNTHETIC_GEN_VERSION}_s{samples}"),
         "--model", "ResNet9", "--batchnorm",
         "--num_workers", "8",
         "--local_batch_size", "16",
@@ -77,10 +89,7 @@ def run(tag, samples, mode_args):
         # overlap host-side augmentation/assembly with device compute
         "--train_dataloader_workers", "1",
     ] + mode_args
-    def echo(msg):
-        print(msg, flush=True)
-
-    rows = run_cv_recorded(argv, f"{tag} s={samples}", echo=echo)
+    rows = run_cv_recorded(argv, f"{tag} s={samples}")
     # provenance lives WITH each run, so a resumed sweep under different
     # env settings cannot silently mislabel earlier entries
     return {"rows": rows, "samples": samples, "epochs": ep,
@@ -98,6 +107,8 @@ def main():
         for tag, mode_args in (("sketch", SKETCH),
                                ("uncompressed", UNCOMPRESSED)):
             key = f"{tag}_s{samples}"
+            if tag == "sketch" and SKETCH_LR != "0.25":
+                key = f"sketch_lr{SKETCH_LR}_s{samples}"
             if out.get(key):
                 print(f"skip {key}: already recorded", flush=True)
                 continue

@@ -74,10 +74,7 @@ UNCOMPRESSED = [
 def run(tag, mode_args):
     from commefficient_tpu.utils import run_cv_recorded
 
-    def echo(msg):
-        print(msg, flush=True)
-
-    return run_cv_recorded(COMMON + mode_args, tag, echo=echo)
+    return run_cv_recorded(COMMON + mode_args, tag)
 
 
 def main():
