@@ -471,10 +471,11 @@ class TestPPxSP:
         for a, b in zip(m_3, m_d):
             np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
 
-    def test_gpt2_train_pp_sp_mesh(self, tmp_path, monkeypatch):
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_gpt2_train_pp_sp_mesh(self, impl, tmp_path, monkeypatch):
         """CLI end-to-end on the clients x stage x seq mesh:
-        --pipeline_devices 2 --seq_parallel ring --seq_devices 2 with 2
-        workers (8 devices), through the sketch pipeline."""
+        --pipeline_devices 2 --seq_parallel ring|ulysses --seq_devices 2
+        with 2 workers (8 devices), through the sketch pipeline."""
         if len(jax.devices()) < 8:
             pytest.skip("needs 8 devices (2 clients x 2 stage x 2 seq)")
         monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
@@ -501,7 +502,7 @@ class TestPPxSP:
             "--seed", "0",
             "--pipeline_devices", "2",
             "--pp_microbatches", "2",
-            "--seq_parallel", "ring",
+            "--seq_parallel", impl,
             "--seq_devices", "2",
         ])
         assert np.isfinite(stats["val_nll"])
