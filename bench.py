@@ -553,7 +553,7 @@ def run_config_measurement(name: str) -> None:
     flops_per_round = resnet9_train_flops_per_image(
         DEFAULT_CHANNELS, num_classes=num_classes) * LOCAL_BS * W
     tflops = flops_per_round * rounds_per_sec / 1e12
-    print(json.dumps({
+    out = {
         f"{name}_metric": label,
         f"{name}_rounds_per_sec": round(rounds_per_sec, 4),
         f"{name}_vs_baseline": round(rounds_per_sec / base, 4),
@@ -561,7 +561,14 @@ def run_config_measurement(name: str) -> None:
         f"{name}_mfu_bf16": round(tflops * 1e12 / TPU_V5E_BF16_PEAK_FLOPS,
                                   4),
         "platform": jax.default_backend(),
-    }), flush=True)
+    }
+    if base_name in ("BASELINE_C1", "BASELINE_C2"):
+        # c1/c2 anchors are analytic estimates of the reference's A100
+        # throughput (derived FLOP/dispatch arithmetic above), never
+        # measured; flag it so a BENCH artifact reader can tell these
+        # ratios apart from ones against measured baselines
+        out[f"{name}_baseline_estimated"] = True
+    print(json.dumps(out), flush=True)
 
 
 # --------------------------------------------------------------------------
@@ -696,6 +703,17 @@ def _fresh_or_cached_extras(result: dict, run_fresh: bool = True) -> None:
     max_age = float(os.environ.get("BENCH_EXTRAS_MAX_AGE", 12 * 3600))
     extras_out = {}
     cache = _load_extras()
+    head_now = _git_head()
+
+    def _mark_stale(leg, cached):
+        # a cached leg measured at a different commit can silently mix two
+        # code generations into one artifact — make that explicit
+        if cached.get("head") not in (head_now, "unknown", None):
+            _log(f"extra leg {leg}: cached head {cached.get('head')} != "
+                 f"current {head_now} — marking stale_head")
+            extras_out[f"{leg}_stale_head"] = (f"{cached.get('head')} != "
+                                               f"{head_now}")
+
     for leg in _EXTRA_LEGS:
         cached = cache.get(leg)
         cache_ok = cached is not None and "result" in cached
@@ -711,6 +729,7 @@ def _fresh_or_cached_extras(result: dict, run_fresh: bool = True) -> None:
                 extras_out.update(cached["result"])
                 extras_out[f"{leg}_cached"] = (f"{cached['measured_at']} @ "
                                                f"{cached.get('head')}")
+                _mark_stale(leg, cached)
                 continue
         fresh, err = (None, "fresh run disabled") if not run_fresh else (
             _run_leg(leg))
@@ -722,6 +741,7 @@ def _fresh_or_cached_extras(result: dict, run_fresh: bool = True) -> None:
                  f"from {stamp}")
             extras_out.update(cached["result"])
             extras_out[f"{leg}_cached"] = f"{stamp} (fresh: {err})"
+            _mark_stale(leg, cached)
         else:
             extras_out[f"{leg}_error"] = err
     result["extra"] = extras_out

@@ -49,17 +49,26 @@ _LANES = 128
 _SUB = 512              # count-kernel block: (512, 128) i32 = 256 KiB VMEM
 
 
-def _use_pallas_topk() -> bool:
-    """Kill-switch for the Pallas count-pass kernel, OFF by default: it is
-    a bandwidth optimization whose on-chip win over XLA's fused
-    compare-count is decided by measurement (scripts/tpu_measure.py radix
-    probe + A/B); flip COMMEFFICIENT_PALLAS_TOPK=1 once it wins."""
+# Measured crossover (scripts/tpu_measure.py ops, v5e, 2026-08-01): the
+# Pallas count-pass descent wins 37x at the FetchSGD geometry
+# (d=6,568,640: 0.30 ms vs 11.10 ms XLA, outputs bit-equal) but LOSES at
+# the GPT-2 geometry (d=124,444,417: 16.15 ms vs 14.57 ms) — above ~100M
+# the kernel's fixed blocking stops tracking HBM streams. Gate between the
+# two measured points, nearer the win.
+_PALLAS_TOPK_MAX_D = 32 * 1024 * 1024
+
+
+def _use_pallas_topk(d: int) -> bool:
+    """Pallas count-pass kernel: ON by default on TPU below the measured
+    crossover size; COMMEFFICIENT_PALLAS_TOPK=0/1 forces either way."""
     import os
 
     from commefficient_tpu.utils import is_tpu_backend
 
-    return (is_tpu_backend()
-            and os.environ.get("COMMEFFICIENT_PALLAS_TOPK", "0") == "1")
+    force = os.environ.get("COMMEFFICIENT_PALLAS_TOPK")
+    if force is not None:
+        return is_tpu_backend() and force == "1"
+    return is_tpu_backend() and d <= _PALLAS_TOPK_MAX_D
 
 
 @functools.partial(jax.jit, static_argnames=("T", "interpret"))
@@ -175,7 +184,7 @@ def topk(vec: jax.Array, k: int, method: str = "threshold") -> jax.Array:
     Accepts 1-D ``(d,)`` or 2-D ``(rows, d)`` input (row-wise top-k), mirroring
     reference utils.py:246-252.
     """
-    if method == "threshold" and _use_pallas_topk():
+    if method == "threshold" and _use_pallas_topk(vec.shape[-1]):
         f = _topk_threshold_1d_pallas
     else:
         f = {"threshold": _topk_threshold_1d, "sort": _topk_sort_1d}[method]
