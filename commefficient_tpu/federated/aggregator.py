@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from commefficient_tpu.federated.rounds import (
+    ClientStates,
     RoundConfig,
     build_round_step,
     init_client_states,
@@ -242,6 +243,25 @@ class FedModel:
         self.client_states = init_client_states(
             alloc_clients, self.grad_size, wcfg, init_weights=flat,
             sketch=self.sketch, sharding=state_sharding)
+        # Host-placed state cannot be indexed inside the device round step
+        # (XLA memory spaces must match per op): stream the W participating
+        # rows around the unchanged round instead (host_state.RowStreamer,
+        # the reference's touched-rows shared-memory traffic,
+        # fed_aggregator.py:105-129). Host-side compute needs the TPU
+        # backend; on other backends the same row-proxy path runs with the
+        # memory kind degraded (client_state_sharding's documented fallback).
+        self._row_stream = None
+        self._stream_round = None
+        if (self.memory_plan.placement == "host"
+                and (wcfg.has_velocity or wcfg.has_error
+                     or wcfg.do_topk_down)):
+            from commefficient_tpu.federated.host_state import RowStreamer
+            from commefficient_tpu.utils import is_tpu_backend
+
+            self._row_stream = RowStreamer(self.mesh, state_sharding,
+                                           host_compute=is_tpu_backend())
+            print("client state host-offload: streaming "
+                  f"{args.num_workers} rows/round around the device step")
 
         self._round_ctx = None
         # --rng_impl: TPU-first extension (no reference equivalent). The
@@ -350,14 +370,54 @@ class FedModel:
 
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
         lr = self._current_lr()
+        states_in = self.client_states
+        if self._row_stream is not None:
+            # stream the W participating rows to device and run the round
+            # on the W-row proxy (ids remapped to arange(W)); the deltas
+            # scatter back into the big host-resident arrays in step()
+            self._stream_round = self._row_stream.gather(
+                self.client_states, jbatch["client_ids"])
+            jbatch["client_ids"] = jnp.arange(
+                int(jbatch["client_ids"].shape[0]), dtype=jnp.int32)
+            states_in = self._stream_round.proxy
         ctx, self._model_state, metrics = self.steps.client_step(
-            self.ps_weights, self.client_states, self._model_state, jbatch,
+            self.ps_weights, states_in, self._model_state, jbatch,
             lr, self._next_rng())
         self._round_ctx = ctx
 
         *ms, count = (np.asarray(m) for m in metrics)
         valid = wmask > 0
         return [m[valid] for m in ms] + [download, upload]
+
+    def _apply_server(self, server_state, lr):
+        """Phase 2 for FedOptimizer.step(): server rule + state scatter.
+        With host offload the scatter lands on the W-row proxy and only the
+        proxy DELTAS stream back into the big host-resident arrays; the
+        pre-round row values come from the (undonated) round ctx because
+        server_step donates its client_states argument."""
+        ctx = self._round_ctx
+        rng = self._next_rng()
+        if self._row_stream is None:
+            new_ps, new_ss, self.client_states = self.steps.server_step(
+                self.ps_weights, server_state, self.client_states, ctx,
+                lr, rng)
+        else:
+            stream = self._stream_round
+            proxy = stream.proxy
+            old = ClientStates(
+                velocities=(ctx.vel_rows if proxy.velocities is not None
+                            else None),
+                errors=ctx.err_rows if proxy.errors is not None else None,
+                weights=(ctx.stale_rows if proxy.weights is not None
+                         else None))
+            new_ps, new_ss, new_proxy = self.steps.server_step(
+                self.ps_weights, server_state, proxy, ctx, lr, rng)
+            self.client_states = self._row_stream.scatter(
+                self.client_states, stream, old, new_proxy)
+            self._stream_round = None
+        self.ps_weights = new_ps
+        self._round_ctx = None
+        return new_ss
 
     def _call_val(self, batch: dict):
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
@@ -453,12 +513,7 @@ class FedOptimizer:
     def step(self):
         fm = self.fed_model
         assert fm._round_ctx is not None, "call model(batch) before step()"
-        lr = self.get_lr()
-        new_ps, self.server_state, fm.client_states = fm.steps.server_step(
-            fm.ps_weights, self.server_state, fm.client_states, fm._round_ctx,
-            lr, fm._next_rng())
-        fm.ps_weights = new_ps
-        fm._round_ctx = None
+        self.server_state = fm._apply_server(self.server_state, self.get_lr())
 
     def zero_grad(self):
         raise NotImplementedError("call zero_grad() on the model instead")

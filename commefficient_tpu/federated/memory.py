@@ -31,6 +31,7 @@ HBM for client state):
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -101,14 +102,20 @@ def plan_client_state_memory(
     per_device = total // max(n_shards, 1)
 
     if hbm_budget_bytes is None:
-        budget = None
-        try:
-            stats = jax.devices()[0].memory_stats()
-            if stats and "bytes_limit" in stats:
-                budget = stats["bytes_limit"] // 2
-        except Exception:
+        env = os.environ.get("COMMEFFICIENT_STATE_HBM_BUDGET")
+        if env:
+            # explicit override: lets tests and the host-offload script
+            # force the host-placement branch at any state size
+            hbm_budget_bytes = int(env)
+        else:
             budget = None
-        hbm_budget_bytes = budget if budget else 8 * 1024 ** 3
+            try:
+                stats = jax.devices()[0].memory_stats()
+                if stats and "bytes_limit" in stats:
+                    budget = stats["bytes_limit"] // 2
+            except Exception:
+                budget = None
+            hbm_budget_bytes = budget if budget else 8 * 1024 ** 3
 
     placement = "hbm" if per_device <= hbm_budget_bytes else "host"
     return ClientStateMemoryPlan(

@@ -550,13 +550,19 @@ def build_round_step(
             weights=client_states.weights,
         )
         # topk-down: participating clients' stale weights advance to the
-        # weights they actually used this round
+        # weights they actually used this round. wmask gates the delta like
+        # the velocity/error scatters above: a padded slot (the loader pads
+        # with client id 0, wmask 0) or a --client_dropout-zeroed slot must
+        # not advance its client's stale weights — and a padded slot
+        # duplicating a real slot's id would otherwise land the SAME delta
+        # twice (2*used - stale instead of used).
         if wcfg.do_topk_down and cs.weights is not None:
             used = jax.vmap(lambda s: get_new_worker_weights(ps_weights, s,
                                                              wcfg.k, True))(
                 ctx.stale_rows)
-            cs = cs._replace(weights=cs.weights.at[ids].add(used -
-                                                            ctx.stale_rows))
+            w = ctx.wmask.reshape(-1, 1)
+            cs = cs._replace(weights=cs.weights.at[ids].add(
+                (used - ctx.stale_rows) * w))
         return new_ps, new_server_state, cs
 
     # ---- fused round (bench / dry-run path) ----------------------------

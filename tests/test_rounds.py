@@ -354,6 +354,37 @@ class TestPaddedSlotMasking:
         np.testing.assert_array_equal(np.asarray(cs1.velocities[0]),
                                       np.asarray(sentinel))
 
+    def test_topk_down_padding_preserves_client0_stale_weights(self):
+        """Padded slots (duplicate id 0, wmask 0) must not advance client
+        0's stale weights — and must not double a real slot's delta.
+        Regression for the unmasked stale-weight scatter: the four padded
+        slots each landed the same (used - stale) delta, leaving client 0
+        at 4*used - 3*stale instead of its untouched init."""
+        flat, train_step, _, ss, cs = _setup(mode="local_topk", k=2,
+                                             do_topk_down=True)
+        assert cs.weights is not None
+        # stale weights far from the live ps so (used - stale) is nonzero:
+        # without the wmask gate each padded slot lands that delta on
+        # client 0
+        sentinel = jnp.full((D,), 7.0)
+        cs = cs._replace(weights=jnp.tile(sentinel[None, :], (16, 1)))
+        batch = _batch()
+        wm = np.ones(8, np.float32)
+        wm[4:] = 0
+        ids = np.array([1, 2, 3, 4, 0, 0, 0, 0], np.int32)  # 0 = padding
+        mask = np.asarray(batch["mask"]).copy()
+        mask[4:] = 0
+        batch = dict(batch, worker_mask=jnp.asarray(wm),
+                     client_ids=jnp.asarray(ids), mask=jnp.asarray(mask))
+        _, _, cs1, _, _ = train_step(flat, ss, cs, {}, batch, 0.1,
+                                     jax.random.key(0))
+        # non-participating client 0: stale weights untouched
+        np.testing.assert_array_equal(np.asarray(cs1.weights[0]),
+                                      np.asarray(sentinel))
+        # participating client 1: stale weights actually advanced
+        assert np.abs(np.asarray(cs1.weights[1]) -
+                      np.asarray(sentinel)).sum() > 0
+
 
 class TestTrueTopk:
     def test_k_sparse_update(self):
