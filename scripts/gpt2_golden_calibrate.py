@@ -1,0 +1,67 @@
+"""Calibrate the GPT-2 sketched golden-trajectory envelope (VERDICT r4 #4).
+
+Reproduces the docs/learning_curves.md ppl-20.4 configuration (tiny GPT-2,
+byte vocab, synthetic PersonaChat, sketch 3x8192 k=2000, virtual momentum
+0.9) at several epoch budgets on the virtual 8-device CPU mesh, printing
+final val_nll per budget so the in-suite envelope (tests/test_gpt2.py
+TestGoldenTrajectory) can be pinned at the shortest budget that still
+separates cleanly from a collapsed-to-uniform model (nll = ln(257) = 5.549).
+
+Usage: python scripts/gpt2_golden_calibrate.py [epochs ...]
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ["HF_HUB_OFFLINE"] = "1"
+os.environ["TRANSFORMERS_OFFLINE"] = "1"
+os.environ.setdefault("COMMEFFICIENT_TINY_MODEL", "1")
+os.environ.setdefault("COMMEFFICIENT_GPT2_SEQ_LEN", "64")
+os.environ["COMMEFFICIENT_SYNTHETIC_CLIENTS"] = "16"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the site hook pre-registers the axon TPU platform at interpreter startup
+# (env pops are too late); config.update after import wins (tests/conftest.py)
+# — this run must NOT land on (and contend for) the single tunneled chip
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import gpt2_train  # noqa: E402
+
+
+def run(epochs, seed=0):
+    tmp = tempfile.mkdtemp(prefix="gpt2_golden_")
+    stats = gpt2_train.train(argv=[
+        "--dataset_name", "PERSONA",
+        "--dataset_dir", os.path.join(tmp, "persona"),
+        "--num_epochs", str(epochs),
+        "--num_workers", "4",
+        "--local_batch_size", "4",
+        "--valid_batch_size", "4",
+        "--num_candidates", "2",
+        "--mode", "sketch",
+        "--num_rows", "3", "--num_cols", "8192", "--k", "2000",
+        "--error_type", "virtual",
+        "--local_momentum", "0",
+        "--virtual_momentum", "0.9",
+        "--lr_scale", "0.08", "--pivot_epoch", "2",
+        "--seed", str(seed),
+    ])
+    return {k: float(stats[k]) for k in ("val_nll", "val_acc", "val_ppl")}
+
+
+if __name__ == "__main__":
+    budgets = [float(a) for a in sys.argv[1:]] or [3, 6]
+    out = {}
+    for ep in budgets:
+        out[str(ep)] = run(ep)
+        print(f"epochs={ep}: {out[str(ep)]}", flush=True)
+    print(json.dumps(out))
