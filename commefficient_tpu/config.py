@@ -168,9 +168,12 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                              "coefficient for MoE GPT-2 (0 disables; only "
                              "meaningful with --n_experts > 0). The aux is "
                              "the mean over MoE layers of the per-token "
-                             "Switch balance term, weighted per example — "
-                             "the Switch-paper convention, so published "
-                             "values (0.01) transfer directly.")
+                             "Switch balance term, weighted per example. "
+                             "Note the Switch paper SUMS per-layer auxes; "
+                             "the mean here (a deliberate deviation) makes "
+                             "the effective per-layer weight "
+                             "coef/n_moe_layers, so retune rather than "
+                             "assuming published values transfer.")
     # TPU-first extension: dropout/DP mask PRNG. threefry (JAX default) is
     # counter-based ALU work; rbg uses the TPU hardware RNG and is much
     # cheaper at GPT-2 mask volumes. unsafe_rbg additionally relaxes

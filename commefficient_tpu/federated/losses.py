@@ -166,10 +166,14 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
                 {"params": params}, batch["input_ids"],
                 mutable=["moe_losses"], **apply_kwargs)
             leaves = jax.tree_util.tree_leaves(sown.get("moe_losses", {}))
-            # mean over MoE layers (each layer sows one per-token-mean aux):
-            # the Switch-paper convention is a per-layer/per-token mean, so
-            # published coefficients (the 0.01 default) transfer regardless
-            # of how many blocks carry an MoE MLP
+            # mean over MoE layers (each layer sows one per-token-mean aux).
+            # DELIBERATE DEVIATION from the Switch paper, which SUMS the
+            # per-layer auxes (each weighted by alpha = 0.01): the mean
+            # keeps the total aux magnitude depth-independent, so the
+            # effective per-layer coefficient is moe_aux_coef / n_moe_layers
+            # — weaker than Switch's for any model with > 1 MoE layer;
+            # retune the coefficient accordingly rather than assuming
+            # published values transfer
             if leaves:
                 aux_total = sum(jnp.sum(jnp.asarray(leaf))
                                 for leaf in leaves) / len(leaves)
@@ -187,8 +191,9 @@ def make_gpt2_losses(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
             # weighted by the client's valid-example count so the aux enters
             # the cross-client aggregation exactly like the per-example CE
             # terms (the round divides by the summed mask); with the
-            # per-layer mean above the effective coefficient is then the
-            # Switch convention independent of depth and batch size
+            # per-layer mean above the aux stays depth- and batch-size-
+            # independent (per-layer weight = moe_aux_coef / n_moe_layers,
+            # see the deviation note at the mean)
             loss_sum = loss_sum + moe_aux_coef * aux_total * jnp.sum(mask)
         return loss_sum, (), jnp.sum(mask), model_state
 

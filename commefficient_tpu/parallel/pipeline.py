@@ -70,10 +70,11 @@ worker's psum chain, federated/worker.py forward_grad):
   orthogonal to the stage psum. The Switch aux is accumulated
   stage-masked across the GPipe ticks and reassembled with one stage
   psum — computed per MICROBATCH (mean over microbatches of per-layer
-  per-token means), the Switch-paper convention for data-parallel
-  replicas, vs the whole-batch mean of the non-pipelined path: equal at
-  ``--pp_microbatches 1``, a different (equally valid) estimator of the
-  same load-balance objective otherwise.
+  per-token means) vs the whole-batch mean of the non-pipelined path:
+  equal at ``--pp_microbatches 1``, a different (equally valid) estimator
+  of the same load-balance objective otherwise. Both paths share the
+  mean-over-layers normalization, a deliberate deviation from the Switch
+  paper's per-layer SUM (see losses.py).
 """
 
 from __future__ import annotations
