@@ -88,15 +88,45 @@ def main():
         # (set COMMEFFICIENT_LEARNING_ALLOW_CPU=1 to override)
         sys.exit("learning_fullscale: backend is not a TPU; refusing "
                  "the full-scale run on CPU")
-    out = {"epochs": EPOCHS,
-           "per_class": os.environ["COMMEFFICIENT_SYNTHETIC_PER_CLASS"],
-           "backend": jax.default_backend()}
-    for tag, mode_args in (("uncompressed", UNCOMPRESSED),
-                           ("sketch", SKETCH)):
+    path = os.path.join(_REPO, "docs", "learning_fullscale.json")
+    geometry = {"epochs": EPOCHS, "tiny": TINY,
+                "per_class": os.environ["COMMEFFICIENT_SYNTHETIC_PER_CLASS"]}
+    out = dict(geometry, backend=jax.default_backend())
+    # per-leg resume: a window kill mid-leg keeps every completed leg (one
+    # ~65-min leg per mode at d=6.5M on the tunneled chip — the whole run
+    # does not fit one 90-min batch window). Sketch runs FIRST: it is the
+    # leg the evidence needs; uncompressed is the anchor. Legs resume only
+    # from a run of the SAME geometry (a LEARN_TINY smoke artifact must
+    # never be kept as full-scale evidence).
+    prev = None
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+        except json.JSONDecodeError:
+            print("previous artifact unreadable; re-running all legs",
+                  flush=True)
+    if prev is not None:
+        if all(prev.get(k) == v for k, v in geometry.items()):
+            for tag in ("sketch", "uncompressed"):
+                if prev.get(tag):
+                    out[tag] = prev[tag]
+                    print(f"leg {tag}: kept from previous run "
+                          f"({len(prev[tag])} rows)", flush=True)
+        else:
+            prev_geo = {k: prev.get(k) for k in geometry}
+            print(f"previous artifact geometry {prev_geo} != current "
+                  f"{geometry}; re-running all legs", flush=True)
+    for tag, mode_args in (("sketch", SKETCH),
+                           ("uncompressed", UNCOMPRESSED)):
+        if out.get(tag):
+            continue
         out[tag] = run(tag, mode_args)
-        path = os.path.join(_REPO, "docs", "learning_fullscale.json")
-        with open(path, "w") as f:
+        # atomic: a window kill during the write must not destroy the
+        # completed legs the resume exists to keep
+        with open(path + ".tmp", "w") as f:
             json.dump(out, f, indent=1)
+        os.replace(path + ".tmp", path)
         print(f"wrote {path} after {tag}", flush=True)
 
 

@@ -1,0 +1,124 @@
+"""Chip-independent learning rung between d=232k and d=6.5M (VERDICT r4 #3).
+
+The committed learning ladder tops out at d = 232,812 (2.84x compression,
+the in-suite golden pin); the full FetchSGD geometry (d = 6.5M) is
+chip-gated. This script runs the same FetchSGD recipe (reference
+utils.py:142-162 semantics) at an intermediate HONEST geometry on the
+virtual 8-device CPU mesh — ResNet9 at 24/48/96/192 channels
+(d = 911,754), sketch 5x65536 = 327,680 cells, a genuine **2.8x
+compression** with k = 8000 — so the compression-at-scale story no longer
+rests on a single point plus a chip-gated run.
+
+It also re-runs the two single-seed round-4 headline rows at a second seed
+(VERDICT r4 weak #8): 5.7x@24ep and non-IID@40ep at d = 232,812.
+
+Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/learning_midscale.py [legs...]
+Legs: mid_sketch mid_uncompressed seed0_5p7 seed1_5p7 seed0_noniid
+seed1_noniid (default: all). Appends each completed leg to
+docs/learning_midscale.json, so an interrupted sweep resumes by re-running
+with the remaining legs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "64")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+OUT = os.path.join(_REPO, "docs", "learning_midscale.json")
+
+# d = 911,754 at 24/48/96/192 channels; 5x65536 cells = 2.78x compression
+MID_CHANNELS = "24,48,96,192"
+GOLDEN_CHANNELS = "12,24,48,96"  # d = 232,812 (the round-4 headline rows)
+
+
+def common(channels, epochs, pivot, lr, seed):
+    os.environ["COMMEFFICIENT_MODEL_CHANNELS"] = channels
+    return [
+        "--dataset_name", "CIFAR10",
+        "--dataset_dir", os.path.join(_REPO, "runs", "learn_midscale_data"),
+        "--model", "ResNet9", "--batchnorm",
+        "--num_workers", "8", "--num_devices", "8",
+        "--local_batch_size", "16",
+        "--valid_batch_size", "50",
+        "--num_epochs", str(epochs), "--pivot_epoch", str(pivot),
+        "--lr_scale", str(lr),
+        "--local_momentum", "0",
+        "--seed", str(seed),
+    ]
+
+
+SKETCH_MID = ["--mode", "sketch", "--error_type", "virtual",
+              "--k", "8000", "--num_cols", "65536", "--num_rows", "5",
+              "--num_blocks", "4", "--virtual_momentum", "0.9"]
+UNCOMP = ["--mode", "uncompressed", "--error_type", "virtual",
+          "--virtual_momentum", "0.9"]
+# the round-4 headline configs, re-run at seed 1 (docs/learning_curves.md)
+SKETCH_5P7 = ["--mode", "sketch", "--error_type", "virtual",
+              "--k", "2000", "--num_cols", "8192", "--num_rows", "5",
+              "--num_blocks", "2", "--virtual_momentum", "0.9"]
+SKETCH_NONIID = ["--mode", "sketch", "--error_type", "virtual",
+                 "--k", "3000", "--num_cols", "16384", "--num_rows", "5",
+                 "--num_blocks", "2", "--virtual_momentum", "0.9"]
+
+LEGS = {
+    # d=912k at genuine 2.78x: 20 epochs, golden-recipe lr shape
+    "mid_sketch": (MID_CHANNELS, 20, 3, 0.3, 0,
+                   ["--iid", "--num_clients", "16"], SKETCH_MID),
+    "mid_uncompressed": (MID_CHANNELS, 10, 2, 0.15, 0,
+                         ["--iid", "--num_clients", "16"], UNCOMP),
+    # round-4 headline rows as SELF-CONSISTENT seed pairs: both seeds run
+    # under this declared recipe (the round-4 one-offs did not record
+    # lr/pivot), so seed-0 both re-validates the documented accuracy band
+    # and anchors the pair
+    "seed0_5p7": (GOLDEN_CHANNELS, 24, 2, 0.3, 0,
+                  ["--iid", "--num_clients", "16"], SKETCH_5P7),
+    "seed1_5p7": (GOLDEN_CHANNELS, 24, 2, 0.3, 1,
+                  ["--iid", "--num_clients", "16"], SKETCH_5P7),
+    "seed0_noniid": (GOLDEN_CHANNELS, 40, 5, 0.3, 0,
+                     ["--num_clients", "10"], SKETCH_NONIID),
+    "seed1_noniid": (GOLDEN_CHANNELS, 40, 5, 0.3, 1,
+                     ["--num_clients", "10"], SKETCH_NONIID),
+}
+
+
+def main():
+    from commefficient_tpu.utils import run_cv_recorded
+
+    legs = sys.argv[1:] or list(LEGS)
+    results = {}
+    if os.path.exists(OUT):
+        try:
+            with open(OUT) as f:
+                results = json.load(f)
+        except json.JSONDecodeError:
+            print("previous artifact unreadable; starting fresh", flush=True)
+    for leg in legs:
+        channels, epochs, pivot, lr, seed, extra, mode = LEGS[leg]
+        argv = common(channels, epochs, pivot, lr, seed) + extra + mode
+        print(f"=== {leg}: channels {channels} epochs {epochs} "
+              f"seed {seed} ===", flush=True)
+        rows = run_cv_recorded(argv, leg)
+        results[leg] = {"channels": channels, "epochs": epochs,
+                        "seed": seed, "argv": argv, "rows": rows}
+        # atomic: an interrupt during the write must not destroy
+        # previously completed legs
+        with open(OUT + ".tmp", "w") as f:
+            json.dump(results, f, indent=1)
+        os.replace(OUT + ".tmp", OUT)
+        print(f"leg {leg} done -> {OUT}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
