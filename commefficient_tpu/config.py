@@ -163,6 +163,18 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--expert_devices", type=int, default=1,
                         help="Size of the `expert` (expert-parallel) mesh "
                              "axis for GPT-2 MoE (1 disables).")
+    parser.add_argument("--moe_dispatch", choices=["dense", "sparse"],
+                        default="dense",
+                        help="MoE token dispatch: 'dense' evaluates every "
+                             "expert on every token (no drops, max FLOPs); "
+                             "'sparse' is GShard/Switch capacity-factor "
+                             "dispatch — each expert processes at most "
+                             "round(capacity_factor*N/E) tokens, overflow "
+                             "tokens skip the MoE layer (residual "
+                             "passthrough).")
+    parser.add_argument("--moe_capacity_factor", type=float, default=1.25,
+                        help="Per-expert token capacity multiplier for "
+                             "--moe_dispatch sparse.")
     parser.add_argument("--moe_aux_coef", type=float, default=0.01,
                         help="Switch load-balancing auxiliary loss "
                              "coefficient for MoE GPT-2 (0 disables; only "

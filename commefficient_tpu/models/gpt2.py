@@ -138,6 +138,8 @@ class Block(nn.Module):
     # the experts over that mesh axis (expert parallelism).
     n_experts: int = 0
     expert_axis: Optional[str] = None
+    moe_dispatch: str = "dense"
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, mask, deterministic: bool):
@@ -191,6 +193,8 @@ class Block(nn.Module):
             h = MoEMLP(C, self.n_experts, expert_axis=self.expert_axis,
                        seq_axis=(self.seq_axis
                                  if self.attn_impl != "dense" else None),
+                       dispatch=self.moe_dispatch,
+                       capacity_factor=self.moe_capacity_factor,
                        name="moe")(h)
         else:
             h = TPDense(4 * C, self.model_axis, mode="col",
@@ -241,6 +245,8 @@ class GPT2DoubleHeads(nn.Module):
     n_experts: int = 0
     moe_every: int = 2
     expert_axis: Optional[str] = None
+    moe_dispatch: str = "dense"
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, mc_token_ids=None,
@@ -304,6 +310,8 @@ class GPT2DoubleHeads(nn.Module):
                       model_axis=self.model_axis,
                       n_experts=self.n_experts if use_moe else 0,
                       expert_axis=self.expert_axis if use_moe else None,
+                      moe_dispatch=self.moe_dispatch,
+                      moe_capacity_factor=self.moe_capacity_factor,
                       name=f"h{i}")(x, mask, deterministic=not train)
         x = nn.LayerNorm(epsilon=1e-5, name="ln_f")(x)
 

@@ -9,12 +9,22 @@ sparsely-activated MLP whose experts shard across TPU cores:
   every expert; each token is combined with its argmax expert's output,
   weighted by that expert's softmax probability (so the router receives
   gradient through the selected probability).
-- **Dispatch**: dense ("einsum dispatch") — every expert evaluates all
-  tokens and the combine weights zero the non-routed ones. No token
-  dropping, no capacity factor, and the per-expert work is one big batched
-  einsum the MXU tiles well. With expert parallelism each shard only
-  evaluates its ``E/ne`` local experts, so per-shard FLOPs scale down
-  1/ne exactly like sparse dispatch would.
+- **Dispatch** (``dispatch=``): two modes.
+  ``dense`` (default) — every expert evaluates all tokens and the combine
+  weights zero the non-routed ones. No token dropping, no capacity
+  factor, one big batched einsum the MXU tiles well — but every token
+  pays all ``E/ne`` local experts' MLP FLOPs.
+  ``sparse`` — GShard/Switch capacity-factor dispatch: each expert
+  processes only the tokens argmax-routed to it, up to a static capacity
+  ``Cap = round(capacity_factor * N / E)`` per expert; overflow tokens
+  are DROPPED from the MoE output (their residual stream passes through
+  unchanged, the Switch semantics). Tokens move through one-hot dispatch
+  matmuls (the standard TPU formulation: static shapes, MXU-friendly),
+  cutting expert-MLP FLOPs by ``E / capacity_factor`` at the cost of the
+  two ``N x (E*Cap) x C`` dispatch/combine einsums. At ``capacity_factor
+  >= E`` no token can drop and the output equals dense dispatch exactly
+  (same selected-expert outputs and gates) — the parity contract
+  ``tests/test_moe.py`` pins.
 - **Expert parallelism** (``expert_axis``): parameters stay FULL-SHAPE and
   replicated — identical tree/layout whether or not the mesh has an
   ``expert`` axis — so the federated flat vector, compression, and
@@ -29,10 +39,9 @@ sparsely-activated MLP whose experts shard across TPU cores:
 
 The Switch auxiliary load-balancing loss (E·Σ f·P) is sown into the
 ``moe_losses`` collection per MoE layer and added to the training loss by
-``losses.make_gpt2_losses`` when ``--moe_aux_coef`` > 0 (dense dispatch
-makes imbalance a routing-quality concern rather than a compute-skew one,
-so the aux is optional). Documented deviation from production MoE stacks:
-no capacity-factor token dropping.
+``losses.make_gpt2_losses`` when ``--moe_aux_coef`` > 0 (under dense
+dispatch imbalance is a routing-quality concern; under sparse dispatch it
+additionally controls the overflow-drop rate, so keep it on there).
 """
 
 from __future__ import annotations
@@ -78,9 +87,17 @@ class MoEMLP(nn.Module):
     # experts; the two reconciliations (seq psum at scale 1, expert psum
     # x ep_scale) act on orthogonal axes.
     seq_axis: Optional[str] = None
+    # "dense" | "sparse" — see module docstring. Under seq parallelism the
+    # sparse capacity is per seq shard (cf * N_local / E): a different
+    # (equally valid) drop rule than global capacity, needing no
+    # cross-shard communication.
+    dispatch: str = "dense"
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x):
+        assert self.dispatch in ("dense", "sparse"), \
+            f"unknown dispatch {self.dispatch!r}"
         # x: (B, T, C)
         C, E = self.n_embd, self.n_experts
         router = self.param("router", nn.initializers.normal(0.02), (C, E))
@@ -156,13 +173,17 @@ class MoEMLP(nn.Module):
             aux = psum_repct(aux, self.expert_axis)
         self.sow("moe_losses", "aux", aux)
 
-        # dense dispatch over the shard's local experts: (E_loc, B, T, ·)
-        h = jnp.einsum("btc,ecf->ebtf", x, sl(w_fc)) \
-            + sl(b_fc)[:, None, None, :]
-        h = nn.gelu(h, approximate=True)
-        y = jnp.einsum("ebtf,efc->ebtc", h, sl(w_proj)) \
-            + sl(b_proj)[:, None, None, :]
-        out = jnp.einsum("bte,ebtc->btc", sl(combine, axis=2), y)
+        if self.dispatch == "sparse":
+            out = self._sparse_dispatch(x, top, combine, sl,
+                                        (w_fc, b_fc, w_proj, b_proj))
+        else:
+            # dense dispatch over the shard's local experts: (E_loc,B,T,·)
+            h = jnp.einsum("btc,ecf->ebtf", x, sl(w_fc)) \
+                + sl(b_fc)[:, None, None, :]
+            h = nn.gelu(h, approximate=True)
+            y = jnp.einsum("ebtf,efc->ebtc", h, sl(w_proj)) \
+                + sl(b_proj)[:, None, None, :]
+            out = jnp.einsum("bte,ebtc->btc", sl(combine, axis=2), y)
         if self.expert_axis is not None:
             # g operator: psum fwd (partial combines -> full MoE output),
             # identity bwd (the output cotangent is replicated)
@@ -170,3 +191,38 @@ class MoEMLP(nn.Module):
 
             out = psum_repct(out, self.expert_axis)
         return out
+
+    def _sparse_dispatch(self, x, top, combine, sl, params):
+        """Capacity-factor dispatch: route each token to its argmax
+        expert's queue slot, process only the ``Cap`` queued tokens per
+        expert, and combine back gated by the selected probability.
+        Overflow tokens (queue position >= Cap) get an all-zero dispatch
+        row and fall out of the MoE output (residual passthrough)."""
+        w_fc, b_fc, w_proj, b_proj = params
+        B, T, C = x.shape
+        E = self.n_experts
+        N = B * T
+        cap = max(1, int(round(self.capacity_factor * N / E)))
+        xf = x.reshape(N, C)
+        sel = top.reshape(N)                                     # (N,)
+        # queue position of each token within its expert, in token order
+        ohs = jax.nn.one_hot(sel, E, dtype=jnp.int32)            # (N, E)
+        pos = jnp.sum((jnp.cumsum(ohs, axis=0) - 1) * ohs, axis=1)
+        # one_hot of an out-of-range position is an all-zero row: tokens
+        # beyond capacity vanish from D with no explicit mask
+        de = jax.nn.one_hot(sel, E, dtype=x.dtype)               # (N, E)
+        dp = jax.nn.one_hot(pos, cap, dtype=x.dtype)             # (N, Cap)
+        d = de[:, :, None] * dp[:, None, :]                      # (N,E,Cap)
+        # local expert slice of the dispatch tensor (same e0 as sl())
+        d_loc = sl(jnp.moveaxis(d, 1, 0))                        # (E_loc,N,Cap)
+        xin = jnp.einsum("enp,nc->epc", d_loc, xf)               # (E_loc,Cap,C)
+        h = jnp.einsum("epc,ecf->epf", xin, sl(w_fc)) \
+            + sl(b_fc)[:, None, :]
+        h = nn.gelu(h, approximate=True)
+        y = jnp.einsum("epf,efc->epc", h, sl(w_proj)) \
+            + sl(b_proj)[:, None, :]
+        # gate = the selected expert's probability (combine rows are
+        # one-hot x prob, so the row-sum is exactly that scalar)
+        gate = jnp.sum(combine, axis=-1).reshape(N, 1)           # (N, 1)
+        out = jnp.einsum("enp,epc->nc", d_loc, y) * gate
+        return out.reshape(B, T, C)

@@ -185,7 +185,9 @@ def make_gpt2_pp_losses(model: GPT2DoubleHeads, n_stages: int,
                      attn_impl=model.attn_impl, seq_axis=model.seq_axis,
                      model_axis=model.model_axis,
                      n_experts=model.n_experts if moe else 0,
-                     expert_axis=model.expert_axis if moe else None)
+                     expert_axis=model.expert_axis if moe else None,
+                     moe_dispatch=model.moe_dispatch,
+                     moe_capacity_factor=model.moe_capacity_factor)
 
     dense_block, moe_block = _block(False), _block(True)
     # stack indices: layer l is the (dense_before[l])-th dense layer or the

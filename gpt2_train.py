@@ -234,6 +234,8 @@ def train(argv=None):
         # MoE MLP; with --expert_devices the experts shard over the
         # `expert` mesh axis (parallel/moe.py)
         geometry["n_experts"] = args.n_experts
+        geometry["moe_dispatch"] = args.moe_dispatch
+        geometry["moe_capacity_factor"] = args.moe_capacity_factor
         if ep:
             geometry["expert_axis"] = "expert"
 

@@ -163,6 +163,95 @@ class TestMoEMLP:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.parametrize("ne", [2, 4])
+    def test_sparse_dispatch_matches_dense_at_full_capacity(self, ne):
+        """VERDICT r4 #8 parity contract: at capacity_factor >= E no token
+        can drop, so sparse (capacity) dispatch must equal dense dispatch
+        — unsharded AND expert-sharded."""
+        C, nexp = 8, 4
+        dense = MoEMLP(C, nexp)
+        sparse = MoEMLP(C, nexp, dispatch="sparse",
+                        capacity_factor=float(nexp))
+        x = jnp.asarray(np.random.RandomState(4).randn(2, 8, C), jnp.float32)
+        params = dense.init(jax.random.key(3), x)["params"]
+        ref = dense.apply({"params": params}, x)
+        got = sparse.apply({"params": params}, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+        sharded = MoEMLP(C, nexp, dispatch="sparse",
+                         capacity_factor=float(nexp), expert_axis="expert")
+        mesh = make_mesh([("expert", ne)])
+        got_ep = jax.jit(shard_map(
+            lambda p, xx: sharded.apply({"params": p}, xx), mesh=mesh,
+            in_specs=(P(), P()), out_specs=P(), check_vma=False))(params, x)
+        np.testing.assert_allclose(np.asarray(got_ep), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_sparse_dispatch_drops_overflow_tokens(self):
+        """At a tiny capacity, an expert processes only its first Cap
+        routed tokens (token order); every dropped token's MoE output is
+        exactly zero (residual passthrough at the Block level)."""
+        C, nexp = 8, 2
+        # route everything to expert 0 via a rigged router: a real bias on
+        # column 0, so the routing does not rest on argmax tie-breaking
+        sparse = MoEMLP(C, nexp, dispatch="sparse", capacity_factor=0.25)
+        x = jnp.asarray(np.abs(np.random.RandomState(7).randn(1, 8, C)),
+                        jnp.float32)
+        params = sparse.init(jax.random.key(8), x)["params"]
+        router = np.zeros_like(np.asarray(params["router"]))
+        router[:, 0] = 1.0  # positive inputs -> column 0 logit dominates
+        params = dict(params, router=jnp.asarray(router))
+        out = sparse.apply({"params": params}, x)
+        # all 8 tokens routed to expert 0; Cap = round(0.25*8/2) = 1 ->
+        # only the first token in order survives
+        outn = np.asarray(out)[0]
+        assert np.abs(outn[0]).sum() > 0
+        np.testing.assert_array_equal(outn[1:], 0.0)
+
+    def test_sparse_dispatch_gradients_flow(self):
+        """Router and expert weights receive gradients through the sparse
+        path (the dispatch mask is constant, the gate probability is not)."""
+        C, nexp = 8, 4
+        sparse = MoEMLP(C, nexp, dispatch="sparse",
+                        capacity_factor=float(nexp))
+        x = jnp.asarray(np.random.RandomState(11).randn(2, 4, C),
+                        jnp.float32)
+        params = sparse.init(jax.random.key(12), x)["params"]
+
+        def loss(p):
+            return jnp.sum(sparse.apply({"params": p}, x) ** 2)
+
+        g = jax.grad(loss)(params)
+        assert float(jnp.abs(g["router"]).sum()) > 0
+        assert float(jnp.abs(g["w_fc"]).sum()) > 0
+        assert float(jnp.abs(g["w_proj"]).sum()) > 0
+
+    def test_sparse_dispatch_cuts_compiled_flops(self):
+        """The measured FLOP reduction the stretch goal asks for: XLA's
+        compiled cost analysis of the sparse forward at capacity_factor
+        1.0 is well below the dense forward's at E=8 (dense pays all E
+        experts per token; sparse pays ~1 plus the dispatch einsums)."""
+        C, nexp = 64, 8
+        x = jnp.asarray(np.random.RandomState(13).randn(4, 64, C),
+                        jnp.float32)
+        dense = MoEMLP(C, nexp)
+        sparse = MoEMLP(C, nexp, dispatch="sparse", capacity_factor=1.0)
+        params = dense.init(jax.random.key(14), x)["params"]
+
+        def flops(mod):
+            comp = (jax.jit(lambda p, xx: mod.apply({"params": p}, xx))
+                    .lower(params, x).compile())
+            ca = comp.cost_analysis()
+            analysis = ca if isinstance(ca, dict) else ca[0]
+            return float(analysis["flops"])
+
+        f_dense, f_sparse = flops(dense), flops(sparse)
+        # at E=8, C=64, N=256: dense expert compute dominates; sparse
+        # should cut total compiled FLOPs by >2x even counting the
+        # dispatch/combine einsums
+        assert f_sparse < f_dense / 2, (f_dense, f_sparse)
+
     def test_ep_sliced_param_predicate(self):
         assert ep_sliced_param("h1/moe/w_fc")
         assert ep_sliced_param("h1/moe/b_proj")
@@ -417,11 +506,13 @@ class TestEPWiring:
 
 
 class TestEPEndToEnd:
-    def test_gpt2_train_expert_parallel(self, tmp_path, monkeypatch):
+    @pytest.mark.parametrize("dispatch", ["dense", "sparse"])
+    def test_gpt2_train_expert_parallel(self, tmp_path, monkeypatch,
+                                        dispatch):
         """--n_experts/--expert_devices runs the full train+val loop with
         experts sharded over a 2-wide `expert` mesh axis (the math is
         pinned above; this pins the CLI wiring end-to-end incl. the sketch
-        pipeline on the reconciled gradient)."""
+        pipeline on the reconciled gradient), for both dispatch modes."""
         if len(jax.devices()) < 4:
             pytest.skip("needs a 4-device mesh (2 clients x 2 expert)")
         monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_CLIENTS", "8")
@@ -446,6 +537,7 @@ class TestEPEndToEnd:
             "--seed", "0",
             "--n_experts", "2",
             "--expert_devices", "2",
+            "--moe_dispatch", dispatch,
         ])
         assert np.isfinite(stats["val_nll"])
         assert np.isfinite(stats["val_ppl"])
