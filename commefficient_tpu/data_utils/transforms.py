@@ -118,28 +118,36 @@ def _resize_bilinear(img, out_h, out_w):
             + c * wy * (1 - wx) + d * wy * wx).astype(img.dtype)
 
 
+def _draw_resized_crop_box(h, w, scale, ratio):
+    """The RandomResizedCrop box draw (10-try rejection sampling, center
+    fallback) as a shared helper: the per-op stack and the fused native
+    stack MUST consume np.random in this exact order to stay batch-
+    identical under one seed."""
+    area = h * w
+    for _ in range(10):
+        target_area = area * np.random.uniform(*scale)
+        log_ratio = np.log(ratio)
+        aspect = np.exp(np.random.uniform(*log_ratio))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            i = np.random.randint(0, h - ch + 1)
+            j = np.random.randint(0, w - cw + 1)
+            return i, j, ch, cw
+    # fallback: center crop
+    s = min(h, w)
+    return (h - s) // 2, (w - s) // 2, s, s
+
+
 class RandomResizedCrop:
     def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
         self.size, self.scale, self.ratio = size, scale, ratio
 
     def __call__(self, img):
         h, w = img.shape[:2]
-        area = h * w
-        for _ in range(10):
-            target_area = area * np.random.uniform(*self.scale)
-            log_ratio = np.log(self.ratio)
-            aspect = np.exp(np.random.uniform(*log_ratio))
-            cw = int(round(np.sqrt(target_area * aspect)))
-            ch = int(round(np.sqrt(target_area / aspect)))
-            if 0 < cw <= w and 0 < ch <= h:
-                i = np.random.randint(0, h - ch + 1)
-                j = np.random.randint(0, w - cw + 1)
-                crop = img[i:i + ch, j:j + cw]
-                return _resize_bilinear(crop, self.size, self.size)
-        # fallback: center crop
-        s = min(h, w)
-        i, j = (h - s) // 2, (w - s) // 2
-        return _resize_bilinear(img[i:i + s, j:j + s], self.size, self.size)
+        i, j, ch, cw = _draw_resized_crop_box(h, w, self.scale, self.ratio)
+        crop = img[i:i + ch, j:j + cw]
+        return _resize_bilinear(crop, self.size, self.size)
 
 
 class RandomRotation:
@@ -225,15 +233,77 @@ femnist_test_transforms = Compose([to_float, Normalize(femnist_mean, femnist_std
 femnist_test_transforms.native_spec = dict(
     pad=0, size=28, mean=femnist_mean, std=femnist_std, train=False)
 
-imagenet_train_transforms = Compose([
+# Pure per-op ImageNet stacks (the reference recipe). Kept importable for
+# parity tests; the exported stacks below fuse the whole pipeline into one
+# native call per image (variable JPEG sizes preclude the batch-level
+# store fusion the CIFAR stacks use).
+imagenet_train_transforms_py = Compose([
     to_float,
     RandomResizedCrop(224),
     RandomHorizontalFlip(),
     Normalize(imagenet_mean, imagenet_std),
 ])
-imagenet_val_transforms = Compose([
+imagenet_val_transforms_py = Compose([
     to_float,
     Resize(256),
     CenterCrop(224),
     Normalize(imagenet_mean, imagenet_std),
 ])
+
+
+class FusedResizedCropFlip:
+    """ImageNet train stack as ONE native call per image: the crop box and
+    flip are drawn with np.random in the exact order of the per-op stack
+    (RandomResizedCrop's rejection loop, then RandomHorizontalFlip), then
+    crop+bilinear-resize+flip+normalize run fused in C
+    (native.resized_crop). Matches the per-op stack to float rounding
+    (the u8->float conversion commutes with the bilinear blend)."""
+
+    def __init__(self, size, mean, std, scale=(0.08, 1.0),
+                 ratio=(3 / 4, 4 / 3)):
+        self.size, self.mean, self.std = size, mean, std
+        self.scale, self.ratio = scale, ratio
+
+    def __call__(self, img):
+        from commefficient_tpu import native
+
+        img = _ensure_hwc(img)
+        h, w = img.shape[:2]
+        by, bx, bh, bw = _draw_resized_crop_box(h, w, self.scale,
+                                                self.ratio)
+        flip = np.random.rand() < 0.5
+        return native.resized_crop(img, (by, bx, bh, bw), self.size,
+                                   self.size, flip, self.mean, self.std,
+                                   clip_mode=0)
+
+
+class FusedResizeCenterCrop:
+    """ImageNet val stack (Resize(resize) + CenterCrop(size) + normalize)
+    as ONE native affine-sampled bilinear pass: sample positions are the
+    two-stage pipeline's exact source positions (clip_mode=1), so no
+    full-size resized intermediate is ever materialized."""
+
+    def __init__(self, resize, size, mean, std):
+        self.resize, self.size = resize, size
+        self.mean, self.std = mean, std
+
+    def __call__(self, img):
+        from commefficient_tpu import native
+
+        img = _ensure_hwc(img)
+        h, w = img.shape[:2]
+        if h < w:
+            oh, ow = self.resize, int(round(w * self.resize / h))
+        else:
+            oh, ow = int(round(h * self.resize / w)), self.resize
+        i0, j0 = (oh - self.size) // 2, (ow - self.size) // 2
+        sy, sx = h / oh, w / ow
+        box = (i0 * sy, j0 * sx, self.size * sy, self.size * sx)
+        return native.resized_crop(img, box, self.size, self.size, False,
+                                   self.mean, self.std, clip_mode=1)
+
+
+imagenet_train_transforms = FusedResizedCropFlip(
+    224, imagenet_mean, imagenet_std)
+imagenet_val_transforms = FusedResizeCenterCrop(
+    256, 224, imagenet_mean, imagenet_std)

@@ -27,6 +27,7 @@ __all__ = [
     "available",
     "image_batch",
     "leaf_parse",
+    "resized_crop",
 ]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -68,6 +69,11 @@ def _build_and_load():
     lib.fd_image_batch.argtypes = [
         ctypes.c_void_p, i, ll, i, i, i, i64p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ll, i, i, f32p, f32p, f32p, i]
+    f = ctypes.c_float
+    lib.fd_resized_crop.restype = None
+    lib.fd_resized_crop.argtypes = [
+        ctypes.c_void_p, i, i, i, i, f, f, f, f, i, i, i, i, f32p, f32p,
+        f32p, i]
     lib.fd_leaf_open.restype = ll
     lib.fd_leaf_open.argtypes = [i8p]
     lib.fd_leaf_counts.restype = None
@@ -143,6 +149,73 @@ def image_batch(src, indices, crop_h, crop_w, flip, pad, size, mean, std):
         return out
     return _image_batch_np(src, indices, crop_h, crop_w, flip, pad, size,
                            mean, std)
+
+
+def resized_crop(img, box, out_h, out_w, flip, mean, std, clip_mode=0):
+    """Fused crop/bilinear-resize/flip/normalize for one HWC image (the
+    ImageNet per-item transform hot path — variable image sizes preclude a
+    contiguous batch store, so this fuses at the transform level).
+
+    img: (H, W, C) uint8 or float32. box: (by, bx, bh, bw) floats in source
+    coords. clip_mode 0 = crop-then-resize (integral box, train); 1 =
+    resize-then-crop affine sampling (val). Returns (out_h, out_w, C)
+    float32. Falls back to numpy when the native library is unavailable.
+    """
+    img = np.ascontiguousarray(img)
+    if img.ndim == 2:
+        img = img[..., None]
+    H, W, C = img.shape
+    by, bx, bh, bw = (float(v) for v in box)
+    if clip_mode == 0:
+        # the native window-clip path offsets indices by the box origin
+        # with no image-bounds re-check: an out-of-range box would read
+        # out of bounds (the numpy fallback would instead silently clamp
+        # via slicing) — reject it identically on both paths
+        if not (0 <= by and 0 <= bx and by + bh <= H and bx + bw <= W
+                and bh >= 1 and bw >= 1):
+            raise ValueError(f"crop box {box} outside image ({H}, {W})")
+    mean = np.ascontiguousarray(np.broadcast_to(mean, (C,)), np.float32)
+    std = np.ascontiguousarray(np.broadcast_to(std, (C,)), np.float32)
+    lib = _get_lib()
+    if lib is not None and img.dtype in (np.uint8, np.float32):
+        out = np.empty((out_h, out_w, C), np.float32)
+        lib.fd_resized_crop(
+            img.ctypes.data_as(ctypes.c_void_p),
+            int(img.dtype == np.uint8), H, W, C, by, bx, bh, bw,
+            int(clip_mode), int(out_h), int(out_w), int(bool(flip)),
+            mean, std, out, _nthreads())
+        return out
+    return _resized_crop_np(img, (by, bx, bh, bw), out_h, out_w, flip,
+                            mean, std, clip_mode)
+
+
+def _resized_crop_np(img, box, out_h, out_w, flip, mean, std, clip_mode):
+    from commefficient_tpu.data_utils.transforms import _resize_bilinear
+
+    by, bx, bh, bw = box
+    f = img.astype(np.float32)
+    if img.dtype == np.uint8:
+        f = f / 255.0
+    if clip_mode == 0:
+        crop = f[int(by):int(by) + int(bh), int(bx):int(bx) + int(bw)]
+        out = _resize_bilinear(crop, out_h, out_w)
+    else:
+        H, W = f.shape[:2]
+        ys = (np.arange(out_h) + 0.5) * bh / out_h - 0.5 + by
+        xs = (np.arange(out_w) + 0.5) * bw / out_w - 0.5 + bx
+        y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+        x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+        y1 = np.clip(y0 + 1, 0, H - 1)
+        x1 = np.clip(x0 + 1, 0, W - 1)
+        wy = np.clip(ys - y0, 0, 1)[:, None, None]
+        wx = np.clip(xs - x0, 0, 1)[None, :, None]
+        out = (f[y0][:, x0] * (1 - wy) * (1 - wx)
+               + f[y0][:, x1] * (1 - wy) * wx
+               + f[y1][:, x0] * wy * (1 - wx)
+               + f[y1][:, x1] * wy * wx)
+    if flip:
+        out = out[:, ::-1]
+    return ((out - mean) / std).astype(np.float32)
 
 
 def _image_batch_np(src, indices, crop_h, crop_w, flip, pad, size, mean, std):

@@ -129,6 +129,108 @@ void fd_image_batch(const void* src, int src_is_u8, long long N, int H, int W,
 }
 
 // ---------------------------------------------------------------------------
+// fd_resized_crop — fused crop/bilinear-resize/flip/to-float/normalize for
+// ONE variable-size image (the ImageNet train/val transform hot path:
+// RandomResizedCrop / Resize+CenterCrop run per item on disk-decoded images
+// of varying shape, so no contiguous batch store exists; the numpy bilinear
+// builds four (out_h, out_w, C) temporaries per image, this is one tight
+// pass).
+//
+// src:      (H, W, C) uint8 (src_is_u8=1) or float32, contiguous
+// box:      (by, bx, bh, bw) crop window in source coords; floats so the
+//           val path can express Resize(s)+CenterCrop(k) exactly as an
+//           affine sample (by = i0*H/oh, bh = k*H/oh)
+// clip_mode 0: clip sample indices to the box window [0, ceil(bh)-1] and
+//           offset by by (integral-box crop-then-resize, the train path);
+//           1: clip to the full image [0, H-1] after adding the float
+//           offset (the val path's resize-then-crop)
+// flip:     nonzero -> horizontal flip of the output
+// out:      (out_h, out_w, C) float32, normalized
+// ---------------------------------------------------------------------------
+void fd_resized_crop(const void* src, int src_is_u8, int H, int W, int C,
+                     float by, float bx, float bh, float bw, int clip_mode,
+                     int out_h, int out_w, int flip, const float* mean,
+                     const float* stddev, float* out, int nthreads) {
+  const uint8_t* s8 = src_is_u8 ? (const uint8_t*)src : nullptr;
+  const float* sf = src_is_u8 ? nullptr : (const float*)src;
+  std::vector<float> inv_std(C), meanv(C);
+  for (int c = 0; c < C; ++c) {
+    inv_std[c] = 1.0f / stddev[c];
+    meanv[c] = mean[c];
+  }
+  const float u8scale = 1.0f / 255.0f;
+  // per-column sample indices/weights, computed once
+  std::vector<int> x0v(out_w), x1v(out_w);
+  std::vector<float> wxv(out_w);
+  for (int j = 0; j < out_w; ++j) {
+    float xs = ((float)j + 0.5f) * bw / (float)out_w - 0.5f;
+    int x0, x1;
+    float wx;
+    if (clip_mode == 0) {
+      int hi = (int)std::ceil(bw) - 1;
+      x0 = std::min(std::max((int)std::floor(xs), 0), hi);
+      x1 = std::min(x0 + 1, hi);
+      wx = std::min(std::max(xs - (float)x0, 0.0f), 1.0f);
+      x0 += (int)bx;
+      x1 += (int)bx;
+    } else {
+      float p = xs + bx;
+      x0 = std::min(std::max((int)std::floor(p), 0), W - 1);
+      x1 = std::min(x0 + 1, W - 1);
+      wx = std::min(std::max(p - (float)x0, 0.0f), 1.0f);
+    }
+    x0v[j] = x0;
+    x1v[j] = x1;
+    wxv[j] = wx;
+  }
+  parallel_for(out_h, nthreads, (long long)out_w * C * 8, [&](long long i) {
+    float ys = ((float)i + 0.5f) * bh / (float)out_h - 0.5f;
+    int y0, y1;
+    float wy;
+    if (clip_mode == 0) {
+      int hi = (int)std::ceil(bh) - 1;
+      y0 = std::min(std::max((int)std::floor(ys), 0), hi);
+      y1 = std::min(y0 + 1, hi);
+      wy = std::min(std::max(ys - (float)y0, 0.0f), 1.0f);
+      y0 += (int)by;
+      y1 += (int)by;
+    } else {
+      float p = ys + by;
+      y0 = std::min(std::max((int)std::floor(p), 0), H - 1);
+      y1 = std::min(y0 + 1, H - 1);
+      wy = std::min(std::max(p - (float)y0, 0.0f), 1.0f);
+    }
+    const long long r0 = (long long)y0 * W * C, r1 = (long long)y1 * W * C;
+    for (int j = 0; j < out_w; ++j) {
+      const int oj = flip ? (out_w - 1 - j) : j;
+      const long long c00 = r0 + (long long)x0v[j] * C;
+      const long long c01 = r0 + (long long)x1v[j] * C;
+      const long long c10 = r1 + (long long)x0v[j] * C;
+      const long long c11 = r1 + (long long)x1v[j] * C;
+      const float wx = wxv[j];
+      float* d = out + ((long long)i * out_w + oj) * C;
+      for (int c = 0; c < C; ++c) {
+        float a, b, cc, dd;
+        if (src_is_u8) {
+          a = (float)s8[c00 + c] * u8scale;
+          b = (float)s8[c01 + c] * u8scale;
+          cc = (float)s8[c10 + c] * u8scale;
+          dd = (float)s8[c11 + c] * u8scale;
+        } else {
+          a = sf[c00 + c];
+          b = sf[c01 + c];
+          cc = sf[c10 + c];
+          dd = sf[c11 + c];
+        }
+        float v = a * (1.0f - wy) * (1.0f - wx) + b * (1.0f - wy) * wx
+                  + cc * wy * (1.0f - wx) + dd * wy * wx;
+        d[c] = (v - meanv[c]) * inv_std[c];
+      }
+    }
+  });
+}
+
+// ---------------------------------------------------------------------------
 // LEAF FEMNIST JSON parsing (the orjson replacement).
 //
 // Restricted-schema parser for LEAF shard files:

@@ -42,6 +42,51 @@ def time_epochs(loader, n_epochs=3):
     return min(times), n
 
 
+def bench_imagenet_transform():
+    """Per-item ImageNet transform: fused native resized-crop vs the pure
+    per-op stack (VERDICT r4 weak #6 — the 224x224 path at the imagenet.sh
+    shape). Images are realistic JPEG-decode sizes (~500x375), throughput
+    is single-image transform calls (the loader applies it per item)."""
+    from commefficient_tpu.data_utils.transforms import (
+        imagenet_train_transforms,
+        imagenet_train_transforms_py,
+        imagenet_val_transforms,
+        imagenet_val_transforms_py,
+    )
+
+    # pin the native kernel to ONE thread: the per-op numpy stack is
+    # single-threaded, so the comparison (and the rounds/sec/thread
+    # print) must be thread-for-thread fair
+    os.environ["COMMEFFICIENT_NATIVE_THREADS"] = "1"
+    rng = np.random.RandomState(0)
+    imgs = [rng.randint(0, 256, (375, 500, 3)).astype(np.uint8)
+            for _ in range(32)]
+    out = {}
+    for tag, fn in (("train_py", imagenet_train_transforms_py),
+                    ("train_native", imagenet_train_transforms),
+                    ("val_py", imagenet_val_transforms_py),
+                    ("val_native", imagenet_val_transforms)):
+        np.random.seed(0)
+        for im in imgs[:4]:
+            fn(im)  # warm
+        np.random.seed(0)
+        t0 = time.perf_counter()
+        for im in imgs:
+            fn(im)
+        dt = (time.perf_counter() - t0) / len(imgs)
+        out[tag] = dt
+        print(f"imagenet {tag:13s}: {dt * 1e3:7.2f} ms/image "
+              f"({1 / dt:,.0f} images/sec)")
+    tr = out["train_py"] / out["train_native"]
+    va = out["val_py"] / out["val_native"]
+    print(f"imagenet speedup: train {tr:.1f}x, val {va:.1f}x")
+    # imagenet.sh round shape: 7 workers x 64 images = 448 images/round
+    rps = 1.0 / (448 * out["train_native"])
+    print(f"imagenet.sh round shape (7x64): native host assembly supports "
+          f"{rps:.1f} rounds/sec/thread")
+    return out
+
+
 def main():
     assert native.available(), "native lib failed to build"
     d = "/tmp/native_bench_cifar"
@@ -60,6 +105,7 @@ def main():
         print(f"{key:8s}: {dt:.3f}s/epoch, {n / dt:,.0f} images/sec")
     speedup = results["python"][0] / results["native"][0]
     print(f"speedup: {speedup:.1f}x")
+    bench_imagenet_transform()
     return results, speedup
 
 

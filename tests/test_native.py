@@ -173,3 +173,76 @@ class TestLoaderParity:
 
         with pytest.raises(RuntimeError, match="boom"):
             list(PrefetchLoader(Boom()))
+
+
+class TestResizedCrop:
+    """ImageNet per-item fusion (native.resized_crop): the fused C pass must
+    match the pure per-op stack (RandomResizedCrop/Resize+CenterCrop on
+    float arrays) to float rounding, for both clip modes and both dtypes."""
+
+    @needs_native
+    def test_train_box_matches_crop_then_resize(self):
+        from commefficient_tpu.data_utils.transforms import (
+            Normalize,
+            _resize_bilinear,
+            imagenet_mean,
+            imagenet_std,
+        )
+
+        rng = np.random.RandomState(3)
+        img = rng.randint(0, 256, (113, 157, 3)).astype(np.uint8)
+        by, bx, bh, bw = 11, 23, 71, 93
+        got = native.resized_crop(img, (by, bx, bh, bw), 224, 224, False,
+                                  imagenet_mean, imagenet_std, clip_mode=0)
+        crop = img.astype(np.float32)[by:by + bh, bx:bx + bw] / 255.0
+        ref = Normalize(imagenet_mean, imagenet_std)(
+            _resize_bilinear(crop, 224, 224))
+        np.testing.assert_allclose(got, ref, atol=2e-4)
+
+    @needs_native
+    def test_train_flip(self):
+        from commefficient_tpu.data_utils.transforms import (
+            imagenet_mean,
+            imagenet_std,
+        )
+
+        rng = np.random.RandomState(4)
+        img = rng.randint(0, 256, (64, 80, 3)).astype(np.uint8)
+        plain = native.resized_crop(img, (4, 4, 48, 60), 32, 32, False,
+                                    imagenet_mean, imagenet_std)
+        flipped = native.resized_crop(img, (4, 4, 48, 60), 32, 32, True,
+                                      imagenet_mean, imagenet_std)
+        np.testing.assert_allclose(flipped, plain[:, ::-1], atol=1e-6)
+
+    def test_fused_train_stack_matches_pure_stack(self):
+        """The exported imagenet_train_transforms (fused) draws the same
+        np.random sequence as the per-op stack, so under one seed both
+        produce the same crop/flip and near-identical pixels. Runs with or
+        without the native lib (numpy fallback follows the same path)."""
+        from commefficient_tpu.data_utils.transforms import (
+            imagenet_train_transforms,
+            imagenet_train_transforms_py,
+        )
+
+        rng = np.random.RandomState(9)
+        img = rng.randint(0, 256, (200, 150, 3)).astype(np.uint8)
+        np.random.seed(123)
+        fused = imagenet_train_transforms(img)
+        np.random.seed(123)
+        ref = imagenet_train_transforms_py(img)
+        assert fused.shape == (224, 224, 3)
+        np.testing.assert_allclose(fused, ref, atol=2e-4)
+
+    def test_fused_val_stack_matches_pure_stack(self):
+        from commefficient_tpu.data_utils.transforms import (
+            imagenet_val_transforms,
+            imagenet_val_transforms_py,
+        )
+
+        rng = np.random.RandomState(10)
+        for shape in [(300, 500, 3), (500, 300, 3), (256, 256, 3)]:
+            img = rng.randint(0, 256, shape).astype(np.uint8)
+            fused = imagenet_val_transforms(img)
+            ref = imagenet_val_transforms_py(img)
+            assert fused.shape == (224, 224, 3)
+            np.testing.assert_allclose(fused, ref, atol=2e-4)
