@@ -16,7 +16,11 @@ This module makes that budget explicit and plans placement:
   placed in **host memory** (``memory_kind="pinned_host"`` on TPU) and the
   per-round gather/scatter streams the W participating rows over PCIe —
   the direct analogue of the reference's host-shared-memory design, but
-  planned, measured, and only used when HBM can't hold the state.
+  planned, measured, and only used when HBM can't hold the state. The
+  streaming itself is implemented by ``federated/host_state.py`` (a W-row
+  proxy around the unchanged round step) and wired in the aggregator;
+  ``COMMEFFICIENT_STATE_HBM_BUDGET`` overrides the budget to force the
+  path.
 
 Capacity reference (v5e, 16 GiB HBM/chip, ResNet9 d=6.5M, budget = 50% of
 HBM for client state):
@@ -129,7 +133,8 @@ def client_state_sharding(mesh: Optional[Mesh],
     """NamedSharding for ClientStates arrays per the plan: row-sharded over
     the clients axis, in HBM or host memory. Host placement needs TPU memory
     kinds; on other backends it degrades to default memory with the plan
-    retained for accounting."""
+    retained for accounting (host_state.RowStreamer runs the same row-proxy
+    data path either way, so the degraded mode stays execution-tested)."""
     if mesh is None:
         return None
     spec = P("clients")

@@ -20,19 +20,12 @@ os.environ["TRANSFORMERS_OFFLINE"] = "1"
 os.environ.setdefault("COMMEFFICIENT_TINY_MODEL", "1")
 os.environ.setdefault("COMMEFFICIENT_GPT2_SEQ_LEN", "64")
 os.environ["COMMEFFICIENT_SYNTHETIC_CLIENTS"] = "16"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# the site hook pre-registers the axon TPU platform at interpreter startup
-# (env pops are too late); config.update after import wins (tests/conftest.py)
-# — this run must NOT land on (and contend for) the single tunneled chip
-import jax  # noqa: E402
+from script_env import force_cpu_mesh  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_mesh(8)
 
 import gpt2_train  # noqa: E402
 

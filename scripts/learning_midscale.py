@@ -32,9 +32,9 @@ sys.path.insert(0, _REPO)
 
 os.environ.setdefault("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "64")
 
-import jax  # noqa: E402
+from script_env import force_cpu_mesh  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_mesh(8)
 
 OUT = os.path.join(_REPO, "docs", "learning_midscale.json")
 

@@ -1,0 +1,21 @@
+"""Shared environment pinning for the CPU-mesh evidence scripts.
+
+One place for the virtual-8-device CPU setup (tests/conftest.py documents
+the hazards): the site hook pre-registers the axon TPU platform at
+interpreter startup, so env pops are too late — ``jax.config.update`` after
+import wins and keeps the run off (and not contending for) the single
+tunneled chip. XLA_FLAGS is read at backend init, so setting it before the
+first device use suffices.
+"""
+
+import os
+
+
+def force_cpu_mesh(n: int = 8) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

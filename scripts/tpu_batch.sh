@@ -104,6 +104,24 @@ for step in $STEPS; do
       log "step $i rc=$rc (docs/measurements/tpu_profile.md on success)"
       [ $rc -eq 0 ] && mark_done profile
       ;;
+    profile2)
+      # CIFAR re-profile AFTER the pallas-topk flip: confirms the new
+      # per-op breakdown behind the 361 r/s headline
+      log "step $i: tpu_profile.py re-profile post-topk-flip (timeout 30m)"
+      timeout 1800 python scripts/tpu_profile.py \
+        >"$OUT/profile2.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (docs/measurements/tpu_profile.md refreshed)"
+      [ $rc -eq 0 ] && mark_done profile2
+      ;;
+    profile_gpt2)
+      log "step $i: tpu_profile.py GPT-2 per-op breakdown (timeout 40m)"
+      TPU_PROFILE_TARGET=gpt2 timeout 2400 python scripts/tpu_profile.py \
+        >"$OUT/profile_gpt2.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (docs/measurements/tpu_profile_gpt2.md on success)"
+      [ $rc -eq 0 ] && mark_done profile_gpt2
+      ;;
     learning)
       log "step $i: learning_fullscale.py (timeout 90m)"
       timeout 5400 python scripts/learning_fullscale.py \

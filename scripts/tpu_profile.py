@@ -30,7 +30,21 @@ from __graft_entry__ import apply_tpu_cache_env  # noqa: E402
 apply_tpu_cache_env(os.environ)
 
 ROUNDS = int(os.environ.get("TPU_PROFILE_ROUNDS", 10))
-OUT_MD = os.path.join(_REPO, "docs", "measurements", "tpu_profile.md")
+# "cifar" (default) or "gpt2" — which workload's fused round to trace
+TARGET = os.environ.get("TPU_PROFILE_TARGET", "cifar")
+if TARGET not in ("cifar", "gpt2"):
+    sys.exit(f"unknown TPU_PROFILE_TARGET {TARGET!r} (cifar|gpt2)")
+OUT_MD = os.path.join(
+    _REPO, "docs", "measurements",
+    "tpu_profile.md" if TARGET == "cifar" else f"tpu_profile_{TARGET}.md")
+_TITLES = {
+    "cifar": ("fused CIFAR federated round",
+              "full bench geometry (ResNet9 d={d}, 8 workers, sketch "
+              "5x500k k=50k)"),
+    "gpt2": ("fused GPT-2 PersonaChat federated round",
+             "full bench geometry (GPT-2 124M double-heads bf16 d={d}, "
+             "4 workers, sketch 5x500k k=50k)"),
+}
 
 
 def _category(op_name: str) -> str:
@@ -110,13 +124,13 @@ def write_report(plane, line, agg, wall_ms_per_round, backend, d, tiny,
         c[1] += ps
     cat_rows = sorted(cats.items(), key=lambda kv: -kv[1][1])
 
+    title, geom_t = _TITLES[TARGET]
     geom = (f"tiny CPU-fallback geometry (ResNet9 d={d:,}) — parser "
             f"self-test, NOT a perf artifact" if tiny else
-            f"full bench geometry (ResNet9 d={d:,}, 8 workers, "
-            f"sketch 5x500k k=50k)")
+            geom_t.format(d=f"{d:,}"))
     os.makedirs(os.path.dirname(out_md), exist_ok=True)
     with open(out_md, "w") as f:
-        f.write("# Per-op profile: fused CIFAR federated round\n\n")
+        f.write(f"# Per-op profile: {title}\n\n")
         f.write(f"Captured {time.strftime('%Y-%m-%d %H:%M:%S')} on backend "
                 f"`{backend}`, {geom}, {ROUNDS} steady-state "
                 f"rounds traced.\n\n")
@@ -137,7 +151,8 @@ def write_report(plane, line, agg, wall_ms_per_round, backend, d, tiny,
             safe = name.replace("|", "\\|")[:90]
             f.write(f"| `{safe}` | {cnt} | {ps / 1e9:.2f} | "
                     f"{ps / 1e9 / ROUNDS:.3f} | {100 * ps / total_ps:.1f}% |\n")
-        f.write("\nRaw trace: runs/tpu_profile_trace/ (not committed).\n")
+        f.write(f"\nRaw trace: runs/tpu_profile_trace_{TARGET}/ "
+                "(not committed).\n")
     print(f"wrote {out_md}", flush=True)
 
 
@@ -155,7 +170,13 @@ def main() -> int:
     import bench as B
 
     tiny = not on_tpu
-    steps, ps, ss, cs, batch = B.build(tiny=tiny)
+    if TARGET == "gpt2":
+        if not on_tpu:
+            print("gpt2 profile target is chip-only (d=124M)", flush=True)
+            return 2
+        steps, ps, ss, cs, batch, _tokens = B.build_gpt2(bf16=True)
+    else:
+        steps, ps, ss, cs, batch = B.build(tiny=tiny)
     d = int(ps.size)
 
     def drain(x):
@@ -169,7 +190,13 @@ def main() -> int:
         state = out[:4]
         drain(state[0])
 
-    trace_dir = os.path.join(_REPO, "runs", "tpu_profile_trace")
+    # per-target trace dir, cleared first: the parser takes the newest
+    # xplane.pb, and a failed trace must NOT silently re-report an older
+    # target's data under this target's filename
+    trace_dir = os.path.join(_REPO, "runs", f"tpu_profile_trace_{TARGET}")
+    import shutil
+
+    shutil.rmtree(trace_dir, ignore_errors=True)
     print(f"tracing {ROUNDS} rounds -> {trace_dir}", flush=True)
     t0 = time.perf_counter()
     with jax.profiler.trace(trace_dir):
