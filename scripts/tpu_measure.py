@@ -232,9 +232,71 @@ def gpt2_leg(bf16):
         steps, st[0], st[2], batch, dt * 1e3, tag.strip())
 
 
+def imagenet_leg(bf16, microbatch):
+    """The reference's only tuned large-scale config (reference
+    imagenet.sh:1-21): FixupResNet50, 7 workers x local bs 64 = 448 imgs
+    per uncompressed round, virtual momentum 0.9, wd 1e-4 — at the real
+    224x224 shapes, microbatched to fit a single chip's HBM.  Synthetic
+    pixels (no ImageNet in the zero-egress image): the measured quantity
+    is the round's compute, which does not depend on pixel values."""
+    from commefficient_tpu import models
+    from commefficient_tpu.federated.losses import make_cv_losses
+    from commefficient_tpu.federated.rounds import (
+        RoundConfig, build_round_step, init_client_states)
+    from commefficient_tpu.federated.server import (
+        ServerConfig, init_server_state)
+    from commefficient_tpu.federated.worker import WorkerConfig
+    from commefficient_tpu.ops.flat import ravel_pytree
+    from commefficient_tpu.parallel.mesh import default_client_mesh
+
+    # reference geometry by default; env overrides for the CPU smoke run
+    W = int(os.environ.get("IMAGENET_W", "7"))
+    BS = int(os.environ.get("IMAGENET_BS", "64"))
+    HW = int(os.environ.get("IMAGENET_HW", "224"))
+    model = models.FixupResNet50(num_classes=1000)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, HW, HW, 3), jnp.float32),
+                        train=False)["params"]
+    flat, unravel = ravel_pytree(params)
+    d = int(flat.size)
+    print(f"imagenet: FixupResNet50 d={d:,} W={W} bs={BS} "
+          f"mb={microbatch} bf16={bf16}", flush=True)
+    wcfg = WorkerConfig(mode="uncompressed", error_type="none",
+                        num_workers=W, weight_decay=1e-4,
+                        microbatch_size=microbatch)
+    scfg = ServerConfig(mode="uncompressed", error_type="none",
+                        grad_size=d, virtual_momentum=0.9)
+    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d)
+    loss_train, loss_val = make_cv_losses(
+        model, compute_dtype=jnp.bfloat16 if bf16 else None)
+    mesh = default_client_mesh(W)
+    steps = build_round_step(loss_train, loss_val, unravel,
+                             lambda t: ravel_pytree(t)[0], cfg, sketch=None,
+                             mesh=mesh)
+    server_state = init_server_state(scfg, None)
+    client_states = init_client_states(W, d, wcfg)
+    rng_np = np.random.RandomState(0)
+    batch = {
+        "inputs": jnp.asarray(rng_np.randn(W, BS, HW, HW, 3), jnp.float32),
+        "targets": jnp.asarray(rng_np.randint(0, 1000, (W, BS))),
+        "mask": jnp.ones((W, BS), jnp.float32),
+        "client_ids": jnp.asarray(np.arange(W), jnp.int32),
+        "worker_mask": jnp.ones(W, jnp.float32),
+    }
+    dt, rtt, _ = time_rounds(steps, (flat, server_state, client_states, {}),
+                             batch, iters=5)
+    imgs = W * BS
+    # fwd+bwd ~= 3x fwd; FixupResNet50 fwd ~= 4.1 GFLOP/img at 224^2,
+    # scaling ~quadratically with spatial resolution (conv-dominated)
+    tflops = 3 * 4.1e9 * (HW / 224) ** 2 * imgs / dt / 1e12
+    print(f"ImageNet {'bf16' if bf16 else 'f32'} round: {dt * 1e3:.1f} ms = "
+          f"{imgs / dt:,.0f} imgs/s ({1 / dt:.2f} r/s), ~{tflops:.1f} "
+          f"TFLOP/s model compute, rtt {rtt * 1e3:.0f} ms", flush=True)
+
+
 def main():
     """Leg names via argv select a subset (default: all)."""
-    known = {"matmul", "cifar", "ops", "gpt2"}
+    known = {"matmul", "cifar", "ops", "gpt2", "imagenet"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -255,6 +317,10 @@ def main():
     if sel("gpt2"):
         leg("gpt2-f32", gpt2_leg, False)
         leg("gpt2-bf16", gpt2_leg, True)
+    if sel("imagenet"):
+        mb = int(os.environ.get("IMAGENET_MICROBATCH", "8"))
+        leg("imagenet-bf16", imagenet_leg, True, mb)
+        leg("imagenet-f32", imagenet_leg, False, mb)
 
 
 if __name__ == "__main__":

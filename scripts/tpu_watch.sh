@@ -17,7 +17,8 @@ trap 'rmdir "$LOCK"' EXIT
 
 # one explicit step list, resolved ONCE here and passed verbatim to every
 # tpu_batch.sh invocation, so the two scripts cannot disagree on defaults
-STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 learning profile ops"}
+STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 learning profile \
+profile_gpt2 host_offload imagenet ops"}
 MAX_BATCHES=${TPU_WATCH_MAX_BATCHES:-6}
 batches=0
 
