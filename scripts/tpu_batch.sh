@@ -8,6 +8,10 @@
 # Logs to runs/tpu_batch_<ts>/.
 #
 # Usage: bash scripts/tpu_batch.sh   (claims the single axon chip)
+#
+# Per-leg wall-clock budgets (warm/cold) live in
+# docs/measurements/leg_budgets.json — consult it before reordering STEPS
+# for a short window.
 set -u
 cd "$(dirname "$0")/.."
 TS=$(date +%Y%m%d_%H%M%S)
