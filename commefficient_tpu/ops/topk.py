@@ -108,17 +108,111 @@ def _count_ge_pallas(v3, ts, *, T, interpret=False):
     )(ts, v3)
 
 
+@functools.partial(jax.jit, static_argnames=("T", "interpret"))
+def _descent_pallas(v3, kk, *, T, interpret=False):
+    """The WHOLE 8-pass radix descent in one ``pallas_call``: grid
+    ``(8, T)`` re-streams the vector once per pass while the resolved
+    prefix and the 15 running ≥-counts live in SMEM scratch across blocks
+    — one kernel launch instead of 8, and none of the tiny s32[16]
+    select/sum XLA ops between passes (each a ~20 µs dispatch in the
+    round-5 post-flip profile). Pass p resolves threshold bits
+    ``31-4p..28-4p``; candidate j tests ``prefix + (j+1) << shift``,
+    with the first pass's impossible candidates (top nibble of a finite
+    |float| is ≤ 7) pinned to INT32_MAX where no magnitude can reach.
+    Returns the scalar k-th-magnitude bit-pattern threshold."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(kk_ref, v_ref, out_ref, counts, prefix):
+        p_id = pl.program_id(0)
+        t_id = pl.program_id(1)
+
+        @pl.when(jnp.logical_and(p_id == 0, t_id == 0))
+        def _():
+            prefix[0] = 0
+
+        @pl.when(t_id == 0)
+        def _():
+            for j in range(15):
+                counts[j] = 0
+
+        shift = 28 - 4 * p_id
+        pfx = prefix[0]
+        m = v_ref[0] & _ABS_MASK
+        m = jnp.where(m > _INF_BITS, 0, m)
+        for j in range(15):
+            ts_j = pfx + jnp.left_shift(jnp.int32(j + 1), shift)
+            # pass 0: candidates 8..15 would shift into the sign bit —
+            # pin to ABS_MASK (>= it is impossible for finite |float|)
+            ts_j = jnp.where(jnp.logical_and(p_id == 0, j >= 7),
+                             jnp.int32(_ABS_MASK), ts_j)
+            counts[j] += jnp.sum((m >= ts_j).astype(jnp.int32))
+
+        @pl.when(t_id == T - 1)
+        def _():
+            k = kk_ref[0]
+            sel = jnp.int32(0)
+            for j in range(15):
+                sel += jnp.where(counts[j] >= k, 1, 0).astype(jnp.int32)
+            prefix[0] = pfx + jnp.left_shift(sel, shift)
+
+        @pl.when(jnp.logical_and(p_id == 7, t_id == T - 1))
+        def _():
+            out_ref[0] = prefix[0]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(8, T),
+        in_specs=[pl.BlockSpec((1, _SUB, _LANES), lambda p, t, *_: (t, 0, 0))],
+        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+        scratch_shapes=[pltpu.SMEM((15,), jnp.int32),
+                        pltpu.SMEM((1,), jnp.int32)],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+        interpret=interpret,
+    )(kk, v3)
+
+
+def _blocks3(raw: jax.Array):
+    """Pad the int32 bit patterns with +0.0 (mag 0 never reaches any
+    threshold, all ≥ 1) and reshape to the kernels' ``(T, _SUB, _LANES)``
+    block layout."""
+    d = raw.shape[0]
+    block = _SUB * _LANES
+    T = -(-d // block)
+    return jnp.pad(raw, (0, T * block - d)).reshape(T, _SUB, _LANES), T
+
+
+def _apply_threshold(raw: jax.Array, vec: jax.Array, p) -> jax.Array:
+    """Dense-masked result from the resolved k-th-magnitude bit pattern:
+    keep mag ≥ p (tie-inclusive), re-insert NaNs (module docstring)."""
+    m = raw & _ABS_MASK
+    mag = jnp.where(m > _INF_BITS, 0, m)
+    out = jnp.where(mag >= p, vec, jnp.zeros_like(vec))
+    return jnp.where(m > _INF_BITS, vec, out)
+
+
+def _topk_threshold_1d_fused(vec: jax.Array, k: int,
+                             interpret: bool = False) -> jax.Array:
+    """Descent via the single fused kernel; identical output to the
+    per-pass paths whenever the counts agree (exact integer arithmetic)."""
+    raw = vec.view(jnp.int32)
+    v3, T = _blocks3(raw)
+    kk = jnp.asarray([k], jnp.int32)
+    p = _descent_pallas(v3, kk, T=T, interpret=interpret)[0]
+    return _apply_threshold(raw, vec, p)
+
+
 def _topk_threshold_1d_pallas(vec: jax.Array, k: int,
                               interpret: bool = False) -> jax.Array:
     """Same radix descent as ``_topk_threshold_1d``, counts from the Pallas
     kernel. Identical output: the descent is exact integer arithmetic, so
     the two paths agree bit-for-bit whenever the counts do."""
     raw = vec.view(jnp.int32)
-    d = raw.shape[0]
-    block = _SUB * _LANES
-    T = -(-d // block)
-    # pad with +0.0 bits: mag 0 never reaches any ts (all >= 1)
-    v3 = jnp.pad(raw, (0, T * block - d)).reshape(T, _SUB, _LANES)
+    v3, T = _blocks3(raw)
 
     p = jnp.int32(0)
     for shift in range(28, -1, -4):
@@ -130,13 +224,7 @@ def _topk_threshold_1d_pallas(vec: jax.Array, k: int,
         sel = jnp.sum(counts >= k).astype(jnp.int32)
         p = p + (sel << shift)
 
-    def mag(r):
-        m = r & _ABS_MASK
-        return jnp.where(m > _INF_BITS, 0, m)
-
-    out = jnp.where(mag(raw) >= p, vec, jnp.zeros_like(vec))
-    nan = (raw & _ABS_MASK) > _INF_BITS
-    return jnp.where(nan, vec, out)
+    return _apply_threshold(raw, vec, p)
 
 
 def _topk_sort_1d(vec: jax.Array, k: int) -> jax.Array:
@@ -185,7 +273,15 @@ def topk(vec: jax.Array, k: int, method: str = "threshold") -> jax.Array:
     reference utils.py:246-252.
     """
     if method == "threshold" and _use_pallas_topk(vec.shape[-1]):
-        f = _topk_threshold_1d_pallas
+        import os
+
+        # fused whole-descent kernel: default OFF until the on-chip A/B
+        # (scripts/tpu_measure.py ops) proves it beats the per-pass kernel
+        # — the same gate-then-flip playbook as the count-pass kernel
+        if os.environ.get("COMMEFFICIENT_PALLAS_TOPK_FUSED") == "1":
+            f = _topk_threshold_1d_fused
+        else:
+            f = _topk_threshold_1d_pallas
     else:
         f = {"threshold": _topk_threshold_1d, "sort": _topk_sort_1d}[method]
     if vec.ndim == 1:

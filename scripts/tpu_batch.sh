@@ -173,6 +173,22 @@ for step in $STEPS; do
       log "step $i rc=$rc (see $OUT/tpu_measure_gpt2.log)"
       [ $rc -eq 0 ] && mark_done gpt2
       ;;
+    ops_fused)
+      # fused whole-descent topk A/B (round 5): decides
+      # COMMEFFICIENT_PALLAS_TOPK_FUSED's default. Cheap standalone leg —
+      # does NOT re-run the wedge-prone full ops chain
+      log "step $i: tpu_measure.py topk_ab fused-descent A/B (timeout 25m)"
+      timeout 1500 python scripts/tpu_measure.py topk_ab \
+        >"$OUT/tpu_measure_ops_fused.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_ops_fused.log)"
+      # done only on the success line (the failure path prints
+      # 'fused-descent topk failed:'), and only if BOTH geometries landed
+      if [ $rc -eq 0 ] && [ "$(grep -c "ms vs per-pass pallas" \
+          "$OUT/tpu_measure_ops_fused.log")" -ge 2 ]; then
+        mark_done ops_fused
+      fi
+      ;;
     ops)
       log "step $i: tpu_measure.py matmul cifar ops (timeout 40m)"
       timeout 2400 python scripts/tpu_measure.py matmul cifar ops \

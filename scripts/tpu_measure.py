@@ -194,6 +194,7 @@ def sketch_ops_leg(d):
     # and the outputs match exactly)
     from commefficient_tpu.ops.topk import _topk_threshold_1d_pallas
 
+    t_ptopk = float("nan")
     try:
         same = bool(jnp.all(_topk_threshold_1d_pallas(est, 50_000)
                             == topk(est, 50_000)))
@@ -204,6 +205,35 @@ def sketch_ops_leg(d):
               f"ms | outputs equal: {same}", flush=True)
     except Exception as e:  # noqa: BLE001
         print(f"d={d}: pallas topk failed: {str(e)[:300]}", flush=True)
+
+
+def topk_ab_leg(d):
+    """Cheap standalone top-k A/B at one geometry: XLA descent vs per-pass
+    Pallas vs the fused whole-descent kernel (one pallas_call for all 8
+    passes, SMEM prefix carry; default-off behind
+    COMMEFFICIENT_PALLAS_TOPK_FUSED=1 — flip only if it beats the per-pass
+    kernel here with equal output). Any dense vector exercises the same
+    code; no sketch build needed, so this costs minutes, not the full
+    wedge-prone ops chain."""
+    from commefficient_tpu.ops.topk import (
+        _topk_threshold_1d,
+        _topk_threshold_1d_fused,
+        _topk_threshold_1d_pallas,
+    )
+
+    v = jnp.asarray(np.random.RandomState(0).randn(d).astype(np.float32))
+    ref = _topk_threshold_1d(v, 50_000)
+    drain(ref)
+    t_x = chained(lambda x: _topk_threshold_1d(x, 50_000), v, K=4)
+    print(f"d={d}: XLA-descent topk {t_x:.2f} ms", flush=True)
+    t_p = chained(lambda x: _topk_threshold_1d_pallas(x, 50_000), v, K=4)
+    same_p = bool(jnp.all(_topk_threshold_1d_pallas(v, 50_000) == ref))
+    print(f"d={d}: per-pass pallas topk {t_p:.2f} ms | outputs equal: "
+          f"{same_p}", flush=True)
+    t_f = chained(lambda x: _topk_threshold_1d_fused(x, 50_000), v, K=4)
+    same_f = bool(jnp.all(_topk_threshold_1d_fused(v, 50_000) == ref))
+    print(f"d={d}: fused-descent topk {t_f:.2f} ms vs per-pass pallas "
+          f"{t_p:.2f} ms | outputs equal: {same_f}", flush=True)
 
 
 def gpt2_leg(bf16):
@@ -296,7 +326,7 @@ def imagenet_leg(bf16, microbatch):
 
 def main():
     """Leg names via argv select a subset (default: all)."""
-    known = {"matmul", "cifar", "ops", "gpt2", "imagenet"}
+    known = {"matmul", "cifar", "ops", "gpt2", "imagenet", "topk_ab"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -321,6 +351,9 @@ def main():
         mb = int(os.environ.get("IMAGENET_MICROBATCH", "8"))
         leg("imagenet-bf16", imagenet_leg, True, mb)
         leg("imagenet-f32", imagenet_leg, False, mb)
+    if sel("topk_ab"):
+        leg("topk_ab-6.5M", topk_ab_leg, 6_568_640)
+        leg("topk_ab-124M", topk_ab_leg, 124_444_417)
 
 
 if __name__ == "__main__":

@@ -406,6 +406,88 @@ class TestTopkPallasCounts:
         self._both(v, 1000)
 
 
+class TestTopkFusedDescent:
+    """The single-kernel fused descent (grid (8, T), SMEM-carried prefix)
+    must reproduce the XLA radix descent bit-for-bit in interpret mode —
+    same contract as the per-pass count kernel it is a candidate
+    replacement for (gated off until the on-chip A/B flips it)."""
+
+    def _both(self, v, k):
+        from commefficient_tpu.ops.topk import (
+            _topk_threshold_1d,
+            _topk_threshold_1d_fused,
+        )
+
+        vj = jnp.asarray(v, jnp.float32)
+        want = np.asarray(_topk_threshold_1d(vj, k))
+        got = np.asarray(_topk_threshold_1d_fused(vj, k, interpret=True))
+        np.testing.assert_array_equal(got, want)
+
+    def test_random_non_block_multiple(self):
+        rng = np.random.RandomState(0)
+        self._both(rng.randn(70_001).astype(np.float32), 1000)
+
+    def test_exact_block_multiple(self):
+        rng = np.random.RandomState(1)
+        self._both(rng.randn(65_536).astype(np.float32), 5000)
+
+    def test_single_block(self):
+        # T == 1: the per-pass count reset and the finalize fire in the
+        # SAME block invocation — the tightest ordering case
+        rng = np.random.RandomState(2)
+        self._both(rng.randn(60_000).astype(np.float32), 600)
+
+    def test_nan_inf_ties_and_zeros(self):
+        v = np.zeros(66_000, np.float32)
+        v[:10] = 3.0
+        v[10:20] = -3.0
+        v[20] = np.inf
+        v[21] = -np.inf
+        v[22] = np.nan
+        v[23:40] = 1e-40
+        self._both(v, 15)
+
+    def test_k_exceeds_nonzeros(self):
+        v = np.zeros(66_000, np.float32)
+        v[:5] = 2.0
+        self._both(v, 1000)
+
+    def test_env_gate_selects_fused(self, monkeypatch):
+        # the flag must route topk() to the fused path when the pallas
+        # gate is open; observed via a sentinel substituted for the fused
+        # implementation (backend forced "open" the same way)
+        import sys
+
+        tk = sys.modules["commefficient_tpu.ops.topk"]
+        monkeypatch.setenv("COMMEFFICIENT_PALLAS_TOPK", "1")
+        monkeypatch.setattr(tk, "_use_pallas_topk", lambda d: True)
+        hits = []
+
+        def sentinel(v, k, interpret=False):
+            hits.append(k)
+            return tk._topk_threshold_1d(v, k)
+
+        monkeypatch.setattr(tk, "_topk_threshold_1d_fused", sentinel)
+        # the per-pass kernel would not lower on the CPU backend — keep the
+        # routing observable without running either real kernel
+        monkeypatch.setattr(tk, "_topk_threshold_1d_pallas",
+                            lambda v, k, interpret=False:
+                            tk._topk_threshold_1d(v, k))
+        v = jnp.asarray(np.random.RandomState(3).randn(4096), jnp.float32)
+        tk.topk(v, 64)
+        assert not hits  # flag unset -> per-pass path
+        monkeypatch.setenv("COMMEFFICIENT_PALLAS_TOPK_FUSED", "1")
+        tk.topk(v, 64)
+        assert hits == [64]  # flag set -> fused path chosen
+
+    def test_env_gate_closed_on_cpu(self, monkeypatch):
+        from commefficient_tpu.ops.topk import _use_pallas_topk
+
+        monkeypatch.setenv("COMMEFFICIENT_PALLAS_TOPK_FUSED", "1")
+        monkeypatch.setenv("COMMEFFICIENT_PALLAS_TOPK", "1")
+        assert not _use_pallas_topk(1000)  # cpu backend -> off
+
+
 class TestSketchProperties:
     """Property-based checks over random geometries (hypothesis)."""
 
