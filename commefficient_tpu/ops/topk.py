@@ -108,8 +108,8 @@ def _count_ge_pallas(v3, ts, *, T, interpret=False):
     )(ts, v3)
 
 
-@functools.partial(jax.jit, static_argnames=("T", "interpret"))
-def _descent_pallas(v3, kk, *, T, interpret=False):
+@functools.partial(jax.jit, static_argnames=("T", "sub", "interpret"))
+def _descent_pallas(v3, kk, *, T, sub=_SUB, interpret=False):
     """The WHOLE 8-pass radix descent in one ``pallas_call``: grid
     ``(8, T)`` re-streams the vector once per pass while the resolved
     prefix and the 15 running ≥-counts live in SMEM scratch across blocks
@@ -163,7 +163,7 @@ def _descent_pallas(v3, kk, *, T, interpret=False):
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(8, T),
-        in_specs=[pl.BlockSpec((1, _SUB, _LANES), lambda p, t, *_: (t, 0, 0))],
+        in_specs=[pl.BlockSpec((1, sub, _LANES), lambda p, t, *_: (t, 0, 0))],
         out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
         scratch_shapes=[pltpu.SMEM((15,), jnp.int32),
                         pltpu.SMEM((1,), jnp.int32)],
@@ -176,14 +176,14 @@ def _descent_pallas(v3, kk, *, T, interpret=False):
     )(kk, v3)
 
 
-def _blocks3(raw: jax.Array):
+def _blocks3(raw: jax.Array, sub: int = _SUB):
     """Pad the int32 bit patterns with +0.0 (mag 0 never reaches any
-    threshold, all ≥ 1) and reshape to the kernels' ``(T, _SUB, _LANES)``
+    threshold, all ≥ 1) and reshape to the kernels' ``(T, sub, _LANES)``
     block layout."""
     d = raw.shape[0]
-    block = _SUB * _LANES
+    block = sub * _LANES
     T = -(-d // block)
-    return jnp.pad(raw, (0, T * block - d)).reshape(T, _SUB, _LANES), T
+    return jnp.pad(raw, (0, T * block - d)).reshape(T, sub, _LANES), T
 
 
 def _apply_threshold(raw: jax.Array, vec: jax.Array, p) -> jax.Array:
@@ -198,11 +198,18 @@ def _apply_threshold(raw: jax.Array, vec: jax.Array, p) -> jax.Array:
 def _topk_threshold_1d_fused(vec: jax.Array, k: int,
                              interpret: bool = False) -> jax.Array:
     """Descent via the single fused kernel; identical output to the
-    per-pass paths whenever the counts agree (exact integer arithmetic)."""
+    per-pass paths whenever the counts agree (exact integer arithmetic).
+
+    Block sublanes scale up 4x at GPT-2-scale d: the measured round-4
+    loss above ~100M came from the fixed (512, 128) blocking — too many
+    block boundaries for the HBM streams to pipeline across; fewer,
+    larger blocks (1 MiB each, still trivially VMEM-resident
+    double-buffered) is the candidate fix the topk_ab leg decides."""
     raw = vec.view(jnp.int32)
-    v3, T = _blocks3(raw)
+    sub = _SUB if raw.shape[0] <= _PALLAS_TOPK_MAX_D else 4 * _SUB
+    v3, T = _blocks3(raw, sub)
     kk = jnp.asarray([k], jnp.int32)
-    p = _descent_pallas(v3, kk, T=T, interpret=interpret)[0]
+    p = _descent_pallas(v3, kk, T=T, sub=sub, interpret=interpret)[0]
     return _apply_threshold(raw, vec, p)
 
 
@@ -225,6 +232,29 @@ def _topk_threshold_1d_pallas(vec: jax.Array, k: int,
         p = p + (sel << shift)
 
     return _apply_threshold(raw, vec, p)
+
+
+def _select_threshold_impl(d: int):
+    """Pick the threshold-descent implementation for this geometry.
+
+    The fused whole-descent kernel is default OFF until the on-chip A/B
+    (scripts/tpu_measure.py topk_ab) proves it beats the per-pass kernel —
+    the same gate-then-flip playbook as the count-pass kernel. The opt-in
+    flag deliberately bypasses the d ≤ 32M crossover gate: the fused
+    kernel's large-d blocking is exactly what the A/B needs to test at
+    GPT-2 scale."""
+    import os
+
+    from commefficient_tpu.utils import is_tpu_backend
+
+    if os.environ.get("COMMEFFICIENT_PALLAS_TOPK") == "0":
+        return _topk_threshold_1d  # explicit kill-switch beats everything
+    if (os.environ.get("COMMEFFICIENT_PALLAS_TOPK_FUSED") == "1"
+            and is_tpu_backend()):
+        return _topk_threshold_1d_fused
+    if _use_pallas_topk(d):
+        return _topk_threshold_1d_pallas
+    return _topk_threshold_1d
 
 
 def _topk_sort_1d(vec: jax.Array, k: int) -> jax.Array:
@@ -272,18 +302,12 @@ def topk(vec: jax.Array, k: int, method: str = "threshold") -> jax.Array:
     Accepts 1-D ``(d,)`` or 2-D ``(rows, d)`` input (row-wise top-k), mirroring
     reference utils.py:246-252.
     """
-    if method == "threshold" and _use_pallas_topk(vec.shape[-1]):
-        import os
-
-        # fused whole-descent kernel: default OFF until the on-chip A/B
-        # (scripts/tpu_measure.py ops) proves it beats the per-pass kernel
-        # — the same gate-then-flip playbook as the count-pass kernel
-        if os.environ.get("COMMEFFICIENT_PALLAS_TOPK_FUSED") == "1":
-            f = _topk_threshold_1d_fused
-        else:
-            f = _topk_threshold_1d_pallas
+    if method == "threshold":
+        f = _select_threshold_impl(vec.shape[-1])
+    elif method == "sort":
+        f = _topk_sort_1d
     else:
-        f = {"threshold": _topk_threshold_1d, "sort": _topk_sort_1d}[method]
+        raise ValueError(f"unknown topk method {method!r}")
     if vec.ndim == 1:
         return f(vec, k)
     if vec.ndim == 2:

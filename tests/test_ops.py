@@ -452,15 +452,41 @@ class TestTopkFusedDescent:
         v[:5] = 2.0
         self._both(v, 1000)
 
+    def test_large_block_sub_override(self):
+        # the GPT-2-scale path switches to (2048, 128) blocks; drive the
+        # kernel with that sub directly (a real 124M interpret run is
+        # prohibitive) and check the resolved threshold matches XLA
+        from commefficient_tpu.ops.topk import (
+            _apply_threshold,
+            _blocks3,
+            _descent_pallas,
+            _topk_threshold_1d,
+        )
+
+        rng = np.random.RandomState(5)
+        v = jnp.asarray(rng.randn(600_000).astype(np.float32))
+        raw = v.view(jnp.int32)
+        v3, T = _blocks3(raw, 2048)
+        assert T == 3  # exercises multi-block count carry at sub=2048
+        p = _descent_pallas(v3, jnp.asarray([7000], jnp.int32), T=T,
+                            sub=2048, interpret=True)[0]
+        got = np.asarray(_apply_threshold(raw, v, p))
+        want = np.asarray(_topk_threshold_1d(v, 7000))
+        np.testing.assert_array_equal(got, want)
+
     def test_env_gate_selects_fused(self, monkeypatch):
         # the flag must route topk() to the fused path when the pallas
         # gate is open; observed via a sentinel substituted for the fused
         # implementation (backend forced "open" the same way)
         import sys
 
+        import commefficient_tpu.utils as cu
+
         tk = sys.modules["commefficient_tpu.ops.topk"]
         monkeypatch.setenv("COMMEFFICIENT_PALLAS_TOPK", "1")
         monkeypatch.setattr(tk, "_use_pallas_topk", lambda d: True)
+        # the fused branch additionally requires a TPU backend
+        monkeypatch.setattr(cu, "is_tpu_backend", lambda: True)
         hits = []
 
         def sentinel(v, k, interpret=False):
@@ -473,6 +499,7 @@ class TestTopkFusedDescent:
         monkeypatch.setattr(tk, "_topk_threshold_1d_pallas",
                             lambda v, k, interpret=False:
                             tk._topk_threshold_1d(v, k))
+        monkeypatch.delenv("COMMEFFICIENT_PALLAS_TOPK_FUSED", raising=False)
         v = jnp.asarray(np.random.RandomState(3).randn(4096), jnp.float32)
         tk.topk(v, 64)
         assert not hits  # flag unset -> per-pass path
