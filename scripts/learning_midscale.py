@@ -15,8 +15,8 @@ It also re-runs the two single-seed round-4 headline rows at a second seed
 Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python scripts/learning_midscale.py [legs...]
-Legs: mid_sketch mid_uncompressed seed0_5p7 seed1_5p7 seed0_noniid
-seed1_noniid (default: all). Appends each completed leg to
+Legs: mid_sketch mid_uncompressed big_sketch seed0_5p7 seed1_5p7
+seed0_noniid seed1_noniid (default: all). Appends each completed leg to
 docs/learning_midscale.json, so an interrupted sweep resumes by re-running
 with the remaining legs.
 """
@@ -72,10 +72,19 @@ SKETCH_NONIID = ["--mode", "sketch", "--error_type", "virtual",
                  "--k", "3000", "--num_cols", "16384", "--num_rows", "5",
                  "--num_blocks", "2", "--virtual_momentum", "0.9"]
 
+BIG_CHANNELS = "48,96,192,384"  # d = 3,699,504 — over half full geometry
+SKETCH_BIG = ["--mode", "sketch", "--error_type", "virtual",
+              "--k", "25000", "--num_cols", "262144", "--num_rows", "5",
+              "--num_blocks", "8", "--virtual_momentum", "0.9"]
+
 LEGS = {
     # d=912k at genuine 2.78x: 20 epochs, golden-recipe lr shape
     "mid_sketch": (MID_CHANNELS, 20, 3, 0.3, 0,
                    ["--iid", "--num_clients", "16"], SKETCH_MID),
+    # 4th rung: d=3.70M at genuine 2.82x (5x262144 cells, k=25k ≈ 0.68%
+    # of d vs FetchSGD's 0.77%), 16 epochs; largest chip-independent rung
+    "big_sketch": (BIG_CHANNELS, 16, 3, 0.3, 0,
+                   ["--iid", "--num_clients", "16"], SKETCH_BIG),
     "mid_uncompressed": (MID_CHANNELS, 10, 2, 0.15, 0,
                          ["--iid", "--num_clients", "16"], UNCOMP),
     # round-4 headline rows as SELF-CONSISTENT seed pairs: both seeds run
