@@ -15,7 +15,7 @@ It also re-runs the two single-seed round-4 headline rows at a second seed
 Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python scripts/learning_midscale.py [legs...]
-Legs: mid_sketch mid_uncompressed big_sketch seed0_5p7 seed1_5p7
+Legs: mid_sketch mid_uncompressed big_sketch big_uncompressed seed0_5p7 seed1_5p7
 seed0_noniid seed1_noniid (default: all). Appends each completed leg to
 docs/learning_midscale.json, so an interrupted sweep resumes by re-running
 with the remaining legs.
@@ -85,6 +85,9 @@ LEGS = {
     # of d vs FetchSGD's 0.77%), 16 epochs; largest chip-independent rung
     "big_sketch": (BIG_CHANNELS, 16, 3, 0.3, 0,
                    ["--iid", "--num_clients", "16"], SKETCH_BIG),
+    # its within-rung uncompressed anchor (mid-rung epoch ratio: ~half)
+    "big_uncompressed": (BIG_CHANNELS, 8, 2, 0.15, 0,
+                         ["--iid", "--num_clients", "16"], UNCOMP),
     "mid_uncompressed": (MID_CHANNELS, 10, 2, 0.15, 0,
                          ["--iid", "--num_clients", "16"], UNCOMP),
     # round-4 headline rows as SELF-CONSISTENT seed pairs: both seeds run
