@@ -323,10 +323,20 @@ def _time_rounds(steps, ps, server_state, client_states, batch, warmup,
     import jax
     import jax.numpy as jnp
 
+    from commefficient_tpu.profiling import host_sync_monitor
+
     def drain(x):
         # force completion of everything x depends on; tiny D2H transfer
         return float(jnp.asarray(x).ravel()[0])
 
+    layout = getattr(steps, "layout", None)
+    if layout is not None and ps.ndim == 1:
+        # chunked-resident data plane (docs/round_engine.md): convert ONCE
+        # before the loop so the steady state runs with zero per-round
+        # flat<->chunk layout churn — the state the real training loops
+        # (FedModel) keep across rounds
+        ps = layout.chunk(ps)
+        _log(f"{tag}: ps resident in chunk layout {tuple(ps.shape)}")
     state = (ps, server_state, client_states, {})
     rng = jax.random.key(0)
     _log(f"{tag}: compiling + warmup (first jit is the slow part)")
@@ -346,18 +356,25 @@ def _time_rounds(steps, ps, server_state, client_states, batch, warmup,
     _log(f"{tag}: timing {iters} rounds x {reps} reps "
          f"(scalar-drain rtt {rtt * 1e3:.1f} ms)")
     best = float("inf")
+    syncs = 0
     for rep in range(reps):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            out = steps.train_step(state[0], state[1], state[2], state[3],
-                                   batch, 0.1, rng)
-            state = out[:4]
+        # the sync audit (profiling.host_sync_monitor, docs/round_engine.md)
+        # covers the dispatch loop only — the one drain after it is the
+        # deliberate batched fetch
+        with host_sync_monitor() as sync_counter:
+            for _ in range(iters):
+                out = steps.train_step(state[0], state[1], state[2], state[3],
+                                       batch, 0.1, rng)
+                state = out[:4]
+        syncs = sync_counter.count
         drain(state[0])
         dt = max(time.perf_counter() - t0 - rtt, 1e-9)
-        _log(f"{tag} rep {rep + 1}/{reps}: {dt:.3f}s for {iters} rounds")
+        _log(f"{tag} rep {rep + 1}/{reps}: {dt:.3f}s for {iters} rounds "
+             f"({syncs} host syncs in dispatch loop)")
         best = min(best, dt)
     _log(f"{tag} done: best rep {best:.3f}s for {iters} rounds")
-    return best
+    return best, syncs
 
 
 def run_gpt2_measurement(legs=(False, True)) -> None:
@@ -391,14 +408,15 @@ def run_gpt2_measurement(legs=(False, True)) -> None:
         # warmup=1: iter 1 pays the compile; the timed loop subtracts the
         # settled rtt, and best-of-3 reps already absorbs residual warmth.
         # A second warmup iter cost window time the d=124M legs don't have.
-        dt = _time_rounds(steps, ps, server_state, client_states, batch,
-                          warmup=1, iters=n, tag=tag)
-        return tokens, dt
+        dt, syncs = _time_rounds(steps, ps, server_state, client_states,
+                                 batch, warmup=1, iters=n, tag=tag)
+        return tokens, dt, syncs
 
     flops_per_token = gpt2_train_flops_per_token()
     for bf16 in legs:
-        tokens, dt = one_leg(bf16)
+        tokens, dt, syncs = one_leg(bf16)
         key = "gpt2_bf16" if bf16 else "gpt2"
+        out[f"{key}_host_syncs_per_round"] = round(syncs / n, 3)
         tok_per_sec = tokens * n / dt
         tflops = flops_per_token * tok_per_sec / 1e12
         out[f"{key}_tokens_per_sec"] = round(tok_per_sec, 1)
@@ -473,8 +491,8 @@ def run_measurement(tiny: bool) -> None:
     _check_pallas_kernel()
 
     steps, ps, server_state, client_states, batch = build(tiny)
-    dt = _time_rounds(steps, ps, server_state, client_states, batch,
-                      warmup=WARMUP, iters=ITERS, tag="cifar10")
+    dt, syncs = _time_rounds(steps, ps, server_state, client_states, batch,
+                             warmup=WARMUP, iters=ITERS, tag="cifar10")
 
     rounds_per_sec = ITERS / dt
     geom = "tiny-fallback" if tiny else "ResNet9, 8 workers, sketch 5x500k k=50k"

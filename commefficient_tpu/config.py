@@ -111,6 +111,17 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                         help="Mesh size; -1 = all visible JAX devices.")
     parser.add_argument("--share_ps_gpu", action="store_true",
                         help="Unused on TPU (no separate PS device).")
+    # Pipelined round engine (federated/engine.py, docs/round_engine.md):
+    # the training loops dispatch rounds without blocking host transfers,
+    # bound host run-ahead to --round_window dispatched-but-incomplete
+    # rounds, and fetch metrics in batches of --metrics_drain_every.
+    parser.add_argument("--round_window", type=int, default=2,
+                        help="Max rounds dispatched ahead of device "
+                             "completion (pipelined round engine).")
+    parser.add_argument("--metrics_drain_every", type=int, default=8,
+                        help="Fetch per-round metrics in batches of N "
+                             "rounds; 1 restores per-round (blocking) "
+                             "metric fetching.")
     parser.add_argument("--iid", action="store_true", dest="do_iid")
     parser.add_argument("--train_dataloader_workers", type=int, default=0)
     parser.add_argument("--val_dataloader_workers", type=int, default=0)

@@ -3,6 +3,10 @@ from commefficient_tpu.federated.aggregator import (
     FedOptimizer,
     LambdaLR,
 )
+from commefficient_tpu.federated.engine import (
+    PipelinedRoundEngine,
+    RoundResult,
+)
 from commefficient_tpu.federated.checkpoint import (
     load_checkpoint,
     load_matching,
@@ -28,6 +32,8 @@ __all__ = [
     "FedModel",
     "FedOptimizer",
     "LambdaLR",
+    "PipelinedRoundEngine",
+    "RoundResult",
     "load_checkpoint",
     "load_matching",
     "load_run_state",

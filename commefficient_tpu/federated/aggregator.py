@@ -41,7 +41,7 @@ reference's ``maxlen``-clamped deque does for very stale clients.
 from __future__ import annotations
 
 import os
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -68,6 +68,23 @@ from commefficient_tpu.parallel.mesh import default_client_mesh
 DEFAULT_NUM_CLIENTS = {"EMNIST": 3500, "PERSONA": 17568}
 
 
+class RoundHandle(NamedTuple):
+    """A dispatched-but-unfetched training round (federated/engine.py).
+
+    Everything device-side stays device-side: ``metrics`` are the round
+    step's per-slot arrays and ``download`` the deferred accounting value (a
+    scalar popcount in regime (a), per-participant changed-coordinate counts
+    in regime (b)); fetching any of them is the blocking host sync the
+    pipelined engine batches into its every-N drain. ``valid``/
+    ``participating``/``upload`` are host data already."""
+
+    metrics: Tuple[Any, ...]
+    valid: np.ndarray
+    participating: np.ndarray
+    download: Optional[Any]
+    upload: np.ndarray
+
+
 @jax.jit
 def _mark_changed(last_changed, cur, prev, round_idx):
     return jnp.where(cur != prev, round_idx, last_changed)
@@ -75,7 +92,12 @@ def _mark_changed(last_changed, cur, prev, round_idx):
 
 @jax.jit
 def _changed_since_counts(last_changed, since):
-    return jnp.sum(last_changed[None, :] >= since[:, None], axis=1)
+    # last_changed is (d,) flat or (T, S, 128) chunked-resident; padded tail
+    # positions stay at their -1 init (cur == prev == 0 there forever) so
+    # they are never counted against any participant
+    reduce_axes = tuple(range(1, 1 + last_changed.ndim))
+    since = since.reshape((-1,) + (1,) * last_changed.ndim)
+    return jnp.sum(last_changed[None] >= since, axis=reduce_axes)
 
 
 def worker_config_from_args(args, mesh=None) -> WorkerConfig:
@@ -225,6 +247,26 @@ class FedModel:
             compute_loss_train,
             compute_loss_val or compute_loss_train,
             self.unravel, ravel, cfg, sketch=self.sketch, mesh=mesh)
+        # Chunked-resident data plane (rounds.build_round_step): ps_weights
+        # lives in the sketch's (T, S, 128) chunk layout across rounds; the
+        # flat (d,) view exists only transiently at the pytree boundary
+        # (`params`) and in checkpoints of older layouts.
+        self.layout = self.steps.layout
+        if self.layout is not None:
+            self.ps_weights = self.layout.chunk(flat)
+        # Commit PS state to the round step's replicated output sharding UP
+        # FRONT: jit cache keys include argument sharding, and the step's
+        # outputs carry NamedSharding(mesh, P()) while freshly created
+        # arrays default to SingleDeviceSharding — without this, round 1
+        # retraces and recompiles every jitted phase a second time (measured
+        # on the CPU mesh; the zero-syncs audit in tests/test_engine.py
+        # trips on the const materializations of that relowering).
+        self._replicated = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._replicated = NamedSharding(self.mesh, PartitionSpec())
+        self.ps_weights = self._place_replicated(self.ps_weights)
         # per-client state is row-sharded over the clients mesh axis; rows are
         # padded to a multiple of the mesh size so the sharding is even
         # (padded rows are never indexed — client ids < num_clients). When
@@ -279,10 +321,15 @@ class FedModel:
         self._drop_rng = np.random.RandomState(args.seed + 2)
 
         # ---- download-byte tracking (fed_aggregator.py:170-194) ----
+        # accounting state mirrors the resident ps layout (flat or chunked);
+        # chunked-tail positions never change, so they never count
+        acct_shape = (self.layout.shape if self.layout is not None
+                      else (self.grad_size,))
         self._simple_download = (args.num_epochs <= 1
                                  and args.local_batch_size == -1)
         if self._simple_download:
-            self._updated_since_init = jnp.zeros(self.grad_size, bool)
+            self._updated_since_init = self._place_replicated(
+                jnp.zeros(acct_shape, bool))
             self._prev_ps = self.ps_weights
         else:
             # Regime (b), TPU-first: the reference keeps a deque of host
@@ -297,7 +344,8 @@ class FedModel:
             # deque undershoots for clients older than its maxlen (its own
             # documented clamp). One O(d) mask update + one fused
             # multi-threshold count per round, all on device.
-            self._last_changed = jnp.full(self.grad_size, -1, jnp.int32)
+            self._last_changed = self._place_replicated(
+                jnp.full(acct_shape, -1, jnp.int32))
             self._round_idx = 0
             self._prev_ps = self.ps_weights
             self._client_part_round = np.zeros(self.num_clients, np.int64)
@@ -322,6 +370,8 @@ class FedModel:
 
     @property
     def params(self):
+        if self.layout is not None:
+            return self.unravel(self.layout.unchunk(self.ps_weights))
         return self.unravel(self.ps_weights)
 
     def state_dict(self):
@@ -335,11 +385,28 @@ class FedModel:
 
     # -- internals ---------------------------------------------------------
 
+    def _place_replicated(self, x):
+        """Pin a (pytree of) fresh device array(s) to the replicated mesh
+        sharding the jitted round step emits, so steady-state jit cache hits
+        start at round 1 (see the __init__ comment). No-op without a mesh."""
+        if self._replicated is None:
+            return x
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._replicated), x)
+
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
     def _call_train(self, batch: dict):
+        return self.finish_round(self.begin_round(batch))
+
+    def begin_round(self, batch: dict) -> RoundHandle:
+        """Dispatch one training round WITHOUT any blocking host transfer:
+        the client phase is enqueued, per-round metrics and the deferred
+        download accounting stay on device in the returned handle. The
+        pipelined engine (federated/engine.py) dispatches round t+1 before
+        fetching round t's handle; ``finish_round`` materializes one."""
         ids = np.asarray(batch["client_ids"])
         wmask = np.asarray(batch["worker_mask"])
         drop_p = getattr(self.args, "client_dropout", 0.0) or 0.0
@@ -366,7 +433,7 @@ class FedModel:
                 wmask.shape + (1,) * (mask.ndim - 1))).astype(mask.dtype)
         participating = np.unique(ids[wmask > 0])
 
-        download, upload = self._account_bytes(participating)
+        download_dev, upload = self._account_bytes_deferred(participating)
 
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
         lr = self._current_lr()
@@ -384,10 +451,23 @@ class FedModel:
             self.ps_weights, states_in, self._model_state, jbatch,
             lr, self._next_rng())
         self._round_ctx = ctx
+        return RoundHandle(metrics=metrics, valid=wmask > 0,
+                           participating=participating,
+                           download=download_dev, upload=upload)
 
-        *ms, count = (np.asarray(m) for m in metrics)
-        valid = wmask > 0
-        return [m[valid] for m in ms] + [download, upload]
+    def finish_round(self, handle: RoundHandle):
+        """Materialize a dispatched round's results — the ONE blocking host
+        sync of a round, batched by the engine's every-N drain. Returns the
+        reference-shaped list: [loss_arr(, acc_arr, ...), download, upload].
+
+        Fetches go through ``profiling.materialize`` so the host-sync
+        monitor counts them (docs/round_engine.md)."""
+        from commefficient_tpu.profiling import materialize
+
+        *ms, count = (materialize(m) for m in handle.metrics)
+        download = self._materialize_download(handle.participating,
+                                              handle.download)
+        return [m[handle.valid] for m in ms] + [download, handle.upload]
 
     def _apply_server(self, server_state, lr):
         """Phase 2 for FedOptimizer.step(): server rule + state scatter.
@@ -429,9 +509,16 @@ class FedModel:
     def _current_lr(self):
         return getattr(self, "_opt_lr", 1.0)
 
-    def _account_bytes(self, participating):
+    def _account_bytes_deferred(self, participating):
+        """Byte accounting with the host sync removed: all device-side
+        reductions (the popcount / changed-coordinate counts behind the
+        per-round ``convert_reduce`` fusions of the GPT-2 profile) are
+        dispatched but NOT fetched — the returned download value is a device
+        array the caller materializes at drain time
+        (``_materialize_download``). Upload is a host-side constant per
+        mode. State updates (mask fold, round index) happen here so
+        accounting is exact regardless of when the fetch lands."""
         args = self.args
-        download = np.zeros(self.num_clients, np.float64)
         upload = np.zeros(self.num_clients, np.float64)
         upload_per = {
             "uncompressed": self.grad_size,
@@ -446,12 +533,13 @@ class FedModel:
         }[args.mode] * 4
         upload[participating] = upload_per
 
+        download_dev = None
         if self._simple_download:
             diff = self.ps_weights - self._prev_ps
             self._updated_since_init = self._updated_since_init | (diff != 0)
             self._prev_ps = self.ps_weights
-            download[participating] = 4.0 * float(
-                jnp.sum(self._updated_since_init))
+            # scalar popcount, broadcast over participants at materialize
+            download_dev = jnp.sum(self._updated_since_init)
         else:
             # fold the latest server update into the last-changed index
             self._last_changed = _mark_changed(self._last_changed,
@@ -465,10 +553,26 @@ class FedModel:
                 # download, one fused pass for all of them
                 since = jnp.asarray(self._client_part_round[participating],
                                     jnp.int32)
-                counts = _changed_since_counts(self._last_changed, since)
-                download[participating] = 4.0 * np.asarray(counts)
+                download_dev = _changed_since_counts(self._last_changed,
+                                                     since)
             self._client_part_round[participating] = self._round_idx
-        return download, upload
+        return download_dev, upload
+
+    def _materialize_download(self, participating, download_dev):
+        """Deferred download counts → the (num_clients,) byte array. The
+        fetch here is the blocking transfer the engine batches."""
+        from commefficient_tpu.profiling import materialize
+
+        download = np.zeros(self.num_clients, np.float64)
+        if download_dev is not None and len(participating):
+            download[participating] = 4.0 * materialize(download_dev)
+        return download
+
+    def _account_bytes(self, participating):
+        """Synchronous accounting (dispatch + immediate materialize) — the
+        accounting tests' direct entry point."""
+        download_dev, upload = self._account_bytes_deferred(participating)
+        return self._materialize_download(participating, download_dev), upload
 
 
 class FedOptimizer:
@@ -485,8 +589,12 @@ class FedOptimizer:
         self.args = args
         self.param_groups = param_groups or [(None, 1.0)]
         self._lr_factor = 0.0
-        self.server_state = init_server_state(fed_model.server_config,
-                                              fed_model.sketch)
+        # placed on the round step's replicated sharding for the same
+        # round-1 retrace reason as FedModel's PS state; device_put creates
+        # a distinct buffer per leaf, preserving the donation-safety split
+        # of init_server_state
+        self.server_state = fed_model._place_replicated(
+            init_server_state(fed_model.server_config, fed_model.sketch))
         self._base_lr_vec = None
         if len(self.param_groups) > 1 or self.param_groups[0][0] is not None:
             vec = np.zeros(fed_model.grad_size, np.float32)
@@ -496,6 +604,11 @@ class FedOptimizer:
                 else:
                     vec[np.asarray(mask)] = base
             self._base_lr_vec = jnp.asarray(vec)
+            if fed_model.layout is not None:
+                # per-coordinate LR rides the chunked resident layout like
+                # every other (d,)-shaped server value (zero tail: padded
+                # coordinates never receive an update)
+                self._base_lr_vec = fed_model.layout.chunk(self._base_lr_vec)
 
     def get_lr(self):
         # scalar if single default group, else per-coordinate vector

@@ -70,7 +70,15 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
     download-accounting state, and byte totals. One ``.npz``, plain numpy.
     """
     fm = fed_model
-    arrays = {"ps_weights": np.asarray(fm.ps_weights)}
+    layout = getattr(fm, "layout", None)
+
+    def canon(arr):
+        # checkpoints store the layout-independent flat (d,) view so a run
+        # with the chunked-resident data plane (federated/rounds.py) and a
+        # pre-chunking run can restore each other's checkpoints
+        return np.asarray(layout.unchunk(arr) if layout is not None else arr)
+
+    arrays = {"ps_weights": canon(fm.ps_weights)}
     for name in ("velocities", "errors", "weights"):
         arr = getattr(fm.client_states, name)
         if arr is not None:
@@ -90,9 +98,9 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
             [d_pos, d_gauss], np.int64)
         arrays["drop_rng/cached"] = np.asarray([d_cached], np.float64)
     if fm._simple_download:
-        arrays["acct/updated_since_init"] = np.asarray(fm._updated_since_init)
+        arrays["acct/updated_since_init"] = canon(fm._updated_since_init)
     else:
-        arrays["acct/last_changed"] = np.asarray(fm._last_changed)
+        arrays["acct/last_changed"] = canon(fm._last_changed)
         arrays["acct/client_part_round"] = fm._client_part_round
     meta = {
         "next_epoch": int(next_epoch),
@@ -151,13 +159,37 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
             f"this run expects {want} — was the checkpoint written with a "
             f"different model/sketch geometry or --mode?")
 
-    check_shape("ps_weights", flat["ps_weights"].shape, fm.ps_weights.shape)
+    layout = getattr(fm, "layout", None)
+    check_shape("ps_weights", flat["ps_weights"].shape, (fm.grad_size,))
     check_shape("server velocity", flat["server/velocity"].shape,
                 tuple(optimizer.server_state.velocity.shape))
     check_shape("server error", flat["server/error"].shape,
                 tuple(optimizer.server_state.error.shape))
 
-    fm.ps_weights = jnp.asarray(flat["ps_weights"])
+    def place(x):
+        # restored arrays re-commit to the round step's replicated sharding
+        # (FedModel._place_replicated) so the first post-resume round hits
+        # the jit cache instead of retracing — same round-1 hazard the
+        # aggregator fixes at init
+        placer = getattr(fm, "_place_replicated", None)
+        return placer(x) if placer is not None else x
+
+    def resident(arr, tail_fill=None):
+        # checkpoints store the flat (d,) view (see save_run_state); a
+        # chunked-resident run re-chunks on restore. tail_fill overrides the
+        # zero padding where the tail invariant is not zero (last_changed
+        # keeps its -1 never-touched sentinel so tail positions are never
+        # counted against a round-0 participant).
+        a = jnp.asarray(arr)
+        if layout is None:
+            return place(a)
+        c = layout.chunk(a)
+        if tail_fill is not None:
+            c = jnp.where(layout.flat_index() < layout.d, c,
+                          jnp.asarray(tail_fill, c.dtype))
+        return place(c)
+
+    fm.ps_weights = resident(flat["ps_weights"])
     cs = {}
     for name in ("velocities", "errors", "weights"):
         key = "client/" + name
@@ -194,8 +226,8 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
     from commefficient_tpu.federated.server import ServerState
 
     optimizer.server_state = ServerState(
-        velocity=jnp.asarray(flat["server/velocity"]),
-        error=jnp.asarray(flat["server/error"]))
+        velocity=place(jnp.asarray(flat["server/velocity"])),
+        error=place(jnp.asarray(flat["server/error"])))
 
     np_meta = meta["np_rng"]
     np.random.set_state((np_meta["name"], flat["np_rng/keys"],
@@ -207,9 +239,9 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
                                 d_pos, d_gauss,
                                 float(flat["drop_rng/cached"][0])))
     if fm._simple_download:
-        fm._updated_since_init = jnp.asarray(flat["acct/updated_since_init"])
+        fm._updated_since_init = resident(flat["acct/updated_since_init"])
     else:
-        fm._last_changed = jnp.asarray(flat["acct/last_changed"])
+        fm._last_changed = resident(flat["acct/last_changed"], tail_fill=-1)
         fm._client_part_round = np.asarray(flat["acct/client_part_round"])
         fm._round_idx = meta["round_idx"]
     fm._prev_ps = fm.ps_weights

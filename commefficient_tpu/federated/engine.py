@@ -1,0 +1,121 @@
+"""Pipelined round engine: host-sync-free steady-state federated rounds.
+
+The GPT-2 per-op profile (docs/measurements/tpu_profile_gpt2.md) measured
+337 ms wall per round against 69 ms of device-busy time — ~80% of every
+round was host dispatch and blocking scalar drains, because the reference
+loop shape (cv_train.py / gpt2_train.py)
+
+    lr_scheduler.step(); loss, ... = model(batch); opt.step()
+
+forces a device→host fetch of every round's metrics before the next round
+may be dispatched. Nothing in the round's *math* requires that: round t+1
+consumes round t's device arrays (weights, momentum, error), never its
+fetched values. This engine restructures the loop around that fact:
+
+- ``submit(batch)`` dispatches one full round (LR step, client phase,
+  server phase) with ZERO blocking host transfers — the per-round metrics
+  and the deferred download accounting stay on device inside a
+  ``RoundHandle`` (aggregator.begin_round);
+- dispatched-but-unfetched handles accumulate in a device-side buffer that
+  is drained every ``drain_every`` rounds (or on ``drain()``/``close()``):
+  one batched materialization instead of one sync per round. Drained
+  values are identical to per-round fetching — pinned by
+  tests/test_engine.py;
+- host run-ahead is bounded by ``window``: before dispatching round t the
+  engine waits for round ``t - window``'s COMPUTATION to complete
+  (``jax.block_until_ready`` — a completion wait, not a transfer, so it
+  does not count as a host sync). Without the bound the host can enqueue
+  unboundedly far ahead of the device (50+ unsynced steps were observed to
+  wedge the bench tunnel, bench.py).
+
+The zero-syncs-per-round invariant is auditable: wrap the submit loop in
+``profiling.host_sync_monitor`` and assert ``counter.count == 0`` (the
+engine's own drains go through the counted ``profiling.materialize``
+seam). ``bench.py`` reports the measured count per round.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Deque, List, NamedTuple, Tuple
+
+import jax
+
+__all__ = ["RoundResult", "PipelinedRoundEngine"]
+
+
+class RoundResult(NamedTuple):
+    """One finished round: ``index`` is the submit order (0-based within
+    the engine's lifetime), ``values`` the reference-shaped result list
+    ``[loss_arr(, acc_arr, ...), download_bytes, upload_bytes]`` that
+    ``model(batch)`` used to return synchronously."""
+
+    index: int
+    values: List[Any]
+
+
+class PipelinedRoundEngine:
+    """Drives ``FedModel`` + ``FedOptimizer`` (+ optional LR scheduler)
+    with round pipelining and batched metric drains.
+
+    One ``submit(batch)`` replaces the reference loop body
+    ``lr_scheduler.step(); model(batch); opt.step()`` and returns the list
+    of rounds drained by this call — empty most rounds, ``drain_every``
+    results at once on drain rounds, always in submit order. Call
+    ``drain()`` after the loop (and before reading ``model.params`` for
+    checkpoints — dispatched rounds are already part of the device-side
+    weights, so this is only about collecting their metrics).
+
+    ``drain_every=1`` degenerates to the reference's per-round fetching,
+    which is what the parity test pins against.
+    """
+
+    def __init__(self, model, opt, lr_scheduler=None, window: int = 2,
+                 drain_every: int = 8):
+        assert window >= 1, "in-flight window must be at least 1"
+        assert drain_every >= 1, "drain_every must be at least 1"
+        self.model = model
+        self.opt = opt
+        self.lr_scheduler = lr_scheduler
+        self.window = window
+        self.drain_every = drain_every
+        self._pending: Deque[Tuple[int, Any]] = deque()
+        self._next_index = 0
+        self.rounds_submitted = 0
+        self.drains = 0
+
+    def submit(self, batch) -> List[RoundResult]:
+        """Dispatch one training round; no blocking host transfer happens
+        here unless this is a drain round (every ``drain_every``-th)."""
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        handle = self.model.begin_round(batch)
+        self.opt.step()
+        self._pending.append((self._next_index, handle))
+        self._next_index += 1
+        self.rounds_submitted += 1
+
+        if len(self._pending) > self.window:
+            # bound host run-ahead: wait for the computation of the round
+            # `window` back — completion only, its values stay on device
+            _, old = self._pending[-1 - self.window]
+            jax.block_until_ready(old.metrics)
+
+        if len(self._pending) >= self.drain_every:
+            return self.drain()
+        return []
+
+    def drain(self) -> List[RoundResult]:
+        """Materialize every dispatched-but-unfetched round, oldest first —
+        the batched host sync. Safe to call with nothing pending."""
+        results = []
+        while self._pending:
+            idx, handle = self._pending.popleft()
+            results.append(RoundResult(idx, self.model.finish_round(handle)))
+        if results:
+            self.drains += 1
+        return results
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)

@@ -52,6 +52,21 @@ def _host_ctx(enabled: bool):
     return compute_on("device_host") if enabled else nullcontext()
 
 
+def _supported_kind(mesh: Mesh, kind: str) -> str:
+    """Degrade a memory kind to the device's default when the backend does
+    not expose it — the module-docstring fallback made real: CPU devices
+    (jax 0.4.x) address only ``unpinned_host``, so asking for ``device`` /
+    ``pinned_host`` placements there is a hard error rather than a no-op."""
+    dev = mesh.devices.flat[0]
+    try:
+        kinds = {m.kind for m in dev.addressable_memories()}
+        if kind in kinds:
+            return kind
+        return dev.default_memory().kind
+    except Exception:  # very old jaxlib without the memories API
+        return kind
+
+
 class RowStreamer:
     """Builds the host-gather / host-scatter jits for one state geometry.
 
@@ -65,8 +80,11 @@ class RowStreamer:
                  host_compute: bool):
         self.host_compute = host_compute
         if mesh is not None:
-            rows_dev = NamedSharding(mesh, P("clients"), memory_kind="device")
-            ids_kind = "pinned_host" if host_compute else "device"
+            rows_dev = NamedSharding(mesh, P("clients"),
+                                     memory_kind=_supported_kind(
+                                         mesh, "device"))
+            ids_kind = _supported_kind(
+                mesh, "pinned_host" if host_compute else "device")
             self._ids_sharding = NamedSharding(mesh, P(),
                                                memory_kind=ids_kind)
         else:
@@ -90,7 +108,8 @@ class RowStreamer:
             out_shardings=state_sharding) if state_sharding is not None \
             else jax.jit(scatter, donate_argnums=(0,))
         self._rows_host = (NamedSharding(mesh, P("clients"),
-                                         memory_kind="pinned_host")
+                                         memory_kind=_supported_kind(
+                                             mesh, "pinned_host"))
                            if mesh is not None and host_compute else None)
 
     def _place_ids(self, ids):

@@ -48,7 +48,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from commefficient_tpu.compat import shard_map
 
 from commefficient_tpu.federated.server import (
     ServerConfig,
@@ -66,7 +66,11 @@ from commefficient_tpu.federated.worker import (
     probe_n_metrics,
     split_microbatches,
 )
-from commefficient_tpu.ops.sketch import CountSketch, sketch_vec
+from commefficient_tpu.ops.sketch import (
+    CountSketch,
+    sketch_chunks,
+    sketch_vec,
+)
 
 
 class ClientStates(NamedTuple):
@@ -151,6 +155,18 @@ class RoundConfig:
     # 1/ne on the router and every dense param). Required when
     # worker.expert_axis is set.
     ep_sliced: Optional[Callable[[str], bool]] = None
+    # Chunked-resident data plane: None = auto (on for sketch mode without
+    # topk-down stale weights), True/False forces it. When on, the round
+    # step's ps_weights argument/result live in the sketch's (T, S, 128)
+    # chunk layout (ops/flat.ChunkLayout, exposed as FederatedSteps.layout)
+    # so the sketch kernels consume PS state with no per-round pad/reshape
+    # churn; per-param pytrees materialize only at the model boundary.
+    chunked_resident: Optional[bool] = None
+    # Buffer donation through the jitted steps (ps_weights, client states,
+    # and — where the server rule cannot alias two outputs to one buffer —
+    # the server velocity/error). False pins the copying path; the
+    # donation-parity test uses it to show results are bit-identical.
+    donate: bool = True
 
 
 class FederatedSteps(NamedTuple):
@@ -158,6 +174,9 @@ class FederatedSteps(NamedTuple):
     client_step: Callable  # phase 1: gradients + client state rows
     server_step: Callable  # phase 2: server rule + state scatter
     val_step: Callable
+    # ops/flat.ChunkLayout of the resident ps_weights when the chunked data
+    # plane is on, else None (callers convert flat vectors at this boundary)
+    layout: Optional[Any] = None
 
 
 def build_round_step(
@@ -171,6 +190,42 @@ def build_round_step(
     axis: str = "clients",
 ) -> FederatedSteps:
     wcfg, scfg = cfg.worker, cfg.server
+
+    # Chunked-resident data plane: ps_weights (and every dense (d,)-shaped
+    # value of the server phase — unsketched update, per-coordinate lr) stay
+    # in the sketch's lane-aligned (T, S, 128) chunk layout across rounds, so
+    # sketch_chunks/estimates_chunks consume and produce PS state directly
+    # and the per-round flat↔chunk conversions (the pad/reshape/concatenate
+    # data movement measured at ~7 ms/round busy on GPT-2,
+    # docs/measurements/tpu_profile_gpt2.md) drop out of the steady state.
+    # The flat view materializes only inside `unravel_res` at the model
+    # (pytree) boundary. topk-down is excluded: its stale-weight
+    # reconstruction math lives on (num_clients, d) dense rows.
+    chunked = cfg.chunked_resident
+    if chunked is None:
+        chunked = (wcfg.mode == "sketch" and sketch is not None
+                   and not wcfg.do_topk_down)
+    if chunked:
+        assert wcfg.mode == "sketch" and sketch is not None, \
+            "chunked_resident requires sketch mode (the layout is the " \
+            "sketch kernels' chunk geometry)"
+        assert not wcfg.do_topk_down, \
+            "chunked_resident is incompatible with --topk_down stale weights"
+    layout = sketch.chunk_layout if chunked else None
+
+    def unravel_res(w):
+        """Resident weights → parameter pytree (the one flat materialization
+        of a chunked round, at the model boundary)."""
+        return unravel(layout.unchunk(w)) if chunked else unravel(w)
+
+    def _to_resident(w):
+        """Normalize ps_weights to the step's resident layout. A chunked
+        round accepts a legacy flat ``(d,)`` vector too (tests, bench, and
+        scripts that predate the chunked data plane): the conversion is pure
+        layout, so results are identical — but a flat caller pays the
+        per-round chunk/unchunk churn the resident path exists to avoid.
+        Shape is static under jit, so the branch retraces, never re-checks."""
+        return layout.chunk(w) if (chunked and w.ndim == 1) else w
 
     # Sketch-after-sum fusion: count-sketches are linear, so when nothing
     # nonlinear touches the per-client table — no sketch-space client state
@@ -254,6 +309,14 @@ def build_round_step(
         # the expert psum x ep_scale reconciles the expert slices)
         ep_scale = _flat_scale(wcfg.expert_axis, cfg.ep_sliced, "ep_sliced")
 
+    # fused-path copies of the rescale masks in the resident layout (the
+    # fused gradient sum is chunked there; the per-client worker path keeps
+    # the flat masks). Zero tail x zero gradient tail stays zero.
+    tp_scale_res = layout.chunk(tp_scale) if (chunked and tp_scale is not None) \
+        else tp_scale
+    ep_scale_res = layout.chunk(ep_scale) if (chunked and ep_scale is not None) \
+        else ep_scale
+
     # Pipeline parallelism (parallel/pipeline.py): the loss callbacks carry
     # the GPipe schedule; the round only needs the one-gradient psum over
     # the stage axis (see worker.WorkerConfig.pp_axis). Composes with seq
@@ -278,7 +341,7 @@ def build_round_step(
             lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), model_state)
 
         def step_loss(w_flat, mstates, micro, subs):
-            params = unravel(w_flat)
+            params = unravel_res(w_flat)
 
             def per_client(ms, b, r):
                 return compute_loss_train(params, ms, b, r, True)
@@ -291,7 +354,7 @@ def build_round_step(
         grad_fn = jax.value_and_grad(step_loss, has_aux=True)
 
         n_metrics = probe_n_metrics(
-            compute_loss_train, unravel(ps_weights), model_state,
+            compute_loss_train, unravel_res(ps_weights), model_state,
             jax.tree_util.tree_map(lambda x: x[0, 0], stacked))
 
         def body(carry, micro):
@@ -316,13 +379,13 @@ def build_round_step(
             g_sum = jax.lax.psum(g_sum, wcfg.seq_axis)
         if wcfg.model_axis is not None:
             # reconcile sliced/replicated segments (see worker.forward_grad)
-            g_sum = jax.lax.psum(g_sum, wcfg.model_axis) * tp_scale
+            g_sum = jax.lax.psum(g_sum, wcfg.model_axis) * tp_scale_res
         if wcfg.pp_axis is not None:
             # disjoint stage-local gradient segments -> full gradient
             g_sum = jax.lax.psum(g_sum, wcfg.pp_axis)
         if wcfg.expert_axis is not None:
             # expert-sliced/replicated reconciliation (see worker.forward_grad)
-            g_sum = jax.lax.psum(g_sum, wcfg.expert_axis) * ep_scale
+            g_sum = jax.lax.psum(g_sum, wcfg.expert_axis) * ep_scale_res
         if wcfg.weight_decay != 0:
             # per-client (wd/num_workers)·w scaled by the client's datum
             # count (worker.forward_grad + local_step ×count)
@@ -394,7 +457,11 @@ def build_round_step(
             # placeholder rows pass through untouched
             new_vel, new_err = vel_rows, err_rows
         else:
-            f = partial(one_client, ps_weights)
+            # per-client path: the worker math (local_step/fedavg_local)
+            # runs on the flat vector; a chunked round materializes the
+            # flat view once per round here (the model boundary)
+            ps_flat = layout.unchunk(ps_weights) if chunked else ps_weights
+            f = partial(one_client, ps_flat)
             transmit, new_vel, new_err, new_ms, metrics = jax.vmap(
                 f, in_axes=(0, 0, 0, None, 0, None, 0, 0),
                 out_axes=(0, 0, 0, 0, 0),
@@ -404,8 +471,12 @@ def build_round_step(
         if sketch_after_sum:
             # one sketch of the shard's dense gradient sum (see fusion note
             # above); the psum then rides the small (r, c_pad) table exactly
-            # as the per-client path would
-            local_sum = sketch_vec(sketch, local_sum)
+            # as the per-client path would. The fused chunked gradient is
+            # already in the kernel's (T, S, 128) layout — no pad/reshape.
+            if chunked and fused_grad:
+                local_sum = sketch_chunks(sketch, local_sum)
+            else:
+                local_sum = sketch_vec(sketch, local_sum)
         if mesh is not None:
             total = jax.lax.psum(local_sum, axis)
         else:
@@ -474,6 +545,7 @@ def build_round_step(
 
     def client_step(ps_weights, client_states: ClientStates, model_state,
                     batch, lr, rng):
+        ps_weights = _to_resident(ps_weights)
         ids = batch["client_ids"]
         W = ids.shape[0]
         worker_mask = batch["worker_mask"]
@@ -502,12 +574,18 @@ def build_round_step(
 
     def server_step(ps_weights, server_state: ServerState,
                     client_states: ClientStates, ctx: RoundContext, lr, rng):
+        flat_caller = chunked and ps_weights.ndim == 1
+        ps_weights = _to_resident(ps_weights)
+        if chunked and jnp.ndim(lr) == 1:
+            # per-coordinate LR from a legacy flat caller rides the resident
+            # layout like every other (d,)-shaped server value
+            lr = layout.chunk(lr)
         # fedavg applies lr on-worker; server sees lr=1
         # (reference fed_aggregator.py:441-451)
         eff_lr = 1.0 if wcfg.mode == "fedavg" else lr
         update, new_server_state = server_update(ctx.gradient, server_state,
                                                  scfg, eff_lr, sketch=sketch,
-                                                 rng=rng)
+                                                 rng=rng, layout=layout)
         new_ps = ps_weights - update
 
         ids = ctx.ids
@@ -527,7 +605,8 @@ def build_round_step(
         if wcfg.mode == "true_topk" and wcfg.local_momentum > 0:
             keep_vel = (update == 0).astype(jnp.float32)[None, :]
         elif wcfg.mode == "sketch" and (wcfg.has_velocity or wcfg.has_error):
-            cell_keep = (sketch_vec(sketch, update) == 0).astype(
+            resketch = sketch_chunks if chunked else sketch_vec
+            cell_keep = (resketch(sketch, update) == 0).astype(
                 jnp.float32)[None]
             keep_vel = keep_err = cell_keep
 
@@ -563,12 +642,16 @@ def build_round_step(
             w = ctx.wmask.reshape(-1, 1)
             cs = cs._replace(weights=cs.weights.at[ids].add(
                 (used - ctx.stale_rows) * w))
+        if flat_caller:
+            new_ps = layout.unchunk(new_ps)
         return new_ps, new_server_state, cs
 
     # ---- fused round (bench / dry-run path) ----------------------------
 
     def train_step(ps_weights, server_state, client_states, model_state,
                    batch, lr, rng):
+        flat_caller = chunked and ps_weights.ndim == 1
+        ps_weights = _to_resident(ps_weights)
         rng, sub = jax.random.split(rng)
         ctx, new_model_state, metrics = client_step(ps_weights, client_states,
                                                     model_state, batch, lr,
@@ -576,12 +659,15 @@ def build_round_step(
         new_ps, new_server_state, cs = server_step(ps_weights, server_state,
                                                    client_states, ctx, lr,
                                                    sub)
+        if flat_caller:
+            new_ps = layout.unchunk(new_ps)
         return new_ps, new_server_state, cs, new_model_state, metrics
 
     def val_step(ps_weights, model_state, batch):
         def _val(w, ms, b):
+            w_flat = layout.unchunk(w) if (chunked and w.ndim != 1) else w
             _, metrics, _, _ = forward_grad(
-                compute_loss_val, w, unravel, ravel, ms, b,
+                compute_loss_val, w_flat, unravel, ravel, ms, b,
                 jax.random.key(0), wcfg, sketch, compute_grad=False)
             return metrics
 
@@ -608,19 +694,33 @@ def build_round_step(
             return sharded(ps_weights, model_state, batch)
         return _val(ps_weights, model_state, batch)
 
-    # Donation keeps the dominant state — the (num_clients, d) per-client
-    # velocity/error/weight arrays — in place across rounds instead of
-    # copying on every scatter-update. Only client_states (and ps_weights in
-    # the fused step) are donated: they are uniquely owned by the caller and
-    # rebound immediately. server_state / ctx are NOT donated — XLA may alias
-    # identical outputs (e.g. two all-zero state tensors) to one buffer, and
-    # donating two aliases of the same buffer is an execute-time error;
-    # ps_weights in server_step is also kept because the aggregator's
-    # download accounting holds references to past weight snapshots
-    # (fed_aggregator.py:178-194 semantics).
+    # Donation keeps PS state in place across rounds instead of copying the
+    # d-sized (124M-element on GPT-2) buffers every round:
+    #   - ps_weights and the (num_clients, ·) client velocity/error/weight
+    #     arrays are donated in the fused step — uniquely owned by the
+    #     caller and rebound immediately;
+    #   - the server (velocity, error) state is donated whenever the server
+    #     rule cannot return two outputs backed by ONE buffer. Sketch mode
+    #     with LOCAL error reassigns error = velocity AFTER the cell_nz
+    #     masking (the torch aliasing of reference fed_aggregator.py:580) —
+    #     two outputs aliasing a single buffer while two donated inputs
+    #     stand by is an execute-time error, so that config keeps the
+    #     copying path. error_type "none" is safe: its returned error is
+    #     the PRE-mask velocity, a distinct value from the masked one;
+    #   - ctx is never donated (same identical-outputs hazard on the
+    #     passthrough rows), and ps_weights in the two-phase server_step is
+    #     kept because the aggregator's download accounting holds references
+    #     to past weight snapshots (fed_aggregator.py:178-194 semantics).
+    # cfg.donate=False disables all of it — the donation-parity test pins
+    # bit-identical results between the two.
+    donate_ss = cfg.donate and not (
+        scfg.mode == "sketch" and scfg.error_type == "local")
+    train_donate = ((0, 1, 2) if donate_ss else (0, 2)) if cfg.donate else ()
+    server_donate = ((1, 2) if donate_ss else (2,)) if cfg.donate else ()
     return FederatedSteps(
-        train_step=jax.jit(train_step, donate_argnums=(0, 2)),
+        train_step=jax.jit(train_step, donate_argnums=train_donate),
         client_step=jax.jit(client_step),
-        server_step=jax.jit(server_step, donate_argnums=(2,)),
+        server_step=jax.jit(server_step, donate_argnums=server_donate),
         val_step=jax.jit(val_step),
+        layout=layout,
     )

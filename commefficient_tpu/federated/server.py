@@ -35,7 +35,14 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from commefficient_tpu.ops.sketch import CountSketch, sketch_vec, unsketch
+from commefficient_tpu.ops.flat import ChunkLayout
+from commefficient_tpu.ops.sketch import (
+    CountSketch,
+    sketch_chunks,
+    sketch_vec,
+    unsketch,
+    unsketch_chunks,
+)
 from commefficient_tpu.ops.topk import topk
 
 MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
@@ -93,8 +100,12 @@ def init_server_state(cfg: ServerConfig, sketch: Optional[CountSketch] = None) -
         shape = sketch.table_shape
     else:
         shape = (cfg.grad_size,)
-    z = jnp.zeros(shape, jnp.float32)
-    return ServerState(velocity=z, error=z)
+    # Two separate zeros computations, NOT one shared array: the round step
+    # donates server_state (rounds.build_round_step), and donating a pytree
+    # whose two leaves share one buffer is an execute-time error
+    # ("attempt to donate the same buffer twice").
+    return ServerState(velocity=jnp.zeros(shape, jnp.float32),
+                       error=jnp.zeros(shape, jnp.float32))
 
 
 def server_update(
@@ -104,6 +115,7 @@ def server_update(
     lr,
     sketch: Optional[CountSketch] = None,
     rng: Optional[jax.Array] = None,
+    layout: Optional[ChunkLayout] = None,
 ) -> Tuple[jax.Array, ServerState]:
     """One server step: aggregated (possibly compressed) round gradient →
     (dense weight update, new state).
@@ -113,6 +125,12 @@ def server_update(
     for local_topk, or an ``(r, c)`` sketch table for sketch mode.
     ``lr`` may be a scalar or a per-coordinate ``(d,)`` vector (per-param-group
     LRs, reference fed_aggregator.py:411-427).
+
+    ``layout`` (sketch mode only) selects the **chunked-resident** server
+    phase: the returned update is in the ``(T, S, 128)`` chunk layout —
+    unsketch/top-k/re-sketch run without a flat-layout materialization
+    (docs/round_engine.md). A vector ``lr`` must then be in the same chunked
+    layout (zero tail). Values are identical to the flat path.
     """
     helper = {
         "fedavg": _fedavg,
@@ -122,7 +140,8 @@ def server_update(
         "sketch": _sketched,
     }[cfg.mode]
     if cfg.mode == "sketch":
-        return helper(gradient, state, cfg, lr, sketch)
+        return helper(gradient, state, cfg, lr, sketch, layout)
+    assert layout is None, "chunked-resident layout is sketch-mode only"
     if cfg.mode == "uncompressed":
         return helper(gradient, state, cfg, lr, rng)
     return helper(gradient, state, cfg, lr)
@@ -165,7 +184,8 @@ def _local_topk(local_topk_grad, state, cfg, lr):
     return velocity * lr, ServerState(velocity, state.error)
 
 
-def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
+def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch,
+              layout: Optional[ChunkLayout] = None):
     velocity = sketched_grad + cfg.virtual_momentum * state.velocity
     if cfg.error_type == "local":
         error = velocity
@@ -174,11 +194,20 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
     else:  # "none": deviation — unsketch the velocity (see module docstring)
         error = velocity
 
-    update = unsketch(sketch, error, cfg.k)
+    # chunked-resident: top-k'd estimates stay in the (T, S, 128) layout and
+    # re-sketch without the pad/reshape round trip; same values as the flat
+    # path (the chunking is pure layout, the threshold descent counts over
+    # the same coordinates)
+    if layout is not None:
+        update = unsketch_chunks(sketch, error, cfg.k)
+        sketched_update = sketch_chunks(sketch, update)
+    else:
+        update = unsketch(sketch, error, cfg.k)
 
-    # re-sketch the dense update; its nonzero cells are where error feedback
-    # and momentum masking happen (reference fed_aggregator.py:592-611)
-    sketched_update = sketch_vec(sketch, update)
+        # re-sketch the dense update; its nonzero cells are where error
+        # feedback and momentum masking happen (reference
+        # fed_aggregator.py:592-611)
+        sketched_update = sketch_vec(sketch, update)
     cell_nz = sketched_update != 0
     if cfg.error_type == "virtual":
         error = jnp.where(cell_nz, 0.0, error)

@@ -1,4 +1,4 @@
-"""Flat-parameter-vector plumbing.
+"""Flat-parameter-vector plumbing and the chunked resident layout.
 
 The reference keeps the authoritative model as a flat float vector and
 scatters/gathers it into the torch module per step (``get_param_vec`` /
@@ -6,19 +6,84 @@ scatters/gathers it into the torch module per step (``get_param_vec`` /
 equivalent is ``jax.flatten_util.ravel_pytree``: ravel once at init to obtain
 the flat vector and a closed-over ``unravel`` function; the forward pass
 unravels under jit, where XLA turns the reshape/slice into free views.
+
+``ChunkLayout`` is the **chunked resident layout** for sketch-mode rounds:
+the lane-aligned ``(T, S, 128)`` chunk/sublane/lane shape the count-sketch
+kernels consume (ops/sketch.py). The GPT-2 per-op profile
+(docs/measurements/tpu_profile_gpt2.md) showed ~7 ms/round of pure layout
+churn converting the d=124M flat vector to and from this shape
+(``pad.6``/``reshape.950``/``reshape.2197``) plus the flat ravel concat
+(``concatenate.35``); keeping PS state resident in the chunked shape
+end-to-end makes those per-round conversions disappear — the flat view is
+materialized only at the model (pytree) boundary. Invariant: a resident
+chunked array carries **zeros in its padded tail** (coordinates ≥ d); every
+linear op preserves it, and the one nonlinear producer (sketch ``estimates``,
+whose tail cells are hash noise) is masked by ``mask_tail`` before re-entering
+the resident data plane.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree as _ravel_pytree
 
+LANES = 128
+
 
 def ravel_pytree(params: Any) -> Tuple[jax.Array, Callable[[jax.Array], Any]]:
     """Flatten a parameter pytree into a float32 vector + unravel closure."""
     flat, unravel = _ravel_pytree(params)
     return flat.astype(jnp.float32), unravel
+
+
+@dataclass(frozen=True)
+class ChunkLayout:
+    """Geometry of the ``(T, S, 128)`` chunked resident layout of a
+    ``(d,)`` vector: T chunks of S sublanes x 128 lanes, zero-padded tail."""
+
+    d: int
+    T: int
+    S: int
+
+    @property
+    def shape(self) -> Tuple[int, int, int]:
+        return (self.T, self.S, LANES)
+
+    @property
+    def padded_size(self) -> int:
+        return self.T * self.S * LANES
+
+    def chunk(self, v: jax.Array) -> jax.Array:
+        """``(d,)`` → ``(T, S, 128)`` with a zero tail (dtype-preserving —
+        the resident plane also carries bool/int32 accounting arrays)."""
+        assert v.shape == (self.d,), (v.shape, self.d)
+        v = jnp.asarray(v)
+        v_p = jnp.pad(v, (0, self.padded_size - self.d))
+        return v_p.reshape(self.shape)
+
+    def unchunk(self, c3: jax.Array) -> jax.Array:
+        """``(T, S, 128)`` → ``(d,)`` (drops the padded tail)."""
+        assert c3.shape == self.shape, (c3.shape, self.shape)
+        return c3.reshape(self.padded_size)[: self.d]
+
+    def mask_tail(self, c3: jax.Array) -> jax.Array:
+        """Zero the padded-tail positions (coordinates ≥ d) — restores the
+        resident-layout invariant after a nonlinear producer."""
+        if self.padded_size == self.d:
+            return c3
+        idx = self.flat_index()
+        return jnp.where(idx < self.d, c3, jnp.zeros((), c3.dtype))
+
+    def flat_index(self) -> jax.Array:
+        """int32 ``(T, S, 128)`` array holding each position's flat
+        coordinate index (tail positions hold indices ≥ d)."""
+        chunk_elems = self.S * LANES
+        return (
+            jax.lax.broadcasted_iota(jnp.int32, self.shape, 0) * chunk_elems
+            + jax.lax.broadcasted_iota(jnp.int32, self.shape, 1) * LANES
+            + jax.lax.broadcasted_iota(jnp.int32, self.shape, 2))
 

@@ -138,6 +138,16 @@ class CountSketch:
     def sublanes(self):
         return self.c_pad // _LANES
 
+    @property
+    def chunk_layout(self):
+        """The ``(T, S, 128)`` resident layout this sketch's kernels consume
+        (ops/flat.ChunkLayout) — the layout the chunked-resident round keeps
+        PS state in so ``sketch_chunks``/``estimates_chunks`` need no per-round
+        pad/reshape."""
+        from commefficient_tpu.ops.flat import ChunkLayout
+
+        return ChunkLayout(d=self.d, T=self.T, S=self.sublanes)
+
 
 def make_sketch(d: int, c: int, r: int, seed: int = 42,
                 num_blocks: int = 20) -> CountSketch:
@@ -211,7 +221,10 @@ def _median_small(rows):
 # --------------------------------------------------------------------------
 
 def _sketch_vec_jax(cs: CountSketch, v: jax.Array) -> jax.Array:
-    v3 = _chunks3(cs, v)
+    return _sketch_chunks_jax(cs, _chunks3(cs, v))
+
+
+def _sketch_chunks_jax(cs: CountSketch, v3: jax.Array) -> jax.Array:
     S = cs.sublanes
 
     def body(table, xs):
@@ -439,11 +452,31 @@ def sketch_vec(cs: CountSketch, v: jax.Array) -> jax.Array:
     return _sketch_vec_jax(cs, v)
 
 
+def sketch_chunks(cs: CountSketch, v3: jax.Array) -> jax.Array:
+    """Accumulate a vector already in the ``(T, S, 128)`` resident chunk
+    layout (ops/flat.ChunkLayout — zero-padded tail) into an ``(r, c_pad)``
+    table. Identical result to ``sketch_vec(cs, unchunk(v3))`` — the chunking
+    is pure layout — but with no per-call pad/reshape: the chunked-resident
+    round's accumulate entry point."""
+    assert v3.shape == (cs.T, cs.sublanes, _LANES), \
+        f"expected chunk layout {(cs.T, cs.sublanes, _LANES)}, got {v3.shape}"
+    if _trace_state_clean():
+        _check_sketch_kernel_once(eager=True)
+    if _use_pallas_sketch():
+        out = _sketch_vec_pallas(v3, cs.shift_q, cs.shift_w, cs.sign_keys,
+                                 S=cs.sublanes, T=cs.T)
+        return out.reshape(cs.r, cs.c_pad)
+    return _sketch_chunks_jax(cs, v3)
+
+
 # --------------------------------------------------------------------------
 # query: (r, c_pad) table -> (d,) estimates
 # --------------------------------------------------------------------------
 
-def _estimates_jax(cs: CountSketch, table: jax.Array) -> jax.Array:
+def _estimates_chunks_jax(cs: CountSketch, table: jax.Array) -> jax.Array:
+    """Pure-XLA query producing the ``(T, S, 128)`` estimate chunks. Tail
+    positions (flat index ≥ d) hold hash noise — callers re-entering the
+    resident data plane must ``mask_tail`` them."""
     S = cs.sublanes
     table3 = table.reshape(cs.r, S, _LANES)
 
@@ -455,6 +488,11 @@ def _estimates_jax(cs: CountSketch, table: jax.Array) -> jax.Array:
 
     t_bases = jnp.arange(cs.T, dtype=jnp.int32) * (S * _LANES)
     _, out = jax.lax.scan(body, None, (cs.inv_q.T, cs.inv_w.T, t_bases))
+    return out
+
+
+def _estimates_jax(cs: CountSketch, table: jax.Array) -> jax.Array:
+    out = _estimates_chunks_jax(cs, table)
     return out.reshape(cs.T * cs.c_pad)[: cs.d]
 
 
@@ -567,6 +605,23 @@ def estimates(cs: CountSketch, table: jax.Array) -> jax.Array:
     return _estimates_jax(cs, table)
 
 
+def estimates_chunks(cs: CountSketch, table: jax.Array) -> jax.Array:
+    """Median-of-rows estimates in the ``(T, S, 128)`` resident chunk layout
+    — same values as ``estimates`` at flat indices < d, but without the
+    table→flat reshape. The padded tail is **masked to zero** (the raw
+    kernel output there is hash noise), so the result satisfies the
+    resident-layout invariant (ops/flat.ChunkLayout)."""
+    if _trace_state_clean():
+        _check_estimates_kernel_once(eager=True)
+    if _use_pallas_estimates():
+        out = _estimates_pallas(
+            _doubled_table(cs, table), cs.shift_q, cs.shift_w, cs.sign_keys,
+            S=cs.sublanes, T=cs.T, c_pad=cs.c_pad)
+    else:
+        out = _estimates_chunks_jax(cs, table)
+    return cs.chunk_layout.mask_tail(out)
+
+
 def unsketch(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:
     """Dense ``(d,)`` vector holding the estimated values of the k
     largest-magnitude coordinates, zero elsewhere (``CSVec.unSketch(k)``,
@@ -574,6 +629,17 @@ def unsketch(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:
     from commefficient_tpu.ops.topk import topk
 
     return topk(estimates(cs, table), k)
+
+
+def unsketch_chunks(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:
+    """``unsketch`` in the ``(T, S, 128)`` resident chunk layout: top-k of
+    the masked estimate chunks, shape-preserving (tail stays zero). Same
+    selected set and values as ``unsketch`` — the threshold descent counts
+    magnitudes over the same d real coordinates plus zero-valued tail
+    positions, which can never win a nonzero threshold."""
+    from commefficient_tpu.ops.topk import topk_dense_nd
+
+    return topk_dense_nd(estimates_chunks(cs, table), k)
 
 
 def l2estimate(table: jax.Array) -> jax.Array:

@@ -83,6 +83,8 @@ def _count_ge_pallas(v3, ts, *, T, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from commefficient_tpu.compat import tpu_smem_space
+
     def kernel(ts_ref, v_ref, out_ref):
         @pl.when(pl.program_id(0) == 0)
         def _():
@@ -98,7 +100,7 @@ def _count_ge_pallas(v3, ts, *, T, interpret=False):
         num_scalar_prefetch=1,
         grid=(T,),
         in_specs=[pl.BlockSpec((1, _SUB, _LANES), lambda t, *_: (t, 0, 0))],
-        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+        out_specs=pl.BlockSpec(memory_space=tpu_smem_space()),
     )
     return pl.pallas_call(
         kernel,
@@ -122,6 +124,8 @@ def _descent_pallas(v3, kk, *, T, sub=_SUB, interpret=False):
     Returns the scalar k-th-magnitude bit-pattern threshold."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from commefficient_tpu.compat import tpu_smem_space
 
     def kernel(kk_ref, v_ref, out_ref, counts, prefix):
         p_id = pl.program_id(0)
@@ -164,7 +168,7 @@ def _descent_pallas(v3, kk, *, T, sub=_SUB, interpret=False):
         num_scalar_prefetch=1,
         grid=(8, T),
         in_specs=[pl.BlockSpec((1, sub, _LANES), lambda p, t, *_: (t, 0, 0))],
-        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+        out_specs=pl.BlockSpec(memory_space=tpu_smem_space()),
         scratch_shapes=[pltpu.SMEM((15,), jnp.int32),
                         pltpu.SMEM((1,), jnp.int32)],
     )
@@ -206,21 +210,29 @@ def _topk_threshold_1d_fused(vec: jax.Array, k: int,
     larger blocks (1 MiB each, still trivially VMEM-resident
     double-buffered) is the candidate fix the topk_ab leg decides."""
     raw = vec.view(jnp.int32)
-    sub = _SUB if raw.shape[0] <= _PALLAS_TOPK_MAX_D else 4 * _SUB
-    v3, T = _blocks3(raw, sub)
-    kk = jnp.asarray([k], jnp.int32)
-    p = _descent_pallas(v3, kk, T=T, sub=sub, interpret=interpret)[0]
+    p = _threshold_descent_fused(raw, k, interpret=interpret)
     return _apply_threshold(raw, vec, p)
 
 
-def _topk_threshold_1d_pallas(vec: jax.Array, k: int,
-                              interpret: bool = False) -> jax.Array:
-    """Same radix descent as ``_topk_threshold_1d``, counts from the Pallas
-    kernel. Identical output: the descent is exact integer arithmetic, so
-    the two paths agree bit-for-bit whenever the counts do."""
-    raw = vec.view(jnp.int32)
-    v3, T = _blocks3(raw)
+def _threshold_descent_fused(raw: jax.Array, k: int,
+                             interpret: bool = False) -> jax.Array:
+    """Resolved k-th-magnitude bit pattern via the single fused descent
+    kernel on the blocked flat view of ``raw`` (any shape) — shared by the
+    flat and chunked-resident paths like ``_threshold_descent_pallas``."""
+    flat = raw.reshape(-1)
+    sub = _SUB if flat.shape[0] <= _PALLAS_TOPK_MAX_D else 4 * _SUB
+    v3, T = _blocks3(flat, sub)
+    kk = jnp.asarray([k], jnp.int32)
+    return _descent_pallas(v3, kk, T=T, sub=sub, interpret=interpret)[0]
 
+
+def _threshold_descent_pallas(raw: jax.Array, k: int,
+                              interpret: bool = False) -> jax.Array:
+    """Resolved k-th-largest-magnitude bit pattern via the per-pass Pallas
+    count kernel on the blocked flat view of ``raw`` (any shape) — the one
+    descent loop both the flat and chunked-resident top-k paths share, so
+    a blocking/kernel change cannot silently diverge them."""
+    v3, T = _blocks3(raw.reshape(-1))
     p = jnp.int32(0)
     for shift in range(28, -1, -4):
         hi_nib = 8 if shift == 28 else 16
@@ -230,7 +242,16 @@ def _topk_threshold_1d_pallas(vec: jax.Array, k: int,
         counts = _count_ge_pallas(v3, ts, T=T, interpret=interpret)
         sel = jnp.sum(counts >= k).astype(jnp.int32)
         p = p + (sel << shift)
+    return p
 
+
+def _topk_threshold_1d_pallas(vec: jax.Array, k: int,
+                              interpret: bool = False) -> jax.Array:
+    """Same radix descent as ``_topk_threshold_1d``, counts from the Pallas
+    kernel. Identical output: the descent is exact integer arithmetic, so
+    the two paths agree bit-for-bit whenever the counts do."""
+    raw = vec.view(jnp.int32)
+    p = _threshold_descent_pallas(raw, k, interpret=interpret)
     return _apply_threshold(raw, vec, p)
 
 
@@ -263,8 +284,11 @@ def _topk_sort_1d(vec: jax.Array, k: int) -> jax.Array:
     return jnp.zeros_like(vec).at[idx].set(vec[idx])
 
 
-def _topk_threshold_1d(vec: jax.Array, k: int) -> jax.Array:
-    raw = vec.view(jnp.int32)
+def _threshold_descent_xla(raw: jax.Array, k: int) -> jax.Array:
+    """Resolved k-th-largest-magnitude bit pattern over ALL elements of
+    ``raw`` (any shape — the counts are full-array reductions, so the same
+    descent serves the flat ``(d,)`` vector and the chunked-resident
+    ``(T, S, 128)`` layout without a reshape)."""
 
     def mag(r):
         # |pattern| as int (abs, not the reference's square, utils.py:246:
@@ -282,18 +306,60 @@ def _topk_threshold_1d(vec: jax.Array, k: int) -> jax.Array:
         hi_nib = 8 if shift == 28 else 16
         ts = p + (jnp.arange(1, hi_nib, dtype=jnp.int32) << shift)
         m = mag(raw)
-        counts = jnp.sum(m[:, None] >= ts[None, :], axis=0)
+        counts = jnp.sum(m[..., None] >= ts, axis=tuple(range(m.ndim)))
         # counts are non-increasing in the threshold, so the chosen nibble
         # is just the number of candidates whose count still reaches k
         sel = jnp.sum(counts >= k).astype(jnp.int32)
         p = p + (sel << shift)
+    return p
 
+
+def _topk_threshold_1d(vec: jax.Array, k: int) -> jax.Array:
+    raw = vec.view(jnp.int32)
+    p = _threshold_descent_xla(raw, k)
     # p == 0 ⇔ fewer than k nonzero magnitudes: m ≥ 0 keeps everything,
     # and zero-magnitude coordinates contribute value 0 anyway — the same
     # dense-masked result lax.top_k pads with zeros
-    out = jnp.where(mag(raw) >= p, vec, jnp.zeros_like(vec))
-    nan = (raw & _ABS_MASK) > _INF_BITS
-    return jnp.where(nan, vec, out)
+    return _apply_threshold(raw, vec, p)
+
+
+def topk_dense_nd(vec: jax.Array, k: int, interpret: bool = False) -> jax.Array:
+    """Shape-preserving global magnitude top-k over EVERY element of an
+    arbitrary-shape array — the chunked-resident round's entry point: the
+    ``(T, S, 128)`` estimate chunks are thresholded in place, so no
+    flat-layout materialization enters the steady-state server phase.
+
+    Tie-inclusive threshold semantics identical to ``topk(method=
+    "threshold")`` on the flattened input: the descent's counts are
+    full-array reductions, so the resolved k-th-magnitude bit pattern (and
+    therefore the kept set) matches the 1-D path's exactly. Zero-valued
+    positions (e.g. a chunked layout's masked tail) can never win a nonzero
+    threshold, and when fewer than k nonzeros exist they are kept with
+    value 0 — the invariant-preserving dense-masked result. On TPU below
+    the measured Pallas crossover the count passes run through the fused
+    count kernel on a blocked flat view (the one remaining reshape rides
+    the same path the flat round always paid; above the crossover the
+    descent is reshape-free)."""
+    import os
+
+    from commefficient_tpu.utils import is_tpu_backend
+
+    raw = vec.view(jnp.int32)
+    # same precedence as the flat selector (_select_threshold_impl):
+    # kill-switch, then the fused-kernel A/B opt-in (which deliberately
+    # bypasses the crossover gate — GPT-2-scale d is what the A/B tests,
+    # and GPT-2 rounds run through THIS entry point), then the per-pass
+    # gate, then pure XLA
+    if os.environ.get("COMMEFFICIENT_PALLAS_TOPK") == "0":
+        p = _threshold_descent_xla(raw, k)
+    elif (os.environ.get("COMMEFFICIENT_PALLAS_TOPK_FUSED") == "1"
+            and is_tpu_backend()):
+        p = _threshold_descent_fused(raw, k, interpret=interpret)
+    elif _use_pallas_topk(vec.size) or interpret:
+        p = _threshold_descent_pallas(raw, k, interpret=interpret)
+    else:
+        p = _threshold_descent_xla(raw, k)
+    return _apply_threshold(raw, vec, p)
 
 
 def topk(vec: jax.Array, k: int, method: str = "threshold") -> jax.Array:

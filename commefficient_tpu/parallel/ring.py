@@ -29,7 +29,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from commefficient_tpu.compat import shard_map
 
 from commefficient_tpu.parallel.mesh import SEQ_AXIS
 

@@ -10,21 +10,162 @@ time — strictly more information than the reference's host-side cProfile.
 ``StepProfiler`` traces a fixed window of training steps (skipping warmup /
 compile steps); ``annotate`` marks host-side phases so they show up on the
 trace timeline.
+
+``host_sync_monitor`` is the pipelined round engine's audit hook
+(federated/engine.py, docs/round_engine.md): it counts blocking
+device→host materializations so the steady-state zero-syncs-per-round
+invariant is assertable in tests and visible in bench output.
+``jax.transfer_guard`` is the natural tool but is inert on the CPU backend
+the test suite runs on (measured — "disallow" lets both array and scalar
+fetches through), and ``np.asarray`` on a CPU-backed ``jax.Array`` reads
+the buffer protocol directly, bypassing any Python-level wrapper. The
+portable counter therefore has two layers: (1) global wraps of the scalar
+conversion entry points (``float``/``int``/``bool``/``item``/``_value``,
+which do route through Python), and (2) the ``materialize()`` seam every
+framework-internal array fetch goes through (aggregator drains, engine).
+``strict=True`` additionally arms the real transfer guard on device
+backends, where it turns ANY device→host transfer into a hard error.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import threading
 
 import jax
 
-__all__ = ["StepProfiler", "annotate"]
+__all__ = ["StepProfiler", "annotate", "SyncCounter", "host_sync_monitor",
+           "materialize"]
 
 
 def annotate(name: str):
     """Context manager marking a host-side phase on the profiler timeline."""
     return jax.profiler.TraceAnnotation(name)
+
+
+class SyncCounter:
+    """Mutable tally of blocking device→host materializations observed
+    while a ``host_sync_monitor`` is active."""
+
+    def __init__(self):
+        self.count = 0
+
+    def __int__(self):
+        return self.count
+
+    def __repr__(self):
+        return f"SyncCounter(count={self.count})"
+
+
+# wrapper state: the patch is installed once and counts into whatever
+# monitors are active (nesting-safe); _depth guards double counting when one
+# conversion path calls another (__array__ -> _value).
+_lock = threading.Lock()
+_active: list = []
+_installed = False
+_depth = threading.local()
+
+
+def _count_sync():
+    if getattr(_depth, "n", 0) > 0:
+        return
+    for c in _active:
+        c.count += 1
+
+
+def materialize(x):
+    """Blocking device→host fetch of ``x`` as a numpy array — THE seam the
+    framework's own drains go through (aggregator ``finish_round``,
+    engine metric drains), so ``host_sync_monitor`` can count them on CPU
+    where ``np.asarray`` reads the buffer protocol and is untraceable."""
+    import numpy as np
+
+    if isinstance(x, jax.Array):
+        _count_sync()
+        # on device backends np.asarray dispatches to the wrapped
+        # __array__/_value (no buffer protocol for device memory) — raise
+        # the reentrancy depth so this ONE fetch is not counted twice
+        _depth.n = getattr(_depth, "n", 0) + 1
+        try:
+            return np.asarray(x)
+        finally:
+            _depth.n -= 1
+    return np.asarray(x)
+
+
+def _install_sync_hooks():
+    """Wrap the blocking scalar-conversion entry points of ``ArrayImpl``.
+    The set is version-sensitive (on jax 0.4.x ``__float__`` routes through
+    Python while ``np.asarray`` takes the C-level buffer protocol — see the
+    module docstring), so each wrapper both counts and bumps a reentrancy
+    depth — whichever entry point fires first claims the sync, nested ones
+    are silent."""
+    global _installed
+    if _installed:
+        return
+    from jax._src import array as _array_mod
+
+    cls = _array_mod.ArrayImpl
+
+    def wrap_method(name):
+        orig = getattr(cls, name, None)
+        if orig is None:
+            return
+
+        def wrapper(self, *a, **kw):
+            _count_sync()
+            _depth.n = getattr(_depth, "n", 0) + 1
+            try:
+                return orig(self, *a, **kw)
+            finally:
+                _depth.n -= 1
+
+        wrapper.__name__ = name
+        setattr(cls, name, wrapper)
+
+    # _value is the shared materialization property (np.asarray, bool, int,
+    # tolist); the dunders cover the scalar paths that bypass it
+    orig_value = cls._value
+
+    def value_wrapper(self):
+        _count_sync()
+        _depth.n = getattr(_depth, "n", 0) + 1
+        try:
+            return orig_value.fget(self)
+        finally:
+            _depth.n -= 1
+
+    cls._value = property(value_wrapper)
+    for name in ("__array__", "__float__", "__int__", "__bool__",
+                 "__index__", "item"):
+        wrap_method(name)
+    _installed = True
+
+
+@contextlib.contextmanager
+def host_sync_monitor(strict: bool = False):
+    """Count blocking device→host materializations in the dynamic extent.
+
+    Yields a ``SyncCounter``. ``jax.block_until_ready`` (a completion wait,
+    not a transfer) and host→device ``jnp.asarray`` uploads do NOT count —
+    the tally is exactly the fetches the pipelined round engine's every-N
+    drain exists to batch. With ``strict=True`` on a non-CPU backend,
+    ``jax.transfer_guard_device_to_host("disallow")`` is armed as well, so
+    any counted sync also raises at the XLA runtime layer."""
+    _install_sync_hooks()
+    counter = SyncCounter()
+    guard = (jax.transfer_guard_device_to_host("disallow")
+             if strict and jax.default_backend() != "cpu"
+             else contextlib.nullcontext())
+    with _lock:
+        _active.append(counter)
+    try:
+        with guard:
+            yield counter
+    finally:
+        with _lock:
+            _active.remove(counter)
 
 
 class StepProfiler:

@@ -29,7 +29,12 @@ from commefficient_tpu.data_utils import (
     num_classes_of_dataset,
     transforms,
 )
-from commefficient_tpu.federated import FedModel, FedOptimizer, LambdaLR
+from commefficient_tpu.federated import (
+    FedModel,
+    FedOptimizer,
+    LambdaLR,
+    PipelinedRoundEngine,
+)
 from commefficient_tpu.federated.checkpoint import (
     load_checkpoint,
     load_matching,
@@ -103,24 +108,45 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
         client_download = np.zeros(num_clients)
         client_upload = np.zeros(num_clients)
         spe = loader.steps_per_epoch()
+        # Pipelined round engine (federated/engine.py): each loop iteration
+        # dispatches a round without blocking on its results; metrics are
+        # fetched in batches of --metrics_drain_every. The NaN abort
+        # therefore fires at drain time, up to drain_every-1 rounds after
+        # the NaN round — same abort, batched detection
+        # (docs/round_engine.md).
+        engine = PipelinedRoundEngine(
+            model, opt, lr_scheduler,
+            window=getattr(args, "round_window", 2),
+            drain_every=getattr(args, "metrics_drain_every", 8))
+        nan_loss = False
+
+        def consume(results):
+            nonlocal nan_loss, client_download, client_upload
+            for res in results:
+                loss, acc, download, upload = res.values
+                if np.any(np.isnan(loss)):
+                    print(f"LOSS OF {np.mean(loss)} IS NAN, "
+                          "TERMINATING TRAINING")
+                    nan_loss = True
+                    return
+                client_download += download
+                client_upload += upload
+                losses.extend(loss.tolist())
+                accs.extend(acc.tolist())
+
         try:
             for i, batch in enumerate(loader):
                 if i > spe * epoch_fraction:
                     break
                 prof.step(i)
-                lr_scheduler.step()
-                loss, acc, download, upload = model(batch)
-                if np.any(np.isnan(loss)):
-                    print(f"LOSS OF {np.mean(loss)} IS NAN, "
-                          "TERMINATING TRAINING")
+                consume(engine.submit(batch))
+                if nan_loss:
                     return np.nan, np.nan, np.nan, np.nan
-                client_download += download
-                client_upload += upload
-                opt.step()
-                losses.extend(loss.tolist())
-                accs.extend(acc.tolist())
                 if args.do_test:
                     break
+            consume(engine.drain())
+            if nan_loss:
+                return np.nan, np.nan, np.nan, np.nan
         finally:
             prof.close()
         return (np.mean(losses), np.mean(accs), client_download,

@@ -28,7 +28,12 @@ from commefficient_tpu.data_utils.tokenization import (
     ATTR_TO_SPECIAL_TOKEN,
     get_tokenizer,
 )
-from commefficient_tpu.federated import FedModel, FedOptimizer, LambdaLR
+from commefficient_tpu.federated import (
+    FedModel,
+    FedOptimizer,
+    LambdaLR,
+    PipelinedRoundEngine,
+)
 from commefficient_tpu.federated.checkpoint import (
     load_checkpoint,
     load_matching,
@@ -97,6 +102,43 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
         client_download = np.zeros(num_clients)
         client_upload = np.zeros(num_clients)
         losses = []
+        # Pipelined round engine (federated/engine.py): rounds are
+        # dispatched sync-free and metrics arrive in batches of
+        # --metrics_drain_every, so logger rows are appended at drain time.
+        # Per-row train_time is the drain interval divided over its rounds
+        # (the per-round value no longer exists — fetching it every round
+        # is exactly the blocking sync the engine removes); loss and byte
+        # values are identical to per-round fetching (tests/test_engine.py).
+        engine = PipelinedRoundEngine(
+            model, opt, lr_scheduler,
+            window=getattr(args, "round_window", 2),
+            drain_every=getattr(args, "metrics_drain_every", 8))
+        meta_by_round = {}
+
+        def consume(results):
+            nonlocal client_download, client_upload
+            if not results:
+                return
+            interval = timer()
+            for res in results:
+                loss, download, upload = res.values
+                client_download += download
+                client_upload += upload
+                loss = float(np.mean(loss))
+                losses.append(loss)
+                row_batch_idx, row_lr = meta_by_round.pop(res.index)
+                batch_stats = {
+                    "train_time": interval / len(results),
+                    "train_loss": loss,
+                    "total_time": timer.total_time,
+                    "down (MiB)": round(download.sum() / (1024 * 1024)),
+                    "up (MiB)": round(upload.sum() / (1024 * 1024)),
+                }
+                if logger is not None:
+                    logger.append(
+                        union({"batch_idx": row_batch_idx, "lr": row_lr},
+                              batch_stats))
+
         try:
             for batch_idx, batch in enumerate(loader):
                 if batch_idx > 2 and args.do_test and batch_idx < spe - 10:
@@ -104,26 +146,13 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
                 if batch_idx > spe * epoch_fraction:
                     break
                 prof.step(batch_idx)
-                lr_scheduler.step()
-                loss, download, upload = model(batch)
-                client_download += download
-                client_upload += upload
-                opt.step()
-                loss = float(np.mean(loss))
-                losses.append(loss)
-                train_time = timer()
-                batch_stats = {
-                    "train_time": train_time,
-                    "train_loss": loss,
-                    "total_time": timer.total_time,
-                    "down (MiB)": round(download.sum() / (1024 * 1024)),
-                    "up (MiB)": round(upload.sum() / (1024 * 1024)),
-                }
-                lr = lr_scheduler.get_last_lr()[0]
-                if logger is not None:
-                    logger.append(
-                        union({"batch_idx": batch_idx + 1, "lr": lr},
-                              batch_stats))
+                done = engine.submit(batch)
+                # the scheduler stepped inside submit(); record this round's
+                # batch index and LR so its drained row logs what it ran with
+                meta_by_round[engine.rounds_submitted - 1] = (
+                    batch_idx + 1, lr_scheduler.get_last_lr()[0])
+                consume(done)
+            consume(engine.drain())
         finally:
             prof.close()
         return np.mean(losses), client_download, client_upload

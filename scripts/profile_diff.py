@@ -1,0 +1,150 @@
+"""Diff two per-op profile captures by category.
+
+The pipelined round engine PR (docs/round_engine.md) claims a specific
+shape of win: the data-movement category of the GPT-2 per-op profile
+(docs/measurements/tpu_profile_gpt2.md — pad/reshape chunk-layout churn,
+~7 ms/round) disappears while custom-call and convolution stay flat. This
+script makes that claim — and any future regression of it — one command to
+check: it parses the "## By category" table and the wall/busy header out
+of two capture files written by scripts/tpu_profile.py and prints the
+per-category delta table.
+
+Usage:
+    python scripts/profile_diff.py BEFORE.md AFTER.md
+
+e.g. against a fresh re-capture:
+    python scripts/profile_diff.py \
+        docs/measurements/tpu_profile_gpt2.md runs/tpu_profile_new.md
+
+Exit status: 0 on a clean diff, 2 on unparseable input. Pass
+``--fail-above-pct CAT=PCT`` (repeatable) to exit 1 when a category's
+ms/round grew by more than PCT percent — the CI regression hook.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import Dict, NamedTuple, Optional
+
+
+class Capture(NamedTuple):
+    path: str
+    wall_ms: Optional[float]  # ms/round wall clock (None in older captures)
+    busy_ms: Optional[float]  # ms/round device busy
+    # category -> (spans, ms_per_round)
+    categories: Dict[str, "tuple[int, float]"]
+
+
+_WALL_RE = re.compile(r"Wall clock:\s*\*\*([\d.]+)\s*ms/round\*\*")
+_BUSY_RE = re.compile(r"busy time\s*([\d.]+)\s*ms/round")
+# | category | spans | total ms | ms/round | % busy |
+_ROW_RE = re.compile(
+    r"^\|\s*([^|]+?)\s*\|\s*(\d+)\s*\|\s*[\d.]+\s*\|\s*([\d.]+)\s*\|")
+
+
+def parse_capture(path: str) -> Capture:
+    with open(path) as f:
+        text = f.read()
+    wall = _WALL_RE.search(text)
+    busy = _BUSY_RE.search(text)
+
+    cats: Dict[str, tuple] = {}
+    in_table = False
+    for line in text.splitlines():
+        if line.startswith("## "):
+            in_table = line.strip() == "## By category"
+            continue
+        if not in_table:
+            continue
+        m = _ROW_RE.match(line)
+        if not m:
+            continue
+        name = m.group(1)
+        if name in ("category", ":---", "---"):
+            continue
+        cats[name] = (int(m.group(2)), float(m.group(3)))
+    if not cats:
+        raise ValueError(f"{path}: no '## By category' table found — is "
+                         "this a scripts/tpu_profile.py capture?")
+    return Capture(path=path,
+                   wall_ms=float(wall.group(1)) if wall else None,
+                   busy_ms=float(busy.group(1)) if busy else None,
+                   categories=cats)
+
+
+def _fmt_delta(before: Optional[float], after: Optional[float]) -> str:
+    if before is None or after is None:
+        return "n/a"
+    d = after - before
+    pct = f" ({100 * d / before:+.1f}%)" if before else ""
+    return f"{d:+.3f}{pct}"
+
+
+def diff(a: Capture, b: Capture, fail_above: Dict[str, float]) -> int:
+    print(f"before: {a.path}")
+    print(f"after:  {b.path}\n")
+
+    print("| category | spans (b→a) | ms/round before | ms/round after | "
+          "delta |")
+    print("|---|---|---|---|---|")
+    # stable order: descending before-ms, categories new in `after` last
+    names = sorted(set(a.categories) | set(b.categories),
+                   key=lambda n: -a.categories.get(n, (0, 0.0))[1])
+    failures = []
+    for name in names:
+        sa, ma = a.categories.get(name, (0, 0.0))
+        sb, mb = b.categories.get(name, (0, 0.0))
+        print(f"| {name} | {sa}→{sb} | {ma:.3f} | {mb:.3f} | "
+              f"{_fmt_delta(ma, mb)} |")
+        for pat, pct in fail_above.items():
+            if pat.lower() in name.lower() and ma > 0 \
+                    and 100 * (mb - ma) / ma > pct:
+                failures.append(
+                    f"{name}: {ma:.3f} → {mb:.3f} ms/round exceeds "
+                    f"+{pct}% budget")
+    print(f"| **device busy** | | "
+          f"{a.busy_ms if a.busy_ms is not None else '?'} | "
+          f"{b.busy_ms if b.busy_ms is not None else '?'} | "
+          f"{_fmt_delta(a.busy_ms, b.busy_ms)} |")
+    print(f"| **wall clock** | | "
+          f"{a.wall_ms if a.wall_ms is not None else '?'} | "
+          f"{b.wall_ms if b.wall_ms is not None else '?'} | "
+          f"{_fmt_delta(a.wall_ms, b.wall_ms)} |")
+
+    if failures:
+        print("\nREGRESSION:", file=sys.stderr)
+        for msg in failures:
+            print(f"  {msg}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("before", help="baseline capture .md")
+    p.add_argument("after", help="new capture .md")
+    p.add_argument("--fail-above-pct", action="append", default=[],
+                   metavar="CAT=PCT",
+                   help="exit 1 if category CAT (substring match) grew "
+                        "more than PCT%% in ms/round; repeatable")
+    args = p.parse_args(argv)
+    fail_above = {}
+    for spec in args.fail_above_pct:
+        cat, _, pct = spec.partition("=")
+        try:
+            fail_above[cat] = float(pct)
+        except ValueError:
+            p.error(f"bad --fail-above-pct {spec!r} (want CAT=PCT)")
+    try:
+        a = parse_capture(args.before)
+        b = parse_capture(args.after)
+    except (OSError, ValueError) as e:
+        print(e, file=sys.stderr)
+        return 2
+    return diff(a, b, fail_above)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,256 @@
+"""Pipelined round engine, buffer donation, and the host-sync audit.
+
+Pins the three contracts of the round-engine PR (docs/round_engine.md):
+
+- **Donation** (federated/rounds.py): the jitted round step's compiled
+  executable reports input-output aliasing for PS state, and the round
+  trajectory is bit-identical with donation on vs off — donation is pure
+  memory plumbing, never math.
+- **Sync audit** (profiling.host_sync_monitor): 5 steady-state rounds
+  through the engine perform zero blocking device→host transfers between
+  drains; the drain itself is the one counted, batched fetch.
+- **Drain parity** (federated/engine.py): metrics fetched in batches of N
+  are value-identical to per-round fetching (drain_every=1 degenerates to
+  the reference loop shape).
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from commefficient_tpu.federated.aggregator import (
+    FedModel,
+    FedOptimizer,
+    LambdaLR,
+)
+from commefficient_tpu.federated.engine import PipelinedRoundEngine
+from commefficient_tpu.federated.rounds import (
+    RoundConfig,
+    build_round_step,
+    init_client_states,
+)
+from commefficient_tpu.federated.server import ServerConfig, init_server_state
+from commefficient_tpu.federated.worker import WorkerConfig
+from commefficient_tpu.ops.flat import ravel_pytree
+from commefficient_tpu.ops.sketch import make_sketch
+from commefficient_tpu.profiling import host_sync_monitor
+
+D = 4  # tiny linear model, as in test_rounds
+
+
+def _linear_loss(params, model_state, batch, rng, train):
+    w = params["w"]
+    pred = batch["inputs"] @ w
+    err = pred - batch["targets"]
+    mask = batch["mask"]
+    return jnp.sum(0.5 * err ** 2 * mask), (jnp.sum(jnp.abs(err) * mask),), \
+        jnp.sum(mask), model_state
+
+
+def _vec_batch(num_workers=8, bs=2, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "inputs": jnp.asarray(rng.randn(num_workers, bs, D), jnp.float32),
+        "targets": jnp.asarray(rng.randn(num_workers, bs), jnp.float32),
+        "mask": jnp.ones((num_workers, bs), jnp.float32),
+        "client_ids": jnp.arange(num_workers, dtype=jnp.int32),
+        "worker_mask": jnp.ones(num_workers, jnp.float32),
+    }
+
+
+def _sketch_steps(donate: bool):
+    """Sketch-mode round step (virtual error/momentum — the FetchSGD config
+    whose server state IS donatable; see rounds.build_round_step) plus fresh
+    resident-state inputs."""
+    params = {"w": jnp.zeros(D)}
+    flat, unravel = ravel_pytree(params)
+
+    def ravel(tree):
+        return ravel_pytree(tree)[0]
+
+    wcfg = WorkerConfig(mode="sketch", error_type="virtual", k=2,
+                        num_workers=8)
+    scfg = ServerConfig(mode="sketch", error_type="virtual", k=2,
+                        grad_size=D, virtual_momentum=0.9,
+                        local_momentum=0.0)
+    sketch = make_sketch(D, 16, 3, seed=0, num_blocks=1)
+    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=D, donate=donate)
+    steps = build_round_step(_linear_loss, _linear_loss, unravel, ravel,
+                             cfg, sketch=sketch)
+    assert steps.layout is not None, "sketch mode must be chunked-resident"
+    ps = steps.layout.chunk(flat)
+    server_state = init_server_state(scfg, sketch)
+    client_states = init_client_states(16, D, wcfg, init_weights=flat,
+                                       sketch=sketch)
+    return steps, ps, server_state, client_states
+
+
+class TestBufferDonation:
+    def test_compiled_executable_reports_ps_aliasing(self):
+        """The donating round step's executable aliases PS state buffers
+        input→output (donation metadata + memory_analysis); the
+        donate=False build reports none."""
+        for donate in (True, False):
+            steps, ps, ss, cs, = _sketch_steps(donate=donate)
+            batch = _vec_batch()
+            compiled = steps.train_step.lower(
+                ps, ss, cs, {}, batch, 0.1, jax.random.key(0)).compile()
+            alias_bytes = compiled.memory_analysis().alias_size_in_bytes
+            if donate:
+                # at least the resident ps buffer must be aliased in place
+                # (server velocity/error and client state ride along)
+                assert alias_bytes >= ps.size * ps.dtype.itemsize, \
+                    f"donating step aliases only {alias_bytes} B"
+                assert "input_output_alias" in compiled.as_text()
+            else:
+                assert alias_bytes == 0, \
+                    f"donate=False must not alias ({alias_bytes} B)"
+
+    def test_trajectory_bit_identical_donation_on_off(self):
+        """Donation changes buffer lifetimes, never values: a 4-round
+        sketched trajectory matches bit-for-bit with donation on vs off."""
+        runs = {}
+        for donate in (True, False):
+            steps, ps, ss, cs = _sketch_steps(donate=donate)
+            state = (ps, ss, cs, {})
+            traj = []
+            for rnd in range(4):
+                out = steps.train_step(state[0], state[1], state[2],
+                                       state[3], _vec_batch(seed=rnd), 0.1,
+                                       jax.random.key(rnd))
+                state = out[:4]
+                traj.append(np.asarray(steps.layout.unchunk(state[0])))
+            runs[donate] = traj
+        for rnd, (a, b) in enumerate(zip(runs[True], runs[False])):
+            np.testing.assert_array_equal(a, b, err_msg=f"round {rnd}")
+
+
+# ---- FedModel-level fixtures (engine drives the aggregator API) ---------
+
+class TinyModel(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.Dense(4, use_bias=False)(x)
+
+
+def _loss(params, model_state, batch, rng, train):
+    pred = TinyModel().apply({"params": params}, batch["inputs"])
+    err = pred - batch["targets"]
+    mask = batch["mask"]
+    return jnp.sum(jnp.square(err).mean(-1) * mask), (), jnp.sum(mask), \
+        model_state
+
+
+def _args(**over):
+    base = dict(
+        mode="sketch", error_type="virtual", k=2, num_workers=2,
+        weight_decay=0.0, local_momentum=0.0, virtual_momentum=0.9,
+        microbatch_size=-1, max_grad_norm=None, do_dp=False,
+        dp_mode="worker", l2_norm_clip=1.0, noise_multiplier=0.0,
+        num_fedavg_epochs=1, fedavg_batch_size=-1, fedavg_lr_decay=1.0,
+        do_topk_down=False, num_clients=4, num_devices=1, seed=0,
+        do_test=False, dataset_name="CIFAR10", num_epochs=2,
+        local_batch_size=2, num_cols=16, num_rows=2, num_blocks=1,
+        seq_parallel="none", seq_devices=1,
+    )
+    base.update(over)
+    return SimpleNamespace(**base)
+
+
+def _host_batch(ids, seed, d_in=3):
+    """Loader-shaped batch: HOST numpy arrays, as the real training loops
+    receive (uploads are H2D and never count as blocking syncs)."""
+    W = len(ids)
+    rng = np.random.RandomState(seed)
+    return {
+        "inputs": rng.randn(W, 2, d_in).astype(np.float32),
+        "targets": rng.randn(W, 2, 4).astype(np.float32),
+        "mask": np.ones((W, 2), np.float32),
+        "client_ids": np.asarray(ids, np.int32),
+        "worker_mask": np.ones(W, np.float32),
+    }
+
+
+def _engine(window=2, drain_every=8, **over):
+    fm = FedModel(TinyModel(), _loss, _args(**over), input_shape=(3,))
+    opt = FedOptimizer(fm, fm.args)
+    sched = LambdaLR(opt, lambda step: 0.5)
+    return fm, PipelinedRoundEngine(fm, opt, sched, window=window,
+                                    drain_every=drain_every)
+
+
+class TestSyncAudit:
+    def test_zero_syncs_between_drains(self):
+        """5 steady-state rounds through the engine perform ZERO blocking
+        device→host transfers; the every-N drain is the one batched fetch
+        (and the monitor counts it, proving the seam is live)."""
+        fm, engine = _engine(drain_every=10)
+        # round 0 pays compilation; keep it outside the steady-state audit
+        engine.submit(_host_batch([0, 1], seed=0))
+        with host_sync_monitor() as counter:
+            for rnd in range(1, 6):
+                done = engine.submit(_host_batch([rnd % 4, (rnd + 1) % 4],
+                                                 seed=rnd))
+                assert done == [], "must not drain before drain_every"
+                assert counter.count == 0, \
+                    f"round {rnd}: {counter.count} blocking host syncs in " \
+                    "the steady-state dispatch path"
+            results = engine.drain()
+            assert len(results) == 6
+            assert counter.count > 0, \
+                "drain must go through the counted materialize seam"
+
+    def test_weights_current_without_drain(self):
+        """Dispatched rounds are already part of the device-side weights —
+        drain() collects metrics, it does not flush pending math."""
+        fm, engine = _engine(drain_every=100)
+        for rnd in range(3):
+            engine.submit(_host_batch([0, 1], seed=rnd))
+        w_before = np.asarray(fm.layout.unchunk(fm.ps_weights))
+        engine.drain()
+        w_after = np.asarray(fm.layout.unchunk(fm.ps_weights))
+        np.testing.assert_array_equal(w_before, w_after)
+        assert np.any(w_after != 0), "3 rounds must have updated weights"
+
+
+class TestDrainParity:
+    def _run(self, drain_every, rounds=6):
+        fm, engine = _engine(drain_every=drain_every)
+        results = []
+        for rnd in range(rounds):
+            results.extend(engine.submit(
+                _host_batch([rnd % 4, (rnd + 1) % 4], seed=rnd)))
+        results.extend(engine.drain())
+        assert [r.index for r in results] == list(range(rounds)), \
+            "drained results must arrive in submit order"
+        return results
+
+    def test_batched_drain_matches_per_round(self):
+        """drain_every=4 yields the exact per-round values of the
+        drain_every=1 reference shape: same losses, same download/upload
+        byte accounting, round for round."""
+        per_round = self._run(drain_every=1)
+        batched = self._run(drain_every=4)
+        for ref, got in zip(per_round, batched):
+            assert ref.index == got.index
+            loss_r, down_r, up_r = ref.values
+            loss_b, down_b, up_b = got.values
+            np.testing.assert_array_equal(loss_r, loss_b,
+                                          err_msg=f"round {ref.index} loss")
+            np.testing.assert_array_equal(down_r, down_b,
+                                          err_msg=f"round {ref.index} down")
+            np.testing.assert_array_equal(up_r, up_b,
+                                          err_msg=f"round {ref.index} up")
+
+    def test_drain_every_one_returns_each_round_immediately(self):
+        fm, engine = _engine(drain_every=1)
+        for rnd in range(3):
+            done = engine.submit(_host_batch([0, 1], seed=rnd))
+            assert len(done) == 1 and done[0].index == rnd
+        assert engine.drain() == []

@@ -21,7 +21,7 @@ os.environ.setdefault("COMMEFFICIENT_GPT2_SEQ_LEN", "64")
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from commefficient_tpu.compat import shard_map
 
 from commefficient_tpu.federated.losses import make_gpt2_losses
 from commefficient_tpu.federated.rounds import (

@@ -123,7 +123,7 @@ class TestGPT2SeqParallel:
     def test_logits_match_dense(self, mesh, attn_impl):
         from functools import partial
 
-        from jax import shard_map
+        from commefficient_tpu.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         dense, sp, params, ids, tti, mc = self._models_and_data(attn_impl)

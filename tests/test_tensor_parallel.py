@@ -15,7 +15,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from commefficient_tpu.compat import shard_map
 
 from commefficient_tpu.federated.losses import make_gpt2_losses
 from commefficient_tpu.federated.rounds import (
