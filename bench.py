@@ -146,7 +146,8 @@ _T0 = time.monotonic()
 # --------------------------------------------------------------------------
 
 def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
-          mode: str = "sketch", num_workers: int = NUM_WORKERS):
+          mode: str = "sketch", num_workers: int = NUM_WORKERS,
+          server_shard: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -194,7 +195,8 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
                         grad_size=d, virtual_momentum=0.9)
     sketch = make_sketch(d, c=c, r=r, seed=42, num_blocks=blocks) \
         if mode == "sketch" else None
-    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d)
+    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
+                      server_shard=server_shard)
     loss_train, loss_val = make_cv_losses(model)
     # the entrypoints' real execution path: shard_map+psum over a clients
     # mesh — a 1-device mesh on the single bench chip
@@ -202,7 +204,7 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
 
     mesh = default_client_mesh(num_workers)
     _log(f"mesh: {dict(mesh.shape)} over {mesh.devices.size} device(s), "
-         f"mode={mode}, W={num_workers}")
+         f"mode={mode}, W={num_workers}, server_shard={server_shard}")
     steps = build_round_step(loss_train, loss_val, unravel, ravel, cfg,
                              sketch=sketch, mesh=mesh)
 
@@ -212,7 +214,17 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
     # much compute a round does, so the leg is honest about measuring the
     # same round under the non-IID configuration.
     num_clients = 500 if non_iid else 10
-    server_state = init_server_state(scfg, sketch)
+    server_state = init_server_state(
+        scfg, sketch,
+        shard_n=mesh.shape["clients"] if server_shard else 0)
+    if server_shard:
+        # commit the sharded-plane residency up front — the ONE rule
+        # FedModel uses (server.place_server_state), so round 1 hits the
+        # jit cache and donation is safe
+        from commefficient_tpu.federated.server import place_server_state
+
+        server_state = place_server_state(server_state, mesh, mode,
+                                          server_shard=True)
     client_states = init_client_states(num_clients, d, wcfg)
 
     rng = np.random.RandomState(0)
@@ -514,21 +526,31 @@ def run_measurement(tiny: bool) -> None:
 
 
 # one measure-and-emit path for every CIFAR-family config leg:
-# name -> (mode, workers, baseline r/s, num_classes, non_iid, K, label).
+# name -> (mode, workers, baseline r/s, num_classes, non_iid, K,
+#          server_shard, label).
 # K multi-rounds per dispatch via lax.scan: the cheap c1/c2 rounds are
 # smaller than the ~40 ms tunnel rtt, so 20 single-round dispatches would
 # measure transport noise (and raising the dispatch count instead wedges
 # the tunnel — 50+ unsynced steps, BASELINE.md); K rounds inside ONE
 # dispatch keep the queue shallow while the timed region grows K x.
 _CFG_LEGS = {
-    "c1": ("uncompressed", 1, "BASELINE_C1", 10, False, 20,
+    "c1": ("uncompressed", 1, "BASELINE_C1", 10, False, 20, False,
            "1-worker uncompressed rounds/sec/chip (ResNet9)"),
-    "c2": ("true_topk", 8, "BASELINE_C2", 10, False, 10,
+    "c2": ("true_topk", 8, "BASELINE_C2", 10, False, 10, False,
            "8-worker true-topk rounds/sec/chip (ResNet9, k=50k)"),
-    "cifar100": ("sketch", 8, "BASELINE_CIFAR100", 100, True, 1,
+    "cifar100": ("sketch", 8, "BASELINE_CIFAR100", 100, True, 1, False,
                  "CIFAR100/FEMNIST-style non-IID sketched rounds/sec/chip "
                  "(ResNet9-100, 500 clients, 8 workers, sketch 5x500k "
                  "k=50k)"),
+    # the headline sketch leg with the sharded server data plane
+    # (--server_shard, docs/sharded_server.md); its baseline anchor is the
+    # headline config-3 estimate so BENCH readers can compare the two legs
+    # directly. Per-shard server work only drops on a multi-chip mesh, so
+    # on the 1-chip bench this leg pins NO-regression with the plane on;
+    # on a multi-chip mesh it measures the win.
+    "shard": ("sketch", 8, "BASELINE", 10, False, 1, True,
+              "8-worker sketched rounds/sec/chip with --server_shard "
+              "(ResNet9, sketch 5x500k k=50k, sharded server data plane)"),
 }
 
 
@@ -542,13 +564,15 @@ def run_config_measurement(name: str) -> None:
     from jax import lax
 
     _check_pallas_kernel()
-    mode, W, base_name, num_classes, non_iid, K, label = _CFG_LEGS[name]
-    base = {"BASELINE_C1": BASELINE_C1_ROUNDS_PER_SEC,
+    mode, W, base_name, num_classes, non_iid, K, server_shard, label = \
+        _CFG_LEGS[name]
+    base = {"BASELINE": BASELINE_ROUNDS_PER_SEC,
+            "BASELINE_C1": BASELINE_C1_ROUNDS_PER_SEC,
             "BASELINE_C2": BASELINE_C2_ROUNDS_PER_SEC,
             "BASELINE_CIFAR100": BASELINE_CIFAR100_ROUNDS_PER_SEC}[base_name]
     steps, ps, server_state, client_states, batch = build(
         tiny=False, num_classes=num_classes, non_iid=non_iid, mode=mode,
-        num_workers=W)
+        num_workers=W, server_shard=server_shard)
     if K > 1:
         inner = steps.train_step
 
@@ -580,8 +604,8 @@ def run_config_measurement(name: str) -> None:
                                   4),
         "platform": jax.default_backend(),
     }
-    if base_name in ("BASELINE_C1", "BASELINE_C2"):
-        # c1/c2 anchors are analytic estimates of the reference's A100
+    if base_name in ("BASELINE", "BASELINE_C1", "BASELINE_C2"):
+        # these anchors are analytic estimates of the reference's A100
         # throughput (derived FLOP/dispatch arithmetic above), never
         # measured; flag it so a BENCH artifact reader can tell these
         # ratios apart from ones against measured baselines
@@ -659,6 +683,8 @@ _EXTRA_LEGS = {
            "c1_rounds_per_sec"),
     "c2": (["--run-cfg", "c2"], "BENCH_C12_TIMEOUT", 900,
            "c2_rounds_per_sec"),
+    "shard": (["--run-cfg", "shard"], "BENCH_C12_TIMEOUT", 900,
+              "shard_rounds_per_sec"),
 }
 
 
@@ -707,26 +733,37 @@ def _capture_extra(leg: str) -> int:
     return 0 if "partial" not in result else 1
 
 
-def _fresh_or_cached_extras(result: dict, run_fresh: bool = True) -> None:
+def _fresh_or_cached_extras(result: dict, run_fresh: bool = True,
+                            allow_stale: bool = False) -> None:
     """Populate result['extra'] from the per-leg children, falling back to
     the extras cache for any leg that fails. A cache hit younger than
-    BENCH_EXTRAS_MAX_AGE (default 12h) skips the fresh run entirely: the
-    batch runner (scripts/tpu_batch.sh) measures each leg as its own step
-    minutes or hours earlier in the same window, the tunneled chip compiles
-    server-side so no compile cache survives into this process, and
-    re-paying a d=124M compile to reproduce a number we already hold is how
-    three straight windows died (VERDICT r3 #1). The cache stamp
-    (measured_at @ head) is copied into the artifact so provenance stays
-    explicit. Set BENCH_EXTRAS_MAX_AGE=0 to force fresh runs."""
+    BENCH_EXTRAS_MAX_AGE (default 12h) AND measured at the current HEAD
+    skips the fresh run entirely: the batch runner (scripts/tpu_batch.sh)
+    measures each leg as its own step minutes or hours earlier in the same
+    window, the tunneled chip compiles server-side so no compile cache
+    survives into this process, and re-paying a d=124M compile to
+    reproduce a number we already hold is how three straight windows died
+    (VERDICT r3 #1). A cached leg from a DIFFERENT head is re-run by
+    default — a stale number silently mixed two code generations into one
+    artifact (BENCH_r05 c2/gpt2 legs); it is only used as the fallback
+    when the fresh run fails, clearly marked ``stale_head``.
+    ``allow_stale`` (--allow_stale_cache / BENCH_ALLOW_STALE_CACHE=1)
+    restores the old behavior for tunnel-down windows where re-running is
+    known hopeless. The cache stamp (measured_at @ head) is copied into
+    the artifact so provenance stays explicit. Set BENCH_EXTRAS_MAX_AGE=0
+    to force fresh runs."""
     max_age = float(os.environ.get("BENCH_EXTRAS_MAX_AGE", 12 * 3600))
     extras_out = {}
     cache = _load_extras()
     head_now = _git_head()
 
+    def _is_stale(cached):
+        return cached.get("head") not in (head_now, "unknown", None)
+
     def _mark_stale(leg, cached):
         # a cached leg measured at a different commit can silently mix two
         # code generations into one artifact — make that explicit
-        if cached.get("head") not in (head_now, "unknown", None):
+        if _is_stale(cached):
             _log(f"extra leg {leg}: cached head {cached.get('head')} != "
                  f"current {head_now} — marking stale_head")
             extras_out[f"{leg}_stale_head"] = (f"{cached.get('head')} != "
@@ -741,7 +778,7 @@ def _fresh_or_cached_extras(result: dict, run_fresh: bool = True) -> None:
                     time.strptime(cached["measured_at"], "%Y-%m-%d %H:%M:%S"))
             except (ValueError, KeyError):
                 age = float("inf")
-            if age < max_age:
+            if age < max_age and (allow_stale or not _is_stale(cached)):
                 _log(f"extra leg {leg}: cache hit ({age / 60:.0f} min old, "
                      f"head {cached.get('head')}) — skipping fresh run")
                 extras_out.update(cached["result"])
@@ -749,6 +786,10 @@ def _fresh_or_cached_extras(result: dict, run_fresh: bool = True) -> None:
                                                f"{cached.get('head')}")
                 _mark_stale(leg, cached)
                 continue
+            if age < max_age:
+                _log(f"extra leg {leg}: cache fresh by age but measured at "
+                     f"head {cached.get('head')} != {head_now} — re-running "
+                     f"(--allow_stale_cache to use it anyway)")
         fresh, err = (None, "fresh run disabled") if not run_fresh else (
             _run_leg(leg))
         if fresh is not None:
@@ -822,6 +863,11 @@ def main() -> int:
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
     run_timeout = float(os.environ.get("BENCH_RUN_TIMEOUT", 2400))
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", 1800))
+    # escape hatch for the HEAD-mismatch re-run policy (see
+    # _fresh_or_cached_extras): accept cached extra legs measured at a
+    # different commit instead of re-running them
+    allow_stale = ("--allow_stale_cache" in sys.argv[1:]
+                   or os.environ.get("BENCH_ALLOW_STALE_CACHE") == "1")
     tpu_error = None
 
     _log(f"probing TPU backend (timeout {probe_timeout:.0f}s)")
@@ -858,7 +904,8 @@ def main() -> int:
         # that follow in scripts/tpu_batch.sh own those compiles, and this
         # step's outer timeout does not budget for them.
         _fresh_or_cached_extras(
-            result, run_fresh=not os.environ.get("BENCH_REQUIRE_TPU"))
+            result, run_fresh=not os.environ.get("BENCH_REQUIRE_TPU"),
+            allow_stale=allow_stale)
         _save_tpu_cache(result)
 
     if result is None and os.environ.get("BENCH_REQUIRE_TPU"):
@@ -919,10 +966,10 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--run-cfg":
         sel = sys.argv[2] if len(sys.argv) >= 3 else "<missing>"
-        if sel not in ("c1", "c2"):
+        if sel not in ("c1", "c2", "shard"):
             # a missing/typo'd operand must never fall through to the full
             # parent orchestration and claim the chip for a headline bench
-            sys.exit(f"--run-cfg: unknown config {sel!r}; use c1|c2")
+            sys.exit(f"--run-cfg: unknown config {sel!r}; use c1|c2|shard")
         run_config_measurement(sel)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--capture":

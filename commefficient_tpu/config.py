@@ -118,6 +118,24 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--round_window", type=int, default=2,
                         help="Max rounds dispatched ahead of device "
                              "completion (pipelined round engine).")
+    # Sharded server data plane (docs/sharded_server.md): reduce-scatter
+    # the round transmit over the worker mesh axis, run the server update
+    # per-shard (velocity/error/top-k on the local slice, threshold via a
+    # psum'd count exchange), all-gather only the result. fp32
+    # trajectories are bit-identical to the replicated path; per-chip
+    # server FLOPs/HBM drop ~n_devices.
+    parser.add_argument("--server_shard", action="store_true",
+                        dest="server_shard",
+                        help="Shard the server aggregation/update over the "
+                             "worker mesh axis (reduce-scatter -> per-"
+                             "shard update -> all-gather).")
+    parser.add_argument("--reduce_dtype", choices=["float32", "int8"],
+                        default="float32",
+                        help="Transmit-collective element type. int8 = "
+                             "block-scaled stochastic-rounding quantized "
+                             "reduce (~4x fewer ICI bytes) with its "
+                             "residual carried in server error feedback; "
+                             "requires --server_shard.")
     parser.add_argument("--metrics_drain_every", type=int, default=8,
                         help="Fetch per-round metrics in batches of N "
                              "rounds; 1 restores per-round (blocking) "
@@ -255,6 +273,14 @@ def validate_args(args):
             f"--seq_devices {args.seq_devices}")
     assert 0.0 <= args.client_dropout < 1.0, (
         f"--client_dropout {args.client_dropout} must be in [0, 1)")
+    if args.reduce_dtype == "int8":
+        assert args.server_shard, (
+            "--reduce_dtype int8 quantizes the transmit reduce of the "
+            "sharded server plane; it requires --server_shard")
+    if args.server_shard:
+        assert not args.do_topk_down, (
+            "--server_shard is incompatible with --topk_down (stale-"
+            "weight reconstruction lives on dense per-client rows)")
     assert args.model_devices >= 1, "--model_devices must be >= 1"
     if args.model_devices > 1:
         assert args.seq_parallel in ("none", "ring"), (

@@ -25,6 +25,7 @@ from commefficient_tpu.federated.server import (
     ServerState,
     init_server_state,
     server_update,
+    sharded_server_update,
 )
 from commefficient_tpu.federated.worker import WorkerConfig
 
@@ -47,5 +48,6 @@ __all__ = [
     "ServerState",
     "init_server_state",
     "server_update",
+    "sharded_server_update",
     "WorkerConfig",
 ]

@@ -238,9 +238,14 @@ class FedModel:
             from commefficient_tpu.parallel.moe import ep_sliced_param
 
             ep_sliced = ep_sliced_param
+        # Sharded server data plane (--server_shard, docs/sharded_server.md)
+        self._server_shard = bool(getattr(args, "server_shard", False))
+        self._reduce_dtype = getattr(args, "reduce_dtype", None) or "float32"
         cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=self.grad_size,
                           do_test=args.do_test, tp_sliced=tp_sliced,
-                          ep_sliced=ep_sliced)
+                          ep_sliced=ep_sliced,
+                          server_shard=self._server_shard,
+                          reduce_dtype=self._reduce_dtype)
         from commefficient_tpu.federated.losses import make_cv_losses  # noqa: F401
 
         self.steps = build_round_step(
@@ -267,6 +272,13 @@ class FedModel:
 
             self._replicated = NamedSharding(self.mesh, PartitionSpec())
         self.ps_weights = self._place_replicated(self.ps_weights)
+        # Sharded-server state residency: the number of worker-axis shards
+        # (0 = replicated plane); the residency rule itself lives in
+        # server.place_server_state (dense velocity/error slices and the
+        # int8 qres carry dim-0-sharded — see the ServerState docstring).
+        self._n_shard = (self.mesh.shape["clients"]
+                         if self._server_shard and self.mesh is not None
+                         else 0)
         # per-client state is row-sharded over the clients mesh axis; rows are
         # padded to a multiple of the mesh size so the sharding is even
         # (padded rows are never indexed — client ids < num_clients). When
@@ -393,6 +405,21 @@ class FedModel:
             return x
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(a, self._replicated), x)
+
+    def place_server_state(self, state):
+        """Commit a fresh/restored ServerState to the round step's output
+        shardings (server.place_server_state — the one residency rule):
+        replicated on the replicated plane; with --server_shard, dense
+        velocity/error and the qres carry are dim-0-sharded over the
+        worker axis (the jit outputs carry those shardings, so — like
+        ``_place_replicated`` — this also avoids the round-1 retrace AND
+        the jax 0.4.37 hazard of donating an unplaced single-device buffer
+        into a mesh-sharded step)."""
+        from commefficient_tpu.federated.server import place_server_state
+
+        return place_server_state(state, self.mesh,
+                                  self.server_config.mode,
+                                  bool(self._n_shard))
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -589,12 +616,15 @@ class FedOptimizer:
         self.args = args
         self.param_groups = param_groups or [(None, 1.0)]
         self._lr_factor = 0.0
-        # placed on the round step's replicated sharding for the same
-        # round-1 retrace reason as FedModel's PS state; device_put creates
-        # a distinct buffer per leaf, preserving the donation-safety split
-        # of init_server_state
-        self.server_state = fed_model._place_replicated(
-            init_server_state(fed_model.server_config, fed_model.sketch))
+        # placed on the round step's output shardings (replicated, or the
+        # --server_shard residency) for the same round-1 retrace reason as
+        # FedModel's PS state; device_put creates a distinct buffer per
+        # leaf, preserving the donation-safety split of init_server_state
+        self.server_state = fed_model.place_server_state(
+            init_server_state(
+                fed_model.server_config, fed_model.sketch,
+                shard_n=fed_model._n_shard,
+                quantized=fed_model._reduce_dtype == "int8"))
         self._base_lr_vec = None
         if len(self.param_groups) > 1 or self.param_groups[0][0] is not None:
             vec = np.zeros(fed_model.grad_size, np.float32)

@@ -85,8 +85,28 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
             arrays["client/" + name] = np.asarray(arr)
     arrays.update({"model_state/" + k: v
                    for k, v in _flatten(fm._model_state).items()})
-    arrays["server/velocity"] = np.asarray(optimizer.server_state.velocity)
-    arrays["server/error"] = np.asarray(optimizer.server_state.error)
+
+    def canon_server(arr):
+        # sharded-server dense state (--server_shard) lives as (d_pad,)
+        # dim-0-sharded arrays; checkpoints store the layout-independent
+        # (d,) view (np.asarray gathers the shards) so sharded and
+        # replicated runs restore each other's checkpoints — the same
+        # contract as `canon` for the chunked ps layout. Sketch tables
+        # are identical in both planes and pass through.
+        a = np.asarray(arr)
+        if getattr(fm, "_n_shard", 0) and a.ndim == 1 \
+                and a.shape[0] != fm.grad_size:
+            a = a[: fm.grad_size]
+        return a
+
+    arrays["server/velocity"] = canon_server(optimizer.server_state.velocity)
+    arrays["server/error"] = canon_server(optimizer.server_state.error)
+    if optimizer.server_state.qres is not None:
+        # the int8 transmit collective's per-chip EF carry
+        # (server.ServerState.qres) — shape (n_shard, *transmit_shape), a
+        # shard-count-dependent layout; the restore zero-inits it when the
+        # geometry changed (a safe restart for an error-feedback carry)
+        arrays["server/qres"] = np.asarray(optimizer.server_state.qres)
     arrays["rng"] = np.asarray(jax.random.key_data(fm._rng))
     np_name, np_keys, np_pos, np_has_gauss, np_cached = np.random.get_state()
     arrays["np_rng/keys"] = np_keys
@@ -161,10 +181,13 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
 
     layout = getattr(fm, "layout", None)
     check_shape("ps_weights", flat["ps_weights"].shape, (fm.grad_size,))
-    check_shape("server velocity", flat["server/velocity"].shape,
-                tuple(optimizer.server_state.velocity.shape))
-    check_shape("server error", flat["server/error"].shape,
-                tuple(optimizer.server_state.error.shape))
+    # server state is stored in its canonical view: (d,) flat for dense
+    # modes (sharded runs re-pad below), the (r, c_pad) table for sketch
+    cur_v = optimizer.server_state.velocity
+    dense_sharded = getattr(fm, "_n_shard", 0) and cur_v.ndim == 1
+    exp_server = (fm.grad_size,) if dense_sharded else tuple(cur_v.shape)
+    check_shape("server velocity", flat["server/velocity"].shape, exp_server)
+    check_shape("server error", flat["server/error"].shape, exp_server)
 
     def place(x):
         # restored arrays re-commit to the round step's replicated sharding
@@ -225,9 +248,33 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
 
     from commefficient_tpu.federated.server import ServerState
 
-    optimizer.server_state = ServerState(
-        velocity=place(jnp.asarray(flat["server/velocity"])),
-        error=place(jnp.asarray(flat["server/error"])))
+    def server_resident(arr):
+        a = jnp.asarray(arr)
+        if dense_sharded:
+            a = jnp.pad(a, (0, int(cur_v.shape[0]) - fm.grad_size))
+        return a
+
+    cur_q = optimizer.server_state.qres
+    qres = None
+    if cur_q is not None:
+        if "server/qres" in flat \
+                and flat["server/qres"].shape == tuple(cur_q.shape):
+            qres = jnp.asarray(flat["server/qres"])
+        else:
+            # missing (pre-int8 checkpoint) or a different shard geometry:
+            # an EF carry restarts safely from zero — warn, don't fail
+            import warnings
+
+            warnings.warn("checkpoint has no matching server/qres carry; "
+                          "re-initializing the quantized-reduce residual "
+                          "to zero")
+            qres = jnp.zeros_like(cur_q)
+    state = ServerState(velocity=server_resident(flat["server/velocity"]),
+                        error=server_resident(flat["server/error"]),
+                        qres=qres)
+    placer = getattr(fm, "place_server_state", None)
+    optimizer.server_state = (placer(state) if placer is not None
+                              else jax.tree_util.tree_map(place, state))
 
     np_meta = meta["np_rng"]
     np.random.set_state((np_meta["name"], flat["np_rng/keys"],

@@ -91,7 +91,14 @@ class ClientStates(NamedTuple):
 
 class RoundContext(NamedTuple):
     """Client-phase outputs the server phase needs (the functional stand-in
-    for the reference's cross-phase module globals, fed_aggregator.py:37-44)."""
+    for the reference's cross-phase module globals, fed_aggregator.py:37-44).
+
+    With the sharded server plane (``RoundConfig.server_shard``)
+    ``gradient`` is the UNREDUCED stack of per-shard transmit sums —
+    ``(n, ...)`` sharded over the worker axis, no data movement between
+    the phases — and ``count`` carries the round's datum count so the
+    data-weighted division happens AFTER the server's reduce (keeping the
+    summed values bit-identical to the replicated path's psum)."""
 
     gradient: jax.Array
     ids: jax.Array
@@ -101,6 +108,7 @@ class RoundContext(NamedTuple):
     stale_rows: jax.Array
     new_vel: jax.Array
     new_err: jax.Array
+    count: Optional[jax.Array] = None
 
 
 def init_client_states(num_clients: int, grad_size: int, wcfg: WorkerConfig,
@@ -167,6 +175,18 @@ class RoundConfig:
     # the server velocity/error). False pins the copying path; the
     # donation-parity test uses it to show results are bit-identical.
     donate: bool = True
+    # Sharded server data plane (--server_shard, docs/sharded_server.md):
+    # reduce-scatter the transmit over the worker mesh axis, run the
+    # server rule per-shard (threshold via a psum'd count exchange), and
+    # all-gather only the resulting update. fp32 trajectories are
+    # bit-identical to the replicated path. Requires a mesh; incompatible
+    # with --topk_down (its stale-weight math lives on dense client rows).
+    server_shard: bool = False
+    # Transmit-collective element type (--reduce_dtype): "int8" swaps the
+    # fp32 reduce for the block-scaled stochastic-rounding collective
+    # (ops/collectives.py) with its residual carried in ServerState.qres.
+    # Opt-in; requires server_shard.
+    reduce_dtype: str = "float32"
 
 
 class FederatedSteps(NamedTuple):
@@ -191,6 +211,20 @@ def build_round_step(
 ) -> FederatedSteps:
     wcfg, scfg = cfg.worker, cfg.server
 
+    # Sharded server data plane (docs/sharded_server.md): legality checks
+    # up front, mirroring the chunked_resident ones below.
+    server_shard = bool(cfg.server_shard)
+    assert cfg.reduce_dtype in ("float32", "int8"), cfg.reduce_dtype
+    if cfg.reduce_dtype == "int8":
+        assert server_shard, "--reduce_dtype int8 requires --server_shard"
+    if server_shard:
+        assert mesh is not None and axis in mesh.axis_names, \
+            "--server_shard needs a mesh with the worker axis"
+        assert not wcfg.do_topk_down, \
+            "--server_shard is incompatible with --topk_down (stale-" \
+            "weight reconstruction lives on dense per-client rows)"
+    n_shard = mesh.shape[axis] if server_shard else 1
+
     # Chunked-resident data plane: ps_weights (and every dense (d,)-shaped
     # value of the server phase — unsketched update, per-coordinate lr) stay
     # in the sketch's lane-aligned (T, S, 128) chunk layout across rounds, so
@@ -212,6 +246,11 @@ def build_round_step(
         assert not wcfg.do_topk_down, \
             "chunked_resident is incompatible with --topk_down stale weights"
     layout = sketch.chunk_layout if chunked else None
+    if server_shard and wcfg.mode == "sketch":
+        # the sharded sketch server produces its update in the chunk
+        # layout (estimates/top-k/re-sketch slices are chunk-aligned)
+        assert chunked, "--server_shard sketch mode requires the " \
+            "chunked-resident data plane (don't force chunked_resident=False)"
 
     def unravel_res(w):
         """Resident weights → parameter pytree (the one flat materialization
@@ -477,7 +516,13 @@ def build_round_step(
                 local_sum = sketch_chunks(sketch, local_sum)
             else:
                 local_sum = sketch_vec(sketch, local_sum)
-        if mesh is not None:
+        if server_shard:
+            # sharded server plane: DON'T reduce here — return this
+            # shard's sum stacked under a leading axis (out_spec P(axis):
+            # no data moves), so the server phase owns the reduce (and,
+            # under --reduce_dtype int8, the quantization + qres carry)
+            total = local_sum[None]
+        elif mesh is not None:
             total = jax.lax.psum(local_sum, axis)
         else:
             total = local_sum
@@ -532,7 +577,7 @@ def build_round_step(
             clients_shard,
             mesh=mesh,
             in_specs=(rep, vec, vec, vec, rep, bspec, rep, vec, vec),
-            out_specs=(rep, vec, vec, rep, vec),
+            out_specs=(vec if server_shard else rep, vec, vec, rep, vec),
             check_vma=False,
         )
 
@@ -564,13 +609,49 @@ def build_round_step(
 
         # data-weighted average (reference fed_aggregator.py:332)
         total_count = jnp.maximum(batch["mask"].sum(), 1.0)
-        gradient = total / total_count
+        if server_shard:
+            # keep the per-shard sums raw: the division happens after the
+            # server phase's reduce, so Σ then ÷ matches the replicated
+            # path's psum-then-÷ bit-for-bit
+            gradient, count = total, total_count
+        else:
+            gradient, count = total / total_count, None
 
         ctx = RoundContext(gradient, ids, worker_mask, vel_rows, err_rows,
-                           stale_rows, new_vel, new_err)
+                           stale_rows, new_vel, new_err, count)
         return ctx, new_model_state, metrics
 
     # ---- phase 2: server update + state scatter ------------------------
+
+    # Sharded server plane: one shard_map over the worker axis owns the
+    # transmit reduce (fp32 psum/psum_scatter, or the int8 EF collective),
+    # the per-shard server rule, and the update all-gather
+    # (server.sharded_server_update). State specs: dense velocity/error
+    # are dim-0-sharded slices; sketch tables are replicated (already
+    # transmit-sized); the qres carry is per-chip (dim-0-sharded).
+    _sharded_server = None
+    if server_shard:
+        from commefficient_tpu.federated.server import sharded_server_update
+
+        _vec = P(axis)
+        _state_spec = ServerState(
+            velocity=P() if scfg.mode == "sketch" else _vec,
+            error=P() if scfg.mode == "sketch" else _vec,
+            qres=_vec)
+
+        def _sharded_inner(g, st, lr_, rng_, count_):
+            return sharded_server_update(
+                g[0], st, scfg, lr_, count_, axis=axis, n_shard=n_shard,
+                sketch=sketch, layout=layout, rng=rng_,
+                reduce_dtype=cfg.reduce_dtype)
+
+        def _sharded_server(grad_stacked, server_state, lr_, rng_, count_):
+            return shard_map(
+                _sharded_inner, mesh=mesh,
+                in_specs=(_vec, _state_spec, P(), P(), P()),
+                out_specs=(P(), _state_spec, P()),
+                check_vma=False,
+            )(grad_stacked, server_state, jnp.asarray(lr_), rng_, count_)
 
     def server_step(ps_weights, server_state: ServerState,
                     client_states: ClientStates, ctx: RoundContext, lr, rng):
@@ -583,9 +664,14 @@ def build_round_step(
         # fedavg applies lr on-worker; server sees lr=1
         # (reference fed_aggregator.py:441-451)
         eff_lr = 1.0 if wcfg.mode == "fedavg" else lr
-        update, new_server_state = server_update(ctx.gradient, server_state,
-                                                 scfg, eff_lr, sketch=sketch,
-                                                 rng=rng, layout=layout)
+        resketched = None
+        if server_shard:
+            update, new_server_state, resketched = _sharded_server(
+                ctx.gradient, server_state, eff_lr, rng, ctx.count)
+        else:
+            update, new_server_state = server_update(
+                ctx.gradient, server_state, scfg, eff_lr, sketch=sketch,
+                rng=rng, layout=layout)
         new_ps = ps_weights - update
 
         ids = ctx.ids
@@ -605,9 +691,18 @@ def build_round_step(
         if wcfg.mode == "true_topk" and wcfg.local_momentum > 0:
             keep_vel = (update == 0).astype(jnp.float32)[None, :]
         elif wcfg.mode == "sketch" and (wcfg.has_velocity or wcfg.has_error):
-            resketch = sketch_chunks if chunked else sketch_vec
-            cell_keep = (resketch(sketch, update) == 0).astype(
-                jnp.float32)[None]
+            if resketched is not None and jnp.ndim(eff_lr) == 0:
+                # sharded server: the psum'd partial re-sketch (of the
+                # UNSCALED update) is already in hand; sketches are linear,
+                # so scaling it by the scalar lr equals re-sketching the
+                # scaled update — no replicated d-sized re-sketch. A
+                # per-coordinate lr vector scales before the sketch, so
+                # that case recomputes below.
+                sketched_update = resketched * eff_lr
+            else:
+                resketch = sketch_chunks if chunked else sketch_vec
+                sketched_update = resketch(sketch, update)
+            cell_keep = (sketched_update == 0).astype(jnp.float32)[None]
             keep_vel = keep_err = cell_keep
 
         # One delta-scatter per state array writes the masked new rows for

@@ -38,12 +38,14 @@ import jax.numpy as jnp
 from commefficient_tpu.ops.flat import ChunkLayout
 from commefficient_tpu.ops.sketch import (
     CountSketch,
+    estimates_chunks_local,
     sketch_chunks,
+    sketch_chunks_local,
     sketch_vec,
     unsketch,
     unsketch_chunks,
 )
-from commefficient_tpu.ops.topk import topk
+from commefficient_tpu.ops.topk import topk, topk_dense_nd
 
 MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
 ERROR_TYPES = ("none", "local", "virtual")
@@ -88,24 +90,79 @@ class ServerConfig:
 
 class ServerState(NamedTuple):
     """(velocity, error) — shape (num_rows, num_cols) for sketch mode, else
-    (grad_size,) (reference fed_aggregator.py:399-409)."""
+    (grad_size,) (reference fed_aggregator.py:399-409).
+
+    Sharded server data plane (``--server_shard``, docs/sharded_server.md):
+    dense-mode velocity/error become ``(d_pad,)`` (grad_size padded to a
+    multiple of the shard count), row-sharded over the worker axis — each
+    chip stores and updates only its ``d_pad/n`` slice. Sketch-mode tables
+    stay replicated (they are the already-small transmit). ``qres`` exists
+    only under ``--reduce_dtype int8``: each chip's un-transmitted
+    quantization remainder from the block-scaled int8 transmit collective
+    (ops/collectives.py), shape ``(n, *transmit_shape)`` sharded over dim
+    0 — the error-feedback carry that is added back into the chip's next
+    contribution before quantization, so the quantized reduce is
+    compensated, not lossy."""
 
     velocity: jax.Array
     error: jax.Array
+    qres: Optional[jax.Array] = None
 
 
-def init_server_state(cfg: ServerConfig, sketch: Optional[CountSketch] = None) -> ServerState:
+def init_server_state(cfg: ServerConfig, sketch: Optional[CountSketch] = None,
+                      shard_n: int = 0,
+                      quantized: bool = False) -> ServerState:
+    """``shard_n`` > 0 selects the sharded-server residency (see
+    ServerState): dense state padded to a shard_n multiple, plus the
+    ``qres`` carry when ``quantized``."""
     if cfg.mode == "sketch":
         assert sketch is not None
         shape = sketch.table_shape
     else:
-        shape = (cfg.grad_size,)
+        d = cfg.grad_size
+        shape = (-(-d // shard_n) * shard_n,) if shard_n else (d,)
+    qres = None
+    if quantized:
+        assert shard_n > 0, "--reduce_dtype int8 requires --server_shard"
+        qres = jnp.zeros((shard_n,) + shape if cfg.mode == "sketch"
+                         else (shard_n, shape[0]), jnp.float32)
     # Two separate zeros computations, NOT one shared array: the round step
     # donates server_state (rounds.build_round_step), and donating a pytree
     # whose two leaves share one buffer is an execute-time error
     # ("attempt to donate the same buffer twice").
     return ServerState(velocity=jnp.zeros(shape, jnp.float32),
-                       error=jnp.zeros(shape, jnp.float32))
+                       error=jnp.zeros(shape, jnp.float32),
+                       qres=qres)
+
+
+def place_server_state(state: ServerState, mesh, mode: str,
+                       server_shard: bool, put=None) -> ServerState:
+    """THE sharded-server residency rule, in one place (callers: FedModel,
+    bench.py, the multichip dry-run): sketch tables replicated (they are
+    the already-small transmit), dense velocity/error dim-0-sharded over
+    the worker axis, the qres carry always dim-0-sharded. Committing
+    fresh state to these shardings up front keeps round 1 on the jit
+    cache and donation safe (see aggregator._place_replicated). ``put``
+    overrides plain ``jax.device_put`` for multi-process global arrays
+    (``__graft_entry__.run_tiny_sketched_round``)."""
+    from commefficient_tpu.parallel.mesh import (
+        replicated_sharding,
+        server_shard_sharding,
+    )
+
+    if mesh is None:
+        return state
+    if put is None:
+        def put(x, sharding):
+            return jax.device_put(x, sharding)
+
+    rep = replicated_sharding(mesh)
+    sh0 = server_shard_sharding(mesh)
+    state_sh = sh0 if (server_shard and mode != "sketch") else rep
+    return state._replace(
+        velocity=put(state.velocity, state_sh),
+        error=put(state.error, state_sh),
+        qres=None if state.qres is None else put(state.qres, sh0))
 
 
 def server_update(
@@ -182,6 +239,143 @@ def _local_topk(local_topk_grad, state, cfg, lr):
     # fed_aggregator.py:559-563)
     velocity = local_topk_grad + cfg.virtual_momentum * state.velocity
     return velocity * lr, ServerState(velocity, state.error)
+
+
+def sharded_server_update(
+    transmit_local: jax.Array,
+    state: ServerState,
+    cfg: ServerConfig,
+    lr,
+    count,
+    *,
+    axis: str,
+    n_shard: int,
+    sketch: Optional[CountSketch] = None,
+    layout: Optional[ChunkLayout] = None,
+    rng: Optional[jax.Array] = None,
+    reduce_dtype: str = "float32",
+) -> Tuple[jax.Array, ServerState, Optional[jax.Array]]:
+    """The sharded server data plane's per-shard step — MUST run inside a
+    ``shard_map`` over mesh axis ``axis`` (rounds.build_round_step wraps
+    it). Replaces ``psum → replicated server_update`` with
+    reduce-scatter → per-shard update → all-gather (Xu et al.,
+    arXiv:2004.13336):
+
+    - ``transmit_local`` is this chip's UNREDUCED transmit sum (the
+      ``(r, c_pad)`` sketch table, or the flat dense ``(d,)`` sum); the
+      round average's ``/count`` division happens here, AFTER the reduce,
+      so the summed values are bit-identical to the replicated path's.
+    - dense modes reduce-scatter the transmit over a ``d_pad = n·⌈d/n⌉``
+      zero-padded flat view and run velocity/error/masking on the local
+      ``d_pad/n`` slice (``state`` arrives as local slices); sketch mode
+      psums the (small) table, keeps the table algebra replicated, and
+      shards the d-sized chunk plane: ``estimates_chunks_local`` /
+      ``topk_dense_nd(axis_name=...)`` / ``sketch_chunks_local`` over
+      this shard's ``⌈T/n⌉`` chunks.
+    - the one genuinely global quantity — the top-k threshold — comes
+      from the radix descent's per-candidate counts psum'd over the axis
+      (ops/topk.py): 16 ints per pass instead of a per-chip full vector.
+    - only the RESULT is all-gathered: the update slice (exact f32 data
+      movement), then scaled by ``lr`` replicated — so fp32 trajectories
+      are bit-identical to ``server_update``'s (pinned in
+      tests/test_sharded_server.py).
+    - ``reduce_dtype == "int8"`` swaps the reduce for the block-scaled
+      stochastic-rounding collective (ops/collectives.py); the carry
+      ``state.qres`` (this chip's row) is folded into the contribution
+      before quantization and the new remainder is returned in the new
+      state — error feedback for the transmit itself.
+
+    Returns ``(lr-scaled full update, new local state, re-sketched update
+    table or None)`` — the table is sketch mode's cell-masking byproduct
+    (psum of the shards' partial re-sketches), reused by the round's
+    client-state masking so it is not recomputed.
+    """
+    assert reduce_dtype in ("float32", "int8"), reduce_dtype
+    from commefficient_tpu.ops.collectives import (
+        all_gather_tiled,
+        quantized_psum,
+        quantized_psum_scatter,
+        reduce_scatter_sum,
+    )
+
+    qres_local = state.qres  # (1, *transmit_shape) local row, or None
+    if reduce_dtype == "int8":
+        assert qres_local is not None, \
+            "int8 reduce needs the qres carry (init_server_state quantized=)"
+
+    if cfg.mode == "sketch":
+        assert sketch is not None and layout is not None
+        if reduce_dtype == "int8":
+            # block = one table row (c_pad = S·128 lanes) per scale
+            table, new_qres = quantized_psum(
+                transmit_local, axis, rng, residual=qres_local[0],
+                block=sketch.c_pad)
+            new_qres = new_qres[None]
+        else:
+            table = jax.lax.psum(transmit_local, axis)
+            new_qres = qres_local
+        table = table / count
+        velocity = table + cfg.virtual_momentum * state.velocity
+        if cfg.error_type == "virtual":
+            error = state.error + velocity
+        else:  # "local" and the documented "none" deviation alike
+            error = velocity
+
+        Tn = -(-sketch.T // n_shard)
+        t0 = jax.lax.axis_index(axis) * Tn
+        est_local = estimates_chunks_local(sketch, error, t0, Tn)
+        upd_local = topk_dense_nd(est_local, cfg.k, axis_name=axis)
+        resketched = jax.lax.psum(
+            sketch_chunks_local(sketch, upd_local, t0), axis)
+        cell_nz = resketched != 0
+        if cfg.error_type == "virtual":
+            error = jnp.where(cell_nz, 0.0, error)
+        velocity = jnp.where(cell_nz, 0.0, velocity)
+        if cfg.error_type == "local":
+            # torch aliasing parity (see _sketched)
+            error = velocity
+        update = all_gather_tiled(upd_local, axis)[: sketch.T]
+        return (update * lr, ServerState(velocity, error, new_qres),
+                resketched)
+
+    # ---- dense modes: flat (d,) transmit, state as local slices --------
+    d = cfg.grad_size
+    d_pad = -(-d // n_shard) * n_shard
+    x = jnp.pad(transmit_local, (0, d_pad - d))
+    if reduce_dtype == "int8":
+        tile, new_qres = quantized_psum_scatter(x, axis, rng,
+                                                residual=qres_local[0])
+        new_qres = new_qres[None]
+    else:
+        tile = reduce_scatter_sum(x, axis)
+        new_qres = qres_local
+    grad = tile / count
+
+    velocity = grad + cfg.virtual_momentum * state.velocity
+    error = state.error
+    if cfg.mode == "true_topk":
+        error = error + velocity
+        upd_local = topk_dense_nd(error, cfg.k, axis_name=axis)
+        nz = upd_local != 0
+        error = jnp.where(nz, 0.0, error)
+        velocity = jnp.where(nz, 0.0, velocity)
+    else:  # uncompressed / local_topk / fedavg: update IS the velocity
+        upd_local = velocity
+        if cfg.mode == "uncompressed" and cfg.do_dp \
+                and cfg.dp_mode == "server":
+            assert rng is not None, "server DP needs an rng key"
+            # one replicated (d_pad,)-stream draw, locally sliced, so every
+            # shard agrees on the full noise vector (the stream differs
+            # from the replicated path's (d,)-shaped draw — documented in
+            # docs/sharded_server.md)
+            noise = jax.random.normal(rng, (d_pad,), upd_local.dtype)
+            per = d_pad // n_shard
+            upd_local = upd_local + cfg.noise_multiplier * \
+                jax.lax.dynamic_slice_in_dim(
+                    noise, jax.lax.axis_index(axis) * per, per)
+
+    update = all_gather_tiled(upd_local, axis)[:d]
+    return update * lr, ServerState(velocity, error, new_qres), None
 
 
 def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch,

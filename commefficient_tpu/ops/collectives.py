@@ -1,11 +1,12 @@
-"""Collective operators with pinned VJPs for SPMD parallelism.
+"""Collective operators: pinned-VJP psums for SPMD parallelism, and the
+sharded server data plane's transmit collectives.
 
-Under ``shard_map`` without replication tracking, JAX transposes a plain
-``lax.psum`` to another ``psum`` — so differentiating through a forward
-reduction scales every upstream gradient by the axis size (measured as an
-exact nm×/nsq× error on tensor- and sequence-parallel gradients). The two
-operators here pin the transposes the parallel layers actually mean, the
-Megatron f/g pair:
+Part 1 — autodiff-pinned psums. Under ``shard_map`` without replication
+tracking, JAX transposes a plain ``lax.psum`` to another ``psum`` — so
+differentiating through a forward reduction scales every upstream gradient
+by the axis size (measured as an exact nm×/nsq× error on tensor- and
+sequence-parallel gradients). The two operators here pin the transposes the
+parallel layers actually mean, the Megatron f/g pair:
 
 - ``psum_repct`` (the g operator): psum forward, **identity** backward —
   for reductions whose output's cotangent is replicated across the axis
@@ -24,6 +25,29 @@ sequence parallelism (``federated/losses.py`` nll reduction, the GPT-2 mc
 head), expert parallelism and the MoE aux (``parallel/moe.py``). Lives in
 ``ops`` (not ``parallel``) so ``models`` can import it without pulling in
 the ``parallel`` package's model-importing submodules (circular import).
+
+Part 2 — transmit collectives for the sharded server data plane
+(``--server_shard``, docs/sharded_server.md). Forward-only (used in the
+server phase, outside autodiff):
+
+- ``reduce_scatter_sum`` / ``all_gather_tiled``: the Xu et al.
+  (arXiv:2004.13336) reduce-scatter → per-shard update → all-gather pair.
+  ``lax.psum_scatter(tiled=True)`` is bit-identical to ``psum`` + the
+  shard's static slice (all-reduce ≡ reduce-scatter + all-gather, same
+  ring reduction order), which is what makes the fp32 sharded server
+  trajectory bit-identical to the replicated one — pinned by
+  tests/test_sharded_server.py.
+- ``quantized_psum_scatter`` / ``quantized_psum``: opt-in
+  (``--reduce_dtype int8``) EQuARX-style (arXiv:2506.17615) block-scaled
+  int8 collectives with **stochastic rounding** and an explicit
+  **error-feedback residual**: each chip's un-transmitted quantization
+  remainder is returned to the caller, persisted (``ServerState.qres``),
+  and added back into the chip's next-round contribution before
+  quantization — the transmit error telescopes instead of accumulating,
+  the same compensation contract as the server's top-k error feedback.
+  Implemented as an ``all_to_all`` of int8 payloads + per-block f32
+  scales (≈4× fewer ICI bytes than an f32 reduce), dequantize-and-sum in
+  f32 on the destination shard.
 """
 
 from __future__ import annotations
@@ -31,8 +55,19 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 
-__all__ = ["psum_repct", "ident_psumct"]
+__all__ = [
+    "psum_repct",
+    "ident_psumct",
+    "reduce_scatter_sum",
+    "all_gather_tiled",
+    "quantize_int8_blocks",
+    "dequantize_int8_blocks",
+    "quantized_psum_scatter",
+    "quantized_psum",
+    "DEFAULT_QUANT_BLOCK",
+]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
@@ -69,3 +104,134 @@ def _ident_psumct_bwd(axis_name, _, ct):
 
 
 ident_psumct.defvjp(_ident_psumct_fwd, _ident_psumct_bwd)
+
+
+# --------------------------------------------------------------------------
+# sharded-server transmit collectives (forward-only; see module docstring)
+# --------------------------------------------------------------------------
+
+# Default quantization block: 64 sublanes x 128 lanes = 8192 elements per
+# f32 scale (0.05% scale overhead). The chunked sketch plane instead passes
+# its own (S, 128) chunk size so one scale covers exactly one resident
+# chunk; the sketch-table all-reduce passes one table row (c_pad = S·128).
+DEFAULT_QUANT_BLOCK = 64 * 128
+
+_INT8_MAX = 127.0
+
+
+def reduce_scatter_sum(x, axis_name):
+    """Sum ``x`` elementwise across ``axis_name`` and return this shard's
+    dim-0 tile (``x.shape[0]`` must divide by the axis size). Must run
+    inside ``shard_map``. Bit-identical to ``psum`` + the shard's static
+    slice (all-reduce ≡ reduce-scatter + all-gather)."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0,
+                                tiled=True)
+
+
+def all_gather_tiled(x, axis_name):
+    """Concatenate the shards' dim-0 tiles back into the full array
+    (replicated). Pure data movement — exact."""
+    return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def quantize_int8_blocks(x, rng):
+    """Block-scaled int8 stochastic-rounding quantization.
+
+    ``x`` is ``(..., block)``; returns ``(q int8, scale f32)`` with one
+    scale per leading index: ``scale = max|block| / 127`` and
+    ``q = SR(x / scale)``. Stochastic rounding makes the quantizer
+    unbiased (``E[q·scale] = x``); the deterministic residual
+    ``x − q·scale`` is what the EF collectives below carry forward.
+    An all-zero block gets scale 0 and q 0 (exact)."""
+    scale = jnp.max(jnp.abs(x), axis=-1) / _INT8_MAX
+    safe = jnp.where(scale > 0, scale, 1.0)
+    y = x / safe[..., None]
+    lo = jnp.floor(y)
+    frac = y - lo
+    u = jax.random.uniform(rng, x.shape, dtype=x.dtype)
+    q = lo + (u < frac).astype(x.dtype)
+    q = jnp.clip(q, -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8_blocks(q, scale):
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def quantized_psum_scatter(x, axis_name, rng, residual=None,
+                           block=DEFAULT_QUANT_BLOCK):
+    """Error-feedback block-scaled int8 reduce-scatter over dim 0.
+
+    Must run inside ``shard_map``; ``x.shape[0]`` must divide by the axis
+    size ``n``. Each chip adds its carried ``residual`` (same shape as
+    ``x``; None ⇒ zeros) to its contribution, quantizes each
+    destination's tile with per-``block`` scales + stochastic rounding,
+    moves int8 payloads with one ``all_to_all``, and the destination
+    dequantizes and sums the ``n`` contributions in f32.
+
+    Returns ``(local_sum_tile, new_residual)``:
+    ``local_sum_tile`` is this shard's dim-0 tile of
+    ``Σ_chips Q(x_chip + residual_chip)``; ``new_residual`` is this
+    chip's un-transmitted remainder ``(x + residual) − Q(x + residual)``,
+    to be persisted and passed back next round. Conservation (pinned in
+    tests): gathered sums + psum of new residuals ≡ Σ (x + residual).
+    """
+    n = jax.lax.psum(1, axis_name)
+    if residual is not None:
+        x = x + residual
+    shape = x.shape
+    assert shape[0] % n == 0, (shape, n)
+    per = shape[0] // n
+    tile_elems = x.size // n
+    # block each destination tile independently (zero-padded to a block
+    # multiple) so block boundaries never straddle two destinations
+    nbd = -(-tile_elems // block)
+    rows = jnp.pad(x.reshape(n, tile_elems),
+                   ((0, 0), (0, nbd * block - tile_elems)))
+    xb = rows.reshape(n, nbd, block)
+    # per-chip rng stream: fold in the shard index so the SR draws
+    # decorrelate across chips (same key on every chip otherwise)
+    rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+    q, scale = quantize_int8_blocks(xb, rng)
+    new_residual = (xb - dequantize_int8_blocks(q, scale)) \
+        .reshape(n, nbd * block)[:, :tile_elems].reshape(shape)
+    # all_to_all: send destination j's int8 tile (and scales) to shard j;
+    # receive the n chips' tiles for MY slice
+    q_in = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+    s_in = jax.lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+    tile = jnp.sum(dequantize_int8_blocks(q_in, s_in), axis=0)
+    tile = tile.reshape(-1)[:tile_elems]
+    return tile.reshape((per,) + shape[1:]), new_residual
+
+
+def quantized_psum(x, axis_name, rng, residual=None,
+                   block=DEFAULT_QUANT_BLOCK):
+    """Error-feedback block-scaled int8 all-reduce (reduce-scatter over a
+    padded flat view + exact f32 all-gather): every shard receives the
+    same summed array, so replicated state updated from it stays
+    replicated. Returns ``(sum, new_residual)`` with ``new_residual`` in
+    ``x``'s shape (see ``quantized_psum_scatter``)."""
+    n = jax.lax.psum(1, axis_name)
+    size = x.size
+    # Small arrays (size < n·block — e.g. a few-row sketch table on a
+    # wide mesh): rounding every per-shard tile up to a full block would
+    # pad the transmit to n·block elements, which can EXCEED the fp32
+    # reduce's bytes (the opposite of the feature's point). Shrink the
+    # block to the per-shard tile instead — finer scales are tighter
+    # quantization, and the padding stays < n elements.
+    block = min(block, max(1, -(-size // n)))
+    # block-aligned per-shard tiles: every scale block then sits inside
+    # one tile AND at a block-multiple offset of the flat array, so a
+    # caller-chosen block boundary (e.g. one sketch-table row, block =
+    # c_pad) is never straddled by a scale whenever tiles hold ≥ 1 block
+    tile = -(-size // (n * block)) * block
+    flat = jnp.pad(x.reshape(-1), (0, n * tile - size))
+    res_flat = None
+    if residual is not None:
+        res_flat = jnp.pad(residual.reshape(-1), (0, n * tile - size))
+    local, new_res = quantized_psum_scatter(flat, axis_name, rng,
+                                            residual=res_flat, block=block)
+    full = all_gather_tiled(local, axis_name)[:size].reshape(x.shape)
+    return full, new_res[:size].reshape(x.shape)

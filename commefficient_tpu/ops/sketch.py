@@ -59,6 +59,7 @@ because within-chunk heavy-hitter pairs never collide.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import numpy as np
 
@@ -69,6 +70,9 @@ from flax import struct
 _LANES = 128
 _M1 = np.int32(np.uint32(0x85EBCA6B).astype(np.int64) - (1 << 32))
 _M2 = np.int32(np.uint32(0xC2B2AE35).astype(np.int64) - (1 << 32))
+
+# zero chunk offset for the full-range kernel calls (a jit-time constant)
+_T0 = np.zeros(1, np.int32)
 
 
 def _mix32(x: jax.Array) -> jax.Array:
@@ -224,8 +228,29 @@ def _sketch_vec_jax(cs: CountSketch, v: jax.Array) -> jax.Array:
     return _sketch_chunks_jax(cs, _chunks3(cs, v))
 
 
-def _sketch_chunks_jax(cs: CountSketch, v3: jax.Array) -> jax.Array:
+def _local_shift_cols(q: jax.Array, w: jax.Array, t0, Tn: int):
+    """Columns ``[t0, t0+Tn)`` of the ``(r, T)`` shift arrays, for a
+    TRACED global chunk offset ``t0``. Zero-padded by ``Tn`` first so the
+    dynamic slice never clamps across valid columns: a slice containing
+    any valid chunk (``t0 < T``) is fully in bounds, and a slice entirely
+    past ``T`` (sharded-server tail shards) reads padding/clamped values
+    whose outputs are tail-masked anyway (all their coordinates ≥ d)."""
+    qp = jnp.pad(q, ((0, 0), (0, Tn)))
+    wp = jnp.pad(w, ((0, 0), (0, Tn)))
+    q_cols = jax.lax.dynamic_slice_in_dim(qp, t0, Tn, axis=1)
+    w_cols = jax.lax.dynamic_slice_in_dim(wp, t0, Tn, axis=1)
+    return q_cols, w_cols
+
+
+def _sketch_chunks_jax(cs: CountSketch, v3: jax.Array,
+                       t0=None) -> jax.Array:
+    """Accumulate chunk layout → table. ``t0`` (traced, default chunk 0)
+    offsets the chunks' global coordinate base — the sharded-server
+    partial accumulate: ``v3`` then holds ``Tn ≤ T`` chunks starting at
+    global chunk ``t0`` and the result is that range's PARTIAL table
+    (linearity: the psum of the shards' partials is the full table)."""
     S = cs.sublanes
+    Tn = v3.shape[0]
 
     def body(table, xs):
         chunk, q_r, w_r, t_base = xs
@@ -233,27 +258,40 @@ def _sketch_chunks_jax(cs: CountSketch, v3: jax.Array) -> jax.Array:
         rolled = jax.vmap(_roll2d)(sv, q_r, w_r)
         return table + rolled, None
 
-    t_bases = jnp.arange(cs.T, dtype=jnp.int32) * (S * _LANES)
+    if t0 is None:
+        q_cols, w_cols = cs.shift_q, cs.shift_w
+        t_bases = jnp.arange(Tn, dtype=jnp.int32) * (S * _LANES)
+    else:
+        q_cols, w_cols = _local_shift_cols(cs.shift_q, cs.shift_w, t0, Tn)
+        t_bases = (jnp.asarray(t0, jnp.int32)
+                   + jnp.arange(Tn, dtype=jnp.int32)) * (S * _LANES)
     init = jnp.zeros((cs.r, S, _LANES), jnp.float32)
     table, _ = jax.lax.scan(
-        body, init, (v3, cs.shift_q.T, cs.shift_w.T, t_bases))
+        body, init, (v3, q_cols.T, w_cols.T, t_bases))
     return table.reshape(cs.r, cs.c_pad)
 
 
 @functools.partial(jax.jit, static_argnames=("S", "T", "interpret"))
-def _sketch_vec_pallas(v3, shift_q, shift_w, sign_keys, *, S, T,
+def _sketch_vec_pallas(v3, shift_q, shift_w, sign_keys, t0, *, S, T,
                        interpret=False):
     """Fused accumulate kernel. Grid ``(r, T)``: each table row stays resident
     in VMEM while the T gradient chunks stream through; sign hashes come from
     iotas and the cyclic shift from the hardware lane-rotate plus a doubled-
-    buffer sublane slice (only the gradient is read from HBM)."""
+    buffer sublane slice (only the gradient is read from HBM).
+
+    ``t0`` ((1,) int32 scalar prefetch) is the chunks' global index offset:
+    0 for the full accumulate, the shard's first global chunk for the
+    sharded-server partial accumulate (shift arrays then arrive pre-sliced
+    to the local range; only the sign-hash coordinate base needs the
+    offset). With ``t0 == 0`` the math is bit-identical to the pre-offset
+    kernel."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     r = shift_q.shape[0]
     chunk_elems = S * _LANES
 
-    def kernel(q_ref, w_ref, key_ref, v_ref, out_ref, dbl):
+    def kernel(q_ref, w_ref, key_ref, t0_ref, v_ref, out_ref, dbl):
         row = pl.program_id(0)
         t = pl.program_id(1)
 
@@ -261,7 +299,7 @@ def _sketch_vec_pallas(v3, shift_q, shift_w, sign_keys, *, S, T,
         def _():
             out_ref[...] = jnp.zeros_like(out_ref)
 
-        idx = t * chunk_elems + (
+        idx = (t0_ref[0] + t) * chunk_elems + (
             jax.lax.broadcasted_iota(jnp.int32, (S, _LANES), 0) * _LANES
             + jax.lax.broadcasted_iota(jnp.int32, (S, _LANES), 1))
         sv = v_ref[0] * _signs_for(idx, key_ref[row])
@@ -288,7 +326,7 @@ def _sketch_vec_pallas(v3, shift_q, shift_w, sign_keys, *, S, T,
                                 dbl[pl.ds(S - q - 1, S), :])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(r, T),
         in_specs=[
             pl.BlockSpec((1, S, _LANES), lambda row, t, *_: (t, 0, 0)),
@@ -301,7 +339,7 @@ def _sketch_vec_pallas(v3, shift_q, shift_w, sign_keys, *, S, T,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((r, S, _LANES), jnp.float32),
         interpret=interpret,
-    )(shift_q, shift_w, sign_keys, v3)
+    )(shift_q, shift_w, sign_keys, t0, v3)
     return out
 
 
@@ -379,11 +417,18 @@ def _check_estimates_kernel_once(eager: bool = False) -> None:
             np.random.RandomState(5).randn(*cs.table_shape), jnp.float32)
         got = _estimates_pallas(
             _doubled_table(cs, tbl), cs.shift_q, cs.shift_w, cs.sign_keys,
-            S=cs.sublanes, T=cs.T, c_pad=cs.c_pad)
+            _T0, S=cs.sublanes, T=cs.T, c_pad=cs.c_pad)
         want = _estimates_jax(cs, tbl)
         if not np.array_equal(np.asarray(got).reshape(-1)[: cs.d],
                               np.asarray(want)):
             raise AssertionError("kernel output != pure XLA path")
+        # sharded-server local query (t0 ≠ 0, pre-sliced shifts) must equal
+        # the full path's slice bit-for-bit — the same kernel, offset base
+        t0v, Tn = 1, 2
+        got_l = estimates_chunks_local(cs, tbl, jnp.int32(t0v), Tn)
+        want_l = np.asarray(got)[t0v:t0v + Tn]
+        if not np.array_equal(np.asarray(got_l), want_l):
+            raise AssertionError("local query != full-path slice")
     except Exception as e:  # noqa: BLE001 — any failure means: don't use it
         os.environ["COMMEFFICIENT_PALLAS_ESTIMATES"] = "0"
         warnings.warn(
@@ -420,12 +465,20 @@ def _check_sketch_kernel_once(eager: bool = False) -> None:
         cs = make_sketch(d=450_000, c=140_000, r=3, seed=11, num_blocks=2)
         v = jnp.asarray(
             np.random.RandomState(6).randn(cs.d), jnp.float32)
+        v3 = _chunks3(cs, v)
         got = _sketch_vec_pallas(
-            _chunks3(cs, v), cs.shift_q, cs.shift_w, cs.sign_keys,
+            v3, cs.shift_q, cs.shift_w, cs.sign_keys, _T0,
             S=cs.sublanes, T=cs.T).reshape(cs.r, cs.c_pad)
         want = _sketch_vec_jax(cs, v)
         if not np.array_equal(np.asarray(got), np.asarray(want)):
             raise AssertionError("kernel output != pure XLA path")
+        # sharded-server partial accumulate (t0 ≠ 0): must equal the pure
+        # path's partial table for the same chunk range bit-for-bit
+        t0v, Tn = 1, 2
+        got_l = sketch_chunks_local(cs, v3[t0v:t0v + Tn], jnp.int32(t0v))
+        want_l = _sketch_chunks_jax(cs, v3[t0v:t0v + Tn], jnp.int32(t0v))
+        if not np.array_equal(np.asarray(got_l), np.asarray(want_l)):
+            raise AssertionError("local accumulate != pure XLA partial")
     except Exception as e:  # noqa: BLE001 — any failure means: don't use it
         os.environ["COMMEFFICIENT_PALLAS_SKETCH"] = "0"
         warnings.warn(
@@ -447,7 +500,7 @@ def sketch_vec(cs: CountSketch, v: jax.Array) -> jax.Array:
     if _use_pallas_sketch():
         v3 = _chunks3(cs, v)
         out = _sketch_vec_pallas(v3, cs.shift_q, cs.shift_w, cs.sign_keys,
-                                 S=cs.sublanes, T=cs.T)
+                                 _T0, S=cs.sublanes, T=cs.T)
         return out.reshape(cs.r, cs.c_pad)
     return _sketch_vec_jax(cs, v)
 
@@ -464,19 +517,51 @@ def sketch_chunks(cs: CountSketch, v3: jax.Array) -> jax.Array:
         _check_sketch_kernel_once(eager=True)
     if _use_pallas_sketch():
         out = _sketch_vec_pallas(v3, cs.shift_q, cs.shift_w, cs.sign_keys,
-                                 S=cs.sublanes, T=cs.T)
+                                 _T0, S=cs.sublanes, T=cs.T)
         return out.reshape(cs.r, cs.c_pad)
     return _sketch_chunks_jax(cs, v3)
+
+
+def sketch_chunks_local(cs: CountSketch, v3: jax.Array, t0,
+                        interpret: bool = False) -> jax.Array:
+    """PARTIAL ``(r, c_pad)`` table of ``Tn`` resident-layout chunks
+    starting at global chunk ``t0`` (a traced scalar) — the sharded
+    server's re-sketch of its local update slice. Linearity makes the
+    psum of the shards' partial tables equal the full ``sketch_chunks``
+    *mathematically* — but only up to float summation order (psum of
+    partials vs one sequential scan), so the sharded server consumes the
+    psum'd table for its **zero-cell pattern only** (cell masking), never
+    for values; an exact cross-order cancellation could in principle flip
+    a cell's zeroness (see docs/sharded_server.md). Per chunk the math IS
+    bit-identical to the full path's (same shifts, same sign-hash
+    coordinates). Chunks past ``cs.T`` (tail shards of an uneven split)
+    must be all-zero — their sliced shift values are padding, and zero
+    input contributes zero regardless."""
+    Tn = v3.shape[0]
+    assert v3.shape[1:] == (cs.sublanes, _LANES), v3.shape
+    if _use_pallas_sketch() or interpret:
+        q_cols, w_cols = _local_shift_cols(cs.shift_q, cs.shift_w, t0, Tn)
+        out = _sketch_vec_pallas(
+            v3, q_cols, w_cols, cs.sign_keys,
+            jnp.asarray(t0, jnp.int32).reshape(1), S=cs.sublanes, T=Tn,
+            interpret=interpret)
+        return out.reshape(cs.r, cs.c_pad)
+    return _sketch_chunks_jax(cs, v3, t0=jnp.asarray(t0, jnp.int32))
 
 
 # --------------------------------------------------------------------------
 # query: (r, c_pad) table -> (d,) estimates
 # --------------------------------------------------------------------------
 
-def _estimates_chunks_jax(cs: CountSketch, table: jax.Array) -> jax.Array:
+def _estimates_chunks_jax(cs: CountSketch, table: jax.Array,
+                          t0=None, Tn: Optional[int] = None) -> jax.Array:
     """Pure-XLA query producing the ``(T, S, 128)`` estimate chunks. Tail
     positions (flat index ≥ d) hold hash noise — callers re-entering the
-    resident data plane must ``mask_tail`` them."""
+    resident data plane must ``mask_tail`` them.
+
+    ``t0``/``Tn`` (sharded server): produce only the ``Tn`` chunks
+    starting at global chunk ``t0`` (traced) — per chunk bit-identical to
+    the full query."""
     S = cs.sublanes
     table3 = table.reshape(cs.r, S, _LANES)
 
@@ -486,8 +571,15 @@ def _estimates_chunks_jax(cs: CountSketch, table: jax.Array) -> jax.Array:
         est = rolled * _chunk_signs(cs, t_base)
         return None, _median_small([est[i] for i in range(cs.r)])
 
-    t_bases = jnp.arange(cs.T, dtype=jnp.int32) * (S * _LANES)
-    _, out = jax.lax.scan(body, None, (cs.inv_q.T, cs.inv_w.T, t_bases))
+    if t0 is None:
+        q_cols, w_cols = cs.inv_q, cs.inv_w
+        t_bases = jnp.arange(cs.T, dtype=jnp.int32) * (S * _LANES)
+    else:
+        assert Tn is not None
+        q_cols, w_cols = _local_shift_cols(cs.inv_q, cs.inv_w, t0, Tn)
+        t_bases = (jnp.asarray(t0, jnp.int32)
+                   + jnp.arange(Tn, dtype=jnp.int32)) * (S * _LANES)
+    _, out = jax.lax.scan(body, None, (q_cols.T, w_cols.T, t_bases))
     return out
 
 
@@ -503,7 +595,7 @@ def _est_subblock(S: int) -> int:
 
 @functools.partial(jax.jit,
                    static_argnames=("S", "T", "c_pad", "interpret"))
-def _estimates_pallas(tbl2, shift_q, shift_w, sign_keys, *, S, T, c_pad,
+def _estimates_pallas(tbl2, shift_q, shift_w, sign_keys, t0, *, S, T, c_pad,
                       interpret=False):
     """Fused query kernel producing the ``(T, S, 128)`` estimate chunks.
 
@@ -524,6 +616,12 @@ def _estimates_pallas(tbl2, shift_q, shift_w, sign_keys, *, S, T, c_pad,
     the sub-block starting at sublane ``g·SB`` needs input sublanes
     ``[g·SB + q, g·SB + q + SB]`` of the doubled row, lane-rotated left by
     ``w`` with the wrapped lanes drawn from the next sublane.
+
+    ``t0`` ((1,) int32 scalar prefetch): the chunks' global index offset —
+    0 for the full query, the shard's first global chunk for the
+    sharded-server local query (shift arrays pre-sliced; only the
+    sign-hash coordinate base shifts). ``t0 == 0`` is bit-identical to
+    the pre-offset kernel.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -532,7 +630,7 @@ def _estimates_pallas(tbl2, shift_q, shift_w, sign_keys, *, S, T, c_pad,
     SB = _est_subblock(S)
     G = -(-S // SB)
 
-    def kernel(q_ref, w_ref, key_ref, tbl2_ref, out_ref, buf, sems):
+    def kernel(q_ref, w_ref, key_ref, t0_ref, tbl2_ref, out_ref, buf, sems):
         t = pl.program_id(0)
         g = pl.program_id(1)
         for j in range(r):
@@ -540,7 +638,7 @@ def _estimates_pallas(tbl2, shift_q, shift_w, sign_keys, *, S, T, c_pad,
             pltpu.make_async_copy(
                 tbl2_ref.at[j, pl.ds(s0, SB + 1), :],
                 buf.at[j], sems.at[j]).start()
-        base = t * c_pad + g * (SB * _LANES)
+        base = (t0_ref[0] + t) * c_pad + g * (SB * _LANES)
         idx = base + (
             jax.lax.broadcasted_iota(jnp.int32, (SB, _LANES), 0) * _LANES
             + jax.lax.broadcasted_iota(jnp.int32, (SB, _LANES), 1))
@@ -557,7 +655,7 @@ def _estimates_pallas(tbl2, shift_q, shift_w, sign_keys, *, S, T, c_pad,
         out_ref[...] = _median_small(rows)[None]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(T, G),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((1, SB, _LANES), lambda t, g, *_: (t, g, 0)),
@@ -571,7 +669,7 @@ def _estimates_pallas(tbl2, shift_q, shift_w, sign_keys, *, S, T, c_pad,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, S, _LANES), jnp.float32),
         interpret=interpret,
-    )(shift_q, shift_w, sign_keys, tbl2)
+    )(shift_q, shift_w, sign_keys, t0, tbl2)
 
 
 def _doubled_table(cs: CountSketch, table: jax.Array) -> jax.Array:
@@ -600,7 +698,7 @@ def estimates(cs: CountSketch, table: jax.Array) -> jax.Array:
     if _use_pallas_estimates():
         out = _estimates_pallas(
             _doubled_table(cs, table), cs.shift_q, cs.shift_w, cs.sign_keys,
-            S=cs.sublanes, T=cs.T, c_pad=cs.c_pad)
+            _T0, S=cs.sublanes, T=cs.T, c_pad=cs.c_pad)
         return out.reshape(cs.T * cs.c_pad)[: cs.d]
     return _estimates_jax(cs, table)
 
@@ -616,10 +714,40 @@ def estimates_chunks(cs: CountSketch, table: jax.Array) -> jax.Array:
     if _use_pallas_estimates():
         out = _estimates_pallas(
             _doubled_table(cs, table), cs.shift_q, cs.shift_w, cs.sign_keys,
-            S=cs.sublanes, T=cs.T, c_pad=cs.c_pad)
+            _T0, S=cs.sublanes, T=cs.T, c_pad=cs.c_pad)
     else:
         out = _estimates_chunks_jax(cs, table)
     return cs.chunk_layout.mask_tail(out)
+
+
+def estimates_chunks_local(cs: CountSketch, table: jax.Array, t0, Tn: int,
+                           interpret: bool = False) -> jax.Array:
+    """Median-of-rows estimates for the ``Tn`` resident-layout chunks
+    starting at global chunk ``t0`` (a traced scalar) — the sharded
+    server's local slice of ``estimates_chunks``. Per chunk bit-identical
+    to the full query's output; positions whose GLOBAL flat index is ≥ d
+    (the padded tail, including entire chunks past ``cs.T`` on tail
+    shards of an uneven split) are masked to zero, so the slice satisfies
+    the resident-layout invariant."""
+    S = cs.sublanes
+    if _use_pallas_estimates() or interpret:
+        # the DMA kernel takes the FORWARD shifts (it reads the window at
+        # p + m rather than rolling by the inverse — see its docstring)
+        q_cols, w_cols = _local_shift_cols(cs.shift_q, cs.shift_w, t0, Tn)
+        out = _estimates_pallas(
+            _doubled_table(cs, table), q_cols, w_cols, cs.sign_keys,
+            jnp.asarray(t0, jnp.int32).reshape(1), S=S, T=Tn,
+            c_pad=cs.c_pad, interpret=interpret)
+    else:
+        out = _estimates_chunks_jax(cs, table, t0=jnp.asarray(t0, jnp.int32),
+                                    Tn=Tn)
+    # global-coordinate tail mask (ChunkLayout.mask_tail is full-range only)
+    idx = (jnp.asarray(t0, jnp.int32).reshape(1, 1, 1) * (S * _LANES)
+           + jax.lax.broadcasted_iota(jnp.int32, (Tn, S, _LANES), 0)
+           * (S * _LANES)
+           + jax.lax.broadcasted_iota(jnp.int32, (Tn, S, _LANES), 1) * _LANES
+           + jax.lax.broadcasted_iota(jnp.int32, (Tn, S, _LANES), 2))
+    return jnp.where(idx < cs.d, out, jnp.zeros((), out.dtype))
 
 
 def unsketch(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:

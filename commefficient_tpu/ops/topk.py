@@ -227,11 +227,18 @@ def _threshold_descent_fused(raw: jax.Array, k: int,
 
 
 def _threshold_descent_pallas(raw: jax.Array, k: int,
-                              interpret: bool = False) -> jax.Array:
+                              interpret: bool = False,
+                              axis_name=None) -> jax.Array:
     """Resolved k-th-largest-magnitude bit pattern via the per-pass Pallas
     count kernel on the blocked flat view of ``raw`` (any shape) — the one
     descent loop both the flat and chunked-resident top-k paths share, so
-    a blocking/kernel change cannot silently diverge them."""
+    a blocking/kernel change cannot silently diverge them.
+
+    ``axis_name`` is the sharded-server threshold exchange
+    (docs/sharded_server.md): each shard counts over its LOCAL slice and
+    the 16 per-candidate counts are psum'd — 16 ints per pass instead of
+    materializing the full vector per chip. Counts are exact integers, so
+    the resolved threshold is identical to the unsharded descent's."""
     v3, T = _blocks3(raw.reshape(-1))
     p = jnp.int32(0)
     for shift in range(28, -1, -4):
@@ -240,6 +247,8 @@ def _threshold_descent_pallas(raw: jax.Array, k: int,
         ts = jnp.pad(ts, (0, 16 - (hi_nib - 1)),
                      constant_values=jnp.int32(_ABS_MASK))
         counts = _count_ge_pallas(v3, ts, T=T, interpret=interpret)
+        if axis_name is not None:
+            counts = jax.lax.psum(counts, axis_name)
         sel = jnp.sum(counts >= k).astype(jnp.int32)
         p = p + (sel << shift)
     return p
@@ -284,11 +293,16 @@ def _topk_sort_1d(vec: jax.Array, k: int) -> jax.Array:
     return jnp.zeros_like(vec).at[idx].set(vec[idx])
 
 
-def _threshold_descent_xla(raw: jax.Array, k: int) -> jax.Array:
+def _threshold_descent_xla(raw: jax.Array, k: int,
+                           axis_name=None) -> jax.Array:
     """Resolved k-th-largest-magnitude bit pattern over ALL elements of
     ``raw`` (any shape — the counts are full-array reductions, so the same
     descent serves the flat ``(d,)`` vector and the chunked-resident
-    ``(T, S, 128)`` layout without a reshape)."""
+    ``(T, S, 128)`` layout without a reshape). With ``axis_name`` the
+    counts additionally psum over that mesh axis — the sharded-server
+    threshold exchange (see ``_threshold_descent_pallas``): integer-exact,
+    so the threshold matches the unsharded descent's over the
+    concatenation of the shards' slices."""
 
     def mag(r):
         # |pattern| as int (abs, not the reference's square, utils.py:246:
@@ -307,6 +321,8 @@ def _threshold_descent_xla(raw: jax.Array, k: int) -> jax.Array:
         ts = p + (jnp.arange(1, hi_nib, dtype=jnp.int32) << shift)
         m = mag(raw)
         counts = jnp.sum(m[..., None] >= ts, axis=tuple(range(m.ndim)))
+        if axis_name is not None:
+            counts = jax.lax.psum(counts, axis_name)
         # counts are non-increasing in the threshold, so the chosen nibble
         # is just the number of candidates whose count still reaches k
         sel = jnp.sum(counts >= k).astype(jnp.int32)
@@ -323,7 +339,8 @@ def _topk_threshold_1d(vec: jax.Array, k: int) -> jax.Array:
     return _apply_threshold(raw, vec, p)
 
 
-def topk_dense_nd(vec: jax.Array, k: int, interpret: bool = False) -> jax.Array:
+def topk_dense_nd(vec: jax.Array, k: int, interpret: bool = False,
+                  axis_name=None) -> jax.Array:
     """Shape-preserving global magnitude top-k over EVERY element of an
     arbitrary-shape array — the chunked-resident round's entry point: the
     ``(T, S, 128)`` estimate chunks are thresholded in place, so no
@@ -339,7 +356,14 @@ def topk_dense_nd(vec: jax.Array, k: int, interpret: bool = False) -> jax.Array:
     the measured Pallas crossover the count passes run through the fused
     count kernel on a blocked flat view (the one remaining reshape rides
     the same path the flat round always paid; above the crossover the
-    descent is reshape-free)."""
+    descent is reshape-free).
+
+    ``axis_name`` (sharded server, docs/sharded_server.md): ``vec`` is one
+    shard's slice inside a ``shard_map``; the counts psum over the axis so
+    the threshold is the GLOBAL k-th magnitude, and the returned mask
+    keeps this shard's members of the global top-k set. The fused
+    whole-descent kernel cannot psum between its in-kernel passes, so the
+    sharded path always uses the per-pass kernel or pure XLA."""
     import os
 
     from commefficient_tpu.utils import is_tpu_backend
@@ -351,14 +375,15 @@ def topk_dense_nd(vec: jax.Array, k: int, interpret: bool = False) -> jax.Array:
     # and GPT-2 rounds run through THIS entry point), then the per-pass
     # gate, then pure XLA
     if os.environ.get("COMMEFFICIENT_PALLAS_TOPK") == "0":
-        p = _threshold_descent_xla(raw, k)
+        p = _threshold_descent_xla(raw, k, axis_name=axis_name)
     elif (os.environ.get("COMMEFFICIENT_PALLAS_TOPK_FUSED") == "1"
-            and is_tpu_backend()):
+            and is_tpu_backend() and axis_name is None):
         p = _threshold_descent_fused(raw, k, interpret=interpret)
     elif _use_pallas_topk(vec.size) or interpret:
-        p = _threshold_descent_pallas(raw, k, interpret=interpret)
+        p = _threshold_descent_pallas(raw, k, interpret=interpret,
+                                      axis_name=axis_name)
     else:
-        p = _threshold_descent_xla(raw, k)
+        p = _threshold_descent_xla(raw, k, axis_name=axis_name)
     return _apply_threshold(raw, vec, p)
 
 

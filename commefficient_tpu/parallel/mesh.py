@@ -39,6 +39,7 @@ __all__ = [
     "default_client_mesh",
     "client_sharding",
     "replicated_sharding",
+    "server_shard_sharding",
     "CLIENTS_AXIS",
     "SEQ_AXIS",
     "MODEL_AXIS",
@@ -219,3 +220,12 @@ def client_sharding(mesh: Mesh, axis: str = CLIENTS_AXIS) -> NamedSharding:
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully-replicated sharding (ps_weights, server state)."""
     return NamedSharding(mesh, P())
+
+
+def server_shard_sharding(mesh: Mesh, axis: str = CLIENTS_AXIS) -> NamedSharding:
+    """Dim-0 sharding over the worker axis for the sharded server plane's
+    resident state (--server_shard, docs/sharded_server.md): dense-mode
+    server velocity/error slices and the int8 qres carry live sharded at
+    rest, so each chip stores 1/n of the d-sized state the replicated
+    plane duplicated per chip."""
+    return NamedSharding(mesh, P(axis))

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# One command, no TPU needed: run the sharded-vs-replicated server
+# equivalence suite on the forced-8-device CPU mesh
+# (docs/sharded_server.md). Pins, per mode family:
+#   - fp32 --server_shard trajectories bit-identical to the replicated
+#     plane (reduce-scatter/threshold-exchange/all-gather exactness);
+#   - the int8 quantized reduce's conservation + EF-carry contracts and
+#     its documented tolerance vs fp32;
+#   - checkpoint round-trips of the sharded server state (both planes).
+# Any extra args are passed through to pytest (e.g. -k bit_identical).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_sharded_server.py -q -p no:cacheprovider "$@"

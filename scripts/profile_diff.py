@@ -19,6 +19,16 @@ e.g. against a fresh re-capture:
 Exit status: 0 on a clean diff, 2 on unparseable input. Pass
 ``--fail-above-pct CAT=PCT`` (repeatable) to exit 1 when a category's
 ms/round grew by more than PCT percent — the CI regression hook.
+``--preset NAME`` expands to a named budget set:
+
+- ``round-engine``   — the pipelined-engine claim (data movement flat);
+- ``sharded-server`` — the --server_shard claim (docs/sharded_server.md):
+  the transmit collectives ("reduce (transmit collectives)" — the
+  reduce-scatter / all-gather / int8 all-to-all bucket
+  scripts/tpu_profile.py emits) must not balloon, and the server step's
+  signature categories — "custom-call" (the Pallas sketch/top-k kernels)
+  and the plain "reduce" bucket (threshold count passes) — must SHRINK
+  per chip, so any growth at all fails the gate.
 """
 
 from __future__ import annotations
@@ -27,6 +37,16 @@ import argparse
 import re
 import sys
 from typing import Dict, NamedTuple, Optional
+
+# named --preset budget sets: category substring -> max allowed growth %
+_PRESETS: Dict[str, Dict[str, float]] = {
+    "round-engine": {"data movement": 25.0},
+    "sharded-server": {
+        "reduce (transmit collectives)": 25.0,
+        "custom-call": 0.0,
+        "reduce": 0.0,
+    },
+}
 
 
 class Capture(NamedTuple):
@@ -98,9 +118,14 @@ def diff(a: Capture, b: Capture, fail_above: Dict[str, float]) -> int:
         sb, mb = b.categories.get(name, (0, 0.0))
         print(f"| {name} | {sa}→{sb} | {ma:.3f} | {mb:.3f} | "
               f"{_fmt_delta(ma, mb)} |")
-        for pat, pct in fail_above.items():
-            if pat.lower() in name.lower() and ma > 0 \
-                    and 100 * (mb - ma) / ma > pct:
+        # most-specific (longest) matching pattern wins, so a broad
+        # budget like "reduce" doesn't also govern
+        # "reduce (transmit collectives)" when both are configured
+        hits = [(pat, pct) for pat, pct in fail_above.items()
+                if pat.lower() in name.lower()]
+        if hits and ma > 0:
+            pat, pct = max(hits, key=lambda kv: len(kv[0]))
+            if 100 * (mb - ma) / ma > pct:
                 failures.append(
                     f"{name}: {ma:.3f} → {mb:.3f} ms/round exceeds "
                     f"+{pct}% budget")
@@ -112,6 +137,23 @@ def diff(a: Capture, b: Capture, fail_above: Dict[str, float]) -> int:
           f"{a.wall_ms if a.wall_ms is not None else '?'} | "
           f"{b.wall_ms if b.wall_ms is not None else '?'} | "
           f"{_fmt_delta(a.wall_ms, b.wall_ms)} |")
+
+    # a budget that GOVERNS no nonzero-baseline category checks nothing
+    # (e.g. the baseline predates a category rename, or a longer pattern
+    # claims every row it matches) — say so instead of passing silently.
+    # Governing = being the longest matching pattern, mirroring the
+    # enforcement rule above.
+    def governs(pat, name):
+        matches = [p for p in fail_above if p.lower() in name.lower()]
+        return bool(matches) and max(matches, key=len) == pat
+
+    for pat in fail_above:
+        if not any(governs(pat, n) and a.categories.get(n, (0, 0.0))[1] > 0
+                   for n in names):
+            print(f"WARNING: budget {pat!r} governs no category with a "
+                  f"nonzero baseline — this gate is vacuous for these "
+                  f"captures (baseline from an older category scheme?)",
+                  file=sys.stderr)
 
     if failures:
         print("\nREGRESSION:", file=sys.stderr)
@@ -129,8 +171,12 @@ def main(argv=None) -> int:
                    metavar="CAT=PCT",
                    help="exit 1 if category CAT (substring match) grew "
                         "more than PCT%% in ms/round; repeatable")
+    p.add_argument("--preset", choices=sorted(_PRESETS),
+                   help="named budget set (see module docstring); "
+                        "composes with --fail-above-pct, which wins on "
+                        "a per-category conflict")
     args = p.parse_args(argv)
-    fail_above = {}
+    fail_above = dict(_PRESETS.get(args.preset, {}))
     for spec in args.fail_above_pct:
         cat, _, pct = spec.partition("=")
         try:

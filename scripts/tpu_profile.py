@@ -56,8 +56,21 @@ def _category(op_name: str) -> str:
     for pat, cat in (
         (r"convolution|conv", "convolution (MXU)"),
         (r"\bdot\b|matmul|gemm", "matmul (MXU)"),
-        (r"all-reduce|all-gather|reduce-scatter|collective|permute",
-         "collectives"),
+        # the sharded server plane's transmit collectives (reduce-scatter
+        # of the round transmit, update all-gather, the int8 collective's
+        # all-to-all — docs/sharded_server.md) get their own bucket so
+        # profile_diff can gate them separately from activation psums.
+        # Deliberately NOT all-reduce: lax.psum lowers to all-reduce, so
+        # that pattern would sweep the seq/model/expert activation and
+        # metric psums (and the sketch-table psum) into the transmit
+        # bucket and dilute the gate — those stay under "collectives".
+        # Caveat: Ulysses sequence parallelism also emits all_to_all
+        # (parallel/ulysses.py) — profile the sharded-server legs without
+        # --seq_parallel ulysses (the bench `shard` leg doesn't use it)
+        # or this bucket mixes in attention activation traffic.
+        (r"all-gather|reduce-scatter|all-to-all",
+         "reduce (transmit collectives)"),
+        (r"all-reduce|collective|permute", "collectives"),
         (r"scatter", "scatter (sketch accumulate)"),
         (r"gather", "gather"),
         (r"sort", "sort"),

@@ -18,7 +18,30 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _cpu_multiprocess_supported() -> bool:
+    """The demo needs a jaxlib whose CPU backend can COMPILE multi-process
+    computations. Through at least jax 0.4.37 that path is unimplemented —
+    every child dies in backend_compile with ``XlaRuntimeError:
+    INVALID_ARGUMENT: Multiprocess computations aren't implemented on the
+    CPU backend`` — so gate on the version rather than burning ~10 min of
+    subprocess startup to rediscover it. Bump the floor when a jaxlib that
+    implements it (cross-process CPU collectives) is in the image."""
+    import jax
+
+    try:
+        version = tuple(int(p) for p in jax.__version__.split(".")[:2])
+    except ValueError:
+        return True  # unknown scheme: let the test speak for itself
+    return version >= (0, 6)
+
+
 @pytest.mark.heavy
+@pytest.mark.skipif(
+    not _cpu_multiprocess_supported(),
+    reason="jaxlib CPU backend cannot compile multi-process computations "
+           "on this jax (XlaRuntimeError: 'Multiprocess computations "
+           "aren't implemented on the CPU backend', observed on 0.4.37); "
+           "needs a newer jaxlib or a real multi-host backend")
 def test_two_process_round_matches_single_process():
     # bounded by the subprocess timeout below (no pytest-timeout plugin)
     proc = subprocess.run(

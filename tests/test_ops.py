@@ -236,7 +236,7 @@ class TestSketchPallasKernel:
         pure = _sketch_vec_jax(cs, v)
         kern = _sketch_vec_pallas(
             _chunks3(cs, v), cs.shift_q, cs.shift_w, cs.sign_keys,
-            S=cs.sublanes, T=cs.T, interpret=True,
+            jnp.zeros(1, jnp.int32), S=cs.sublanes, T=cs.T, interpret=True,
         ).reshape(cs.r, cs.c_pad)
         np.testing.assert_allclose(kern, pure, rtol=1e-6, atol=1e-6)
 
@@ -262,7 +262,7 @@ class TestSketchKernelSelfCheck:
         contract as the estimates kernel's self-check."""
         import os
 
-        def zeros_kernel(v3, q, w, k, *, S, T, interpret=False):
+        def zeros_kernel(v3, q, w, k, t0, *, S, T, interpret=False):
             return jnp.zeros((3, T * 0 + 140032), jnp.float32)
 
         sk = self._arm(monkeypatch, zeros_kernel)
@@ -297,7 +297,7 @@ class TestSketchKernelSelfCheck:
 
         cs = sk.make_sketch(d=2048, c=256, r=3, seed=1)
 
-        def zeros_kernel(v3, q, w, k, *, S, T, interpret=False):
+        def zeros_kernel(v3, q, w, k, t0, *, S, T, interpret=False):
             return jnp.zeros((3, T * 0 + 140032), jnp.float32)
 
         sk = self._arm(monkeypatch, zeros_kernel)
@@ -325,7 +325,8 @@ class TestEstimatesPallasKernel:
         pure = _estimates_jax(cs, table)
         kern = _estimates_pallas(
             _doubled_table(cs, table), cs.shift_q, cs.shift_w, cs.sign_keys,
-            S=cs.sublanes, T=cs.T, c_pad=cs.c_pad, interpret=True,
+            jnp.zeros(1, jnp.int32), S=cs.sublanes, T=cs.T, c_pad=cs.c_pad,
+            interpret=True,
         ).reshape(cs.T * cs.c_pad)[: cs.d]
         np.testing.assert_array_equal(np.asarray(kern), np.asarray(pure))
 

@@ -1,0 +1,485 @@
+"""Sharded server data plane (--server_shard, docs/sharded_server.md).
+
+Three contracts pinned on the forced-8-device CPU mesh:
+
+1. fp32 sharded trajectories are BIT-IDENTICAL to the replicated path's —
+   the reduce is ``psum_scatter`` (≡ psum + the shard's slice, same ring),
+   the per-chunk estimate/threshold/re-sketch math is the full path's
+   math on a slice, the threshold exchange is integer-exact, and the
+   all-gather is pure data movement.
+2. the int8 quantized transmit collective is opt-in, unbiased (stochastic
+   rounding), CONSERVATIVE (transmitted sum + carried residual ≡ exact
+   contribution — nothing silently lost), its residual lands in
+   ``ServerState.qres`` and feeds the next round, and short trajectories
+   stay within a stated tolerance of fp32.
+3. checkpoints round-trip the sharded server state (canonical flat view on
+   disk, re-padded/re-sharded on restore) across both planes.
+"""
+
+import numpy as np
+import pytest
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from commefficient_tpu.compat import shard_map
+from commefficient_tpu.federated.rounds import (
+    RoundConfig,
+    build_round_step,
+    init_client_states,
+)
+from commefficient_tpu.federated.server import (
+    ServerConfig,
+    init_server_state,
+)
+from commefficient_tpu.federated.worker import WorkerConfig
+from commefficient_tpu.ops.flat import ravel_pytree
+from commefficient_tpu.ops.sketch import make_sketch
+from tests.test_rounds import _batch, _linear_loss, D
+
+N = 8  # worker-axis shards == forced CPU devices
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("clients",))
+
+
+def _build(mode, error_type, server_shard, reduce_dtype="float32",
+           virtual_momentum=0.0, k=2, **kw):
+    """A placed, ready-to-step round on the 8-device mesh — state committed
+    to the step's output shardings exactly as FedModel does (replicated,
+    or the --server_shard residency)."""
+    mesh = _mesh()
+    rep = NamedSharding(mesh, P())
+    sh0 = NamedSharding(mesh, P("clients"))
+    params = {"w": jnp.zeros(D)}
+    flat, unravel = ravel_pytree(params)
+
+    def ravel(tree):
+        return ravel_pytree(tree)[0]
+
+    wcfg = WorkerConfig(mode=mode, error_type=error_type, k=k,
+                        num_workers=N, **kw)
+    scfg = ServerConfig(mode=mode, error_type=error_type, k=k, grad_size=D,
+                        virtual_momentum=virtual_momentum,
+                        local_momentum=kw.get("local_momentum", 0.0))
+    sketch = make_sketch(D, 16, 3, seed=0, num_blocks=1) \
+        if mode == "sketch" else None
+    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=D,
+                      server_shard=server_shard, reduce_dtype=reduce_dtype)
+    steps = build_round_step(_linear_loss, _linear_loss, unravel, ravel,
+                             cfg, sketch=sketch, mesh=mesh)
+    ss = init_server_state(scfg, sketch,
+                           shard_n=N if server_shard else 0,
+                           quantized=reduce_dtype == "int8")
+    dense_sharded = server_shard and mode != "sketch"
+    ss = ss._replace(
+        velocity=jax.device_put(ss.velocity, sh0 if dense_sharded else rep),
+        error=jax.device_put(ss.error, sh0 if dense_sharded else rep),
+        qres=None if ss.qres is None else jax.device_put(ss.qres, sh0))
+    ps = jax.device_put(
+        steps.layout.chunk(flat) if steps.layout is not None else flat, rep)
+    cs = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, rep),
+        init_client_states(16, D, wcfg, init_weights=flat, sketch=sketch))
+    return steps, ps, ss, cs
+
+
+def _run_rounds(steps, ps, ss, cs, rounds, lr=0.1):
+    traj = []
+    for rnd in range(rounds):
+        ps, ss, cs, _, _ = steps.train_step(ps, ss, cs, {}, _batch(seed=rnd),
+                                            lr, jax.random.key(rnd))
+        flat = steps.layout.unchunk(ps) if steps.layout is not None else ps
+        traj.append(np.asarray(flat))
+    return traj, ss, cs
+
+
+MODES = [
+    ("uncompressed", "none", dict(virtual_momentum=0.5)),
+    ("true_topk", "virtual", dict(virtual_momentum=0.9,
+                                  local_momentum=0.9)),
+    ("local_topk", "local", dict(k=1)),
+    ("sketch", "virtual", dict(virtual_momentum=0.9)),
+    ("sketch", "local", dict(local_momentum=0.9)),
+    ("fedavg", "none", dict()),
+]
+
+
+class TestShardedBitIdentity:
+    """Acceptance criterion: fp32 sharded == replicated, bit for bit."""
+
+    @pytest.mark.parametrize("mode,et,kw", MODES,
+                             ids=[f"{m}-{e}" for m, e, kw in MODES])
+    def test_trajectory_bit_identical(self, mode, et, kw):
+        a, ssa, csa = _run_rounds(*_build(mode, et, False, **kw), rounds=3)
+        b, ssb, csb = _run_rounds(*_build(mode, et, True, **kw), rounds=3)
+        for rnd, (x, y) in enumerate(zip(a, b)):
+            np.testing.assert_array_equal(
+                x, y, err_msg=f"{mode}/{et} round {rnd} ps diverged")
+        # server state: compare the canonical view (dense sharded state is
+        # (d_pad,), the replicated plane's is (d,))
+        for name in ("velocity", "error"):
+            va = np.asarray(getattr(ssa, name)).reshape(-1)
+            vb = np.asarray(getattr(ssb, name)).reshape(-1)[: va.size]
+            np.testing.assert_array_equal(va, vb, err_msg=f"{mode} {name}")
+        # client state (sketch-space masking reused the sharded re-sketch)
+        for name in ("velocities", "errors"):
+            ca, cb = getattr(csa, name), getattr(csb, name)
+            if ca is not None:
+                np.testing.assert_array_equal(np.asarray(ca),
+                                              np.asarray(cb),
+                                              err_msg=f"{mode} client {name}")
+
+    def test_two_phase_matches_fused(self):
+        """client_step + server_step (the FedModel path) equals the fused
+        train_step under --server_shard — ctx.gradient crosses the phase
+        boundary as the sharded per-chip stack."""
+        steps, ps, ss, cs = _build("sketch", "virtual", True,
+                                   virtual_momentum=0.9)
+        batch = _batch(seed=0)
+        rng = jax.random.key(0)
+        rng2, sub = jax.random.split(rng)
+        ctx, ms, _ = steps.client_step(ps, cs, {}, batch, 0.1, rng2)
+        new_ps, ss1, cs1 = steps.server_step(ps, ss, cs, ctx, 0.1, sub)
+
+        steps2, ps2, ss2, cs2 = _build("sketch", "virtual", True,
+                                       virtual_momentum=0.9)
+        fused_ps, *_ = steps2.train_step(ps2, ss2, cs2, {}, batch, 0.1, rng)
+        np.testing.assert_array_equal(np.asarray(new_ps),
+                                      np.asarray(fused_ps))
+
+
+class TestQuantizedCollectives:
+    """ops/collectives.py contracts, straight on the mesh."""
+
+    def test_reduce_scatter_bitwise_equals_psum_slice(self):
+        mesh = _mesh()
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(N, 16, 128).astype(np.float32))
+
+        def f(xl):
+            from commefficient_tpu.ops.collectives import reduce_scatter_sum
+
+            tot = jax.lax.psum(xl[0], "clients")
+            tile = reduce_scatter_sum(xl[0], "clients")
+            i = jax.lax.axis_index("clients")
+            ref = jax.lax.dynamic_slice_in_dim(tot, i * (16 // N), 16 // N)
+            return jnp.array_equal(tile, ref).astype(jnp.int32)[None]
+
+        eq = shard_map(f, mesh=mesh, in_specs=(P("clients"),),
+                       out_specs=P("clients"), check_vma=False)(x)
+        assert np.asarray(eq).all()
+
+    def test_conservation_nothing_silently_lost(self):
+        """Transmitted sum + psum of carried residuals ≡ exact sum (to f32
+        rounding): the quantizer's loss is exactly what the EF carry
+        holds."""
+        from commefficient_tpu.ops.collectives import (
+            all_gather_tiled,
+            quantized_psum_scatter,
+        )
+
+        mesh = _mesh()
+        rng = np.random.RandomState(1)
+        x = rng.randn(N, 16, 3, 128).astype(np.float32)
+
+        def f(xl, key):
+            tile, res = quantized_psum_scatter(xl[0], "clients", key,
+                                               block=128)
+            return all_gather_tiled(tile, "clients"), res[None]
+
+        out, res = shard_map(
+            f, mesh=mesh, in_specs=(P("clients"), P()),
+            out_specs=(P(), P("clients")), check_vma=False,
+        )(jnp.asarray(x), jax.random.key(3))
+        exact = x.sum(0)
+        conserved = np.asarray(out) + np.asarray(res).sum(0)
+        np.testing.assert_allclose(conserved, exact, atol=5e-5)
+        # and the quantization is actually lossy (the residual is real)
+        assert np.abs(np.asarray(res)).max() > 0
+
+    def test_ef_carry_feeds_next_round(self):
+        """Round 2's contribution includes round 1's residual: summing the
+        two rounds' transmitted totals tracks 2x the exact sum to within
+        ONE round's quantization error (telescoping), not two."""
+        from commefficient_tpu.ops.collectives import (
+            all_gather_tiled,
+            quantized_psum_scatter,
+        )
+
+        mesh = _mesh()
+        rng = np.random.RandomState(2)
+        x = rng.randn(N, 16, 128).astype(np.float32)
+
+        def f(xl, key):
+            k1, k2 = jax.random.split(key)
+            t1, r1 = quantized_psum_scatter(xl[0], "clients", k1, block=128)
+            t2, r2 = quantized_psum_scatter(xl[0], "clients", k2,
+                                            residual=r1, block=128)
+            return (all_gather_tiled(t1, "clients"),
+                    all_gather_tiled(t2, "clients"), r2[None])
+
+        t1, t2, r2 = shard_map(
+            f, mesh=mesh, in_specs=(P("clients"), P()),
+            out_specs=(P(), P(), P("clients")), check_vma=False,
+        )(jnp.asarray(x), jax.random.key(9))
+        exact = x.sum(0)
+        cum_err = np.abs(np.asarray(t1) + np.asarray(t2) - 2 * exact)
+        # telescoped: t1 + t2 = 2·exact − psum(r2) exactly
+        np.testing.assert_allclose(
+            cum_err, np.abs(np.asarray(r2).sum(0)), atol=5e-5)
+
+
+class TestQuantizedRound:
+    """--reduce_dtype int8 end-to-end: tolerance vs fp32 + qres plumbing.
+
+    Documented tolerance (docs/sharded_server.md): with per-(S,128)-block
+    scales and stochastic rounding, short sketched trajectories stay
+    within 2% relative error of fp32 — the compression error the server's
+    own error feedback then re-absorbs across rounds.
+    """
+
+    def test_sketch_trajectory_within_tolerance(self):
+        f32, _, _ = _run_rounds(
+            *_build("sketch", "virtual", True, virtual_momentum=0.9),
+            rounds=4)
+        i8, ss8, _ = _run_rounds(
+            *_build("sketch", "virtual", True, reduce_dtype="int8",
+                    virtual_momentum=0.9), rounds=4)
+        for rnd, (a, b) in enumerate(zip(f32, i8)):
+            denom = max(np.abs(a).max(), 1e-12)
+            assert np.abs(b - a).max() / denom < 0.02, \
+                f"round {rnd}: int8 trajectory drifted past the 2% tolerance"
+        # the residual carry exists, is per-chip, and is nonzero
+        assert ss8.qres is not None and ss8.qres.shape[0] == N
+        assert float(np.abs(np.asarray(ss8.qres)).max()) > 0
+
+    def test_int8_requires_server_shard(self):
+        with pytest.raises(AssertionError):
+            _build("sketch", "virtual", False, reduce_dtype="int8",
+                   virtual_momentum=0.9)
+
+
+class TestLocalKernels:
+    """Interpret-mode coverage of the t0-offset Pallas kernels (the TPU
+    path the CPU suite otherwise never executes): local accumulate/query
+    must equal the pure-XLA partials bit-for-bit."""
+
+    def _sketch(self):
+        return make_sketch(d=5000, c=512, r=3, seed=7, num_blocks=2)
+
+    def test_local_query_matches_full_slices(self):
+        from commefficient_tpu.ops.sketch import (
+            estimates_chunks,
+            estimates_chunks_local,
+        )
+
+        cs = self._sketch()
+        tbl = jnp.asarray(
+            np.random.RandomState(5).randn(*cs.table_shape), jnp.float32)
+        full = np.asarray(estimates_chunks(cs, tbl))
+        Tn = -(-cs.T // 4)
+        fullp = np.pad(full, ((0, 4 * Tn - cs.T), (0, 0), (0, 0)))
+        for i in range(4):
+            for interpret in (False, True):
+                loc = estimates_chunks_local(cs, tbl, jnp.int32(i * Tn), Tn,
+                                             interpret=interpret)
+                np.testing.assert_array_equal(
+                    np.asarray(loc), fullp[i * Tn:(i + 1) * Tn],
+                    err_msg=f"shard {i} interpret={interpret}")
+
+    def test_local_accumulate_partials_sum_to_full(self):
+        from commefficient_tpu.ops.sketch import (
+            _chunks3,
+            sketch_chunks,
+            sketch_chunks_local,
+        )
+
+        cs = self._sketch()
+        v3 = _chunks3(cs, jnp.asarray(
+            np.random.RandomState(3).randn(cs.d), jnp.float32))
+        Tn = -(-cs.T // 4)
+        v3p = jnp.pad(v3, ((0, 4 * Tn - cs.T), (0, 0), (0, 0)))
+        for interpret in (False, True):
+            parts = sum(
+                sketch_chunks_local(cs, v3p[i * Tn:(i + 1) * Tn],
+                                    jnp.int32(i * Tn), interpret=interpret)
+                for i in range(4))
+            np.testing.assert_allclose(
+                np.asarray(parts), np.asarray(sketch_chunks(cs, v3)),
+                rtol=1e-5, atol=1e-5)
+
+    def test_interpret_accumulate_matches_xla_partial(self):
+        from commefficient_tpu.ops.sketch import (
+            _chunks3,
+            _sketch_chunks_jax,
+            sketch_chunks_local,
+        )
+
+        cs = self._sketch()
+        v3 = _chunks3(cs, jnp.asarray(
+            np.random.RandomState(4).randn(cs.d), jnp.float32))
+        got = sketch_chunks_local(cs, v3[2:5], jnp.int32(2), interpret=True)
+        want = _sketch_chunks_jax(cs, v3[2:5], jnp.int32(2))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_sharded_threshold_matches_global(self):
+        from commefficient_tpu.ops.topk import topk_dense_nd
+
+        mesh = _mesh()
+        vec = jnp.asarray(
+            np.random.RandomState(8).randn(N * 64, 128).astype(np.float32))
+        k = 37
+        want = np.asarray(topk_dense_nd(vec, k))
+
+        def f(xl):
+            return topk_dense_nd(xl, k, axis_name="clients")
+
+        got = shard_map(f, mesh=mesh, in_specs=(P("clients"),),
+                        out_specs=P("clients"), check_vma=False)(vec)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+# ---- checkpoint round-trip on the FedModel/FedOptimizer surface ---------
+
+class _TinyModel:
+    pass
+
+
+def _fed_args(**over):
+    base = dict(
+        mode="sketch", error_type="virtual", k=2, num_workers=N,
+        weight_decay=0.0, local_momentum=0.0, virtual_momentum=0.9,
+        microbatch_size=-1, max_grad_norm=None, do_dp=False,
+        dp_mode="worker", l2_norm_clip=1.0, noise_multiplier=0.0,
+        num_fedavg_epochs=1, fedavg_batch_size=-1, fedavg_lr_decay=1.0,
+        do_topk_down=False, num_clients=16, num_devices=N, seed=0,
+        do_test=False, dataset_name="CIFAR10", num_epochs=2,
+        local_batch_size=2, num_cols=16, num_rows=2, num_blocks=1,
+        seq_parallel="none", seq_devices=1,
+        server_shard=True, reduce_dtype="float32",
+    )
+    base.update(over)
+    return SimpleNamespace(**base)
+
+
+class TestShardedCheckpoint:
+    def _fed_model(self, **over):
+        import flax.linen as nn
+
+        from commefficient_tpu.federated.aggregator import (
+            FedModel,
+            FedOptimizer,
+            LambdaLR,
+        )
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                return nn.Dense(4, use_bias=False)(x)
+
+        def loss(params, model_state, batch, rng, train):
+            pred = Tiny().apply({"params": params}, batch["inputs"])
+            err = pred - batch["targets"]
+            mask = batch["mask"]
+            return jnp.sum(jnp.square(err).mean(-1) * mask), (), \
+                jnp.sum(mask), model_state
+
+        args = _fed_args(**over)
+        fm = FedModel(Tiny(), loss, args, input_shape=(3,))
+        opt = FedOptimizer(fm, args)
+        sched = LambdaLR(opt, lambda step: 0.5)
+        return fm, opt, sched
+
+    def _fed_batch(self):
+        rng = np.random.RandomState(1)
+        return {
+            "inputs": jnp.asarray(rng.randn(N, 2, 3), jnp.float32),
+            "targets": jnp.asarray(rng.randn(N, 2, 4), jnp.float32),
+            "mask": jnp.ones((N, 2), jnp.float32),
+            "client_ids": jnp.arange(N, dtype=jnp.int32),
+            "worker_mask": jnp.ones(N, jnp.float32),
+        }
+
+    @pytest.mark.parametrize("mode,rdtype", [("sketch", "float32"),
+                                             ("uncompressed", "float32"),
+                                             ("sketch", "int8")])
+    def test_run_state_roundtrip(self, tmp_path, mode, rdtype):
+        """save_run_state → load_run_state reproduces the exact sharded
+        server state (incl. the dense (d_pad,) slices and the int8 qres
+        carry) and the subsequent round bit-exactly."""
+        from commefficient_tpu.federated.checkpoint import (
+            load_run_state,
+            save_run_state,
+        )
+
+        et = "virtual" if mode == "sketch" else "none"
+        vm = 0.9 if mode == "sketch" else 0.5
+        fm, opt, sched = self._fed_model(mode=mode, error_type=et,
+                                         virtual_momentum=vm,
+                                         reduce_dtype=rdtype)
+        for _ in range(2):
+            fm(self._fed_batch())
+            opt.step()
+        path = save_run_state(str(tmp_path / "rs"), fm, opt, sched,
+                              next_epoch=1)
+
+        fm2, opt2, sched2 = self._fed_model(mode=mode, error_type=et,
+                                            virtual_momentum=vm,
+                                            reduce_dtype=rdtype)
+        next_epoch, _ = load_run_state(path, fm2, opt2, sched2)
+        assert next_epoch == 1
+        for name in ("velocity", "error", "qres"):
+            a = getattr(opt.server_state, name)
+            b = getattr(opt2.server_state, name)
+            if a is None:
+                assert b is None
+                continue
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+            assert a.sharding == b.sharding, name
+        # one more round from the restored state matches the original
+        fm(self._fed_batch())
+        opt.step()
+        fm2(self._fed_batch())
+        opt2.step()
+        np.testing.assert_array_equal(np.asarray(fm.ps_weights),
+                                      np.asarray(fm2.ps_weights))
+
+    def test_cross_plane_restore(self, tmp_path):
+        """A replicated-plane checkpoint restores into a sharded-plane run
+        (canonical flat view on disk) — and vice versa."""
+        from commefficient_tpu.federated.checkpoint import (
+            load_run_state,
+            save_run_state,
+        )
+
+        fm, opt, sched = self._fed_model(mode="uncompressed",
+                                         error_type="none",
+                                         virtual_momentum=0.5,
+                                         server_shard=False)
+        for _ in range(2):
+            fm(self._fed_batch())
+            opt.step()
+        path = save_run_state(str(tmp_path / "rs"), fm, opt, sched,
+                              next_epoch=1)
+
+        fm2, opt2, sched2 = self._fed_model(mode="uncompressed",
+                                            error_type="none",
+                                            virtual_momentum=0.5,
+                                            server_shard=True)
+        load_run_state(path, fm2, opt2, sched2)
+        d = fm.grad_size
+        np.testing.assert_array_equal(
+            np.asarray(opt.server_state.velocity)[:d],
+            np.asarray(opt2.server_state.velocity)[:d])
+        # trajectories stay bit-identical across the plane switch
+        fm(self._fed_batch())
+        opt.step()
+        fm2(self._fed_batch())
+        opt2.step()
+        np.testing.assert_array_equal(np.asarray(fm.ps_weights),
+                                      np.asarray(fm2.ps_weights))
