@@ -147,7 +147,7 @@ _T0 = time.monotonic()
 
 def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
           mode: str = "sketch", num_workers: int = NUM_WORKERS,
-          server_shard: bool = False):
+          server_shard: bool = False, fused_epilogue: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -192,7 +192,8 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
     wcfg = WorkerConfig(mode=mode, error_type="virtual", k=k,
                         num_workers=num_workers, weight_decay=5e-4)
     scfg = ServerConfig(mode=mode, error_type="virtual", k=k,
-                        grad_size=d, virtual_momentum=0.9)
+                        grad_size=d, virtual_momentum=0.9,
+                        fused_epilogue=fused_epilogue)
     sketch = make_sketch(d, c=c, r=r, seed=42, num_blocks=blocks) \
         if mode == "sketch" else None
     cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
@@ -244,11 +245,13 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
     return steps, flat, server_state, client_states, batch
 
 
-def build_gpt2(bf16: bool = False):
+def build_gpt2(bf16: bool = False, fused_epilogue: bool = False):
     """GPT-2 PersonaChat sketched federated round (BASELINE.md config 5):
     full 124M double-heads geometry, 4 clients/round, 2 candidates x 256
     tokens per example, sketch 5x500k/k=50k (reference gpt2_train.py:255-313
-    run shape). ``bf16`` switches the fwd/bwd compute to bf16 (--bf16)."""
+    run shape). ``bf16`` switches the fwd/bwd compute to bf16 (--bf16);
+    ``fused_epilogue`` turns on the one-sweep server epilogue
+    (docs/fused_epilogue.md) for the profiling A/B."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -287,7 +290,8 @@ def build_gpt2(bf16: bool = False):
     wcfg = WorkerConfig(mode="sketch", error_type="virtual", k=k,
                         num_workers=W)
     scfg = ServerConfig(mode="sketch", error_type="virtual", k=k,
-                        grad_size=d, virtual_momentum=0.9)
+                        grad_size=d, virtual_momentum=0.9,
+                        fused_epilogue=fused_epilogue)
     sketch = make_sketch(d, c=c, r=r, seed=42, num_blocks=blocks)
     cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d)
     loss_train, loss_val = make_gpt2_losses(
@@ -527,18 +531,19 @@ def run_measurement(tiny: bool) -> None:
 
 # one measure-and-emit path for every CIFAR-family config leg:
 # name -> (mode, workers, baseline r/s, num_classes, non_iid, K,
-#          server_shard, label).
+#          server_shard, fused_epilogue, label).
 # K multi-rounds per dispatch via lax.scan: the cheap c1/c2 rounds are
 # smaller than the ~40 ms tunnel rtt, so 20 single-round dispatches would
 # measure transport noise (and raising the dispatch count instead wedges
 # the tunnel — 50+ unsynced steps, BASELINE.md); K rounds inside ONE
 # dispatch keep the queue shallow while the timed region grows K x.
 _CFG_LEGS = {
-    "c1": ("uncompressed", 1, "BASELINE_C1", 10, False, 20, False,
+    "c1": ("uncompressed", 1, "BASELINE_C1", 10, False, 20, False, False,
            "1-worker uncompressed rounds/sec/chip (ResNet9)"),
-    "c2": ("true_topk", 8, "BASELINE_C2", 10, False, 10, False,
+    "c2": ("true_topk", 8, "BASELINE_C2", 10, False, 10, False, False,
            "8-worker true-topk rounds/sec/chip (ResNet9, k=50k)"),
     "cifar100": ("sketch", 8, "BASELINE_CIFAR100", 100, True, 1, False,
+                 False,
                  "CIFAR100/FEMNIST-style non-IID sketched rounds/sec/chip "
                  "(ResNet9-100, 500 clients, 8 workers, sketch 5x500k "
                  "k=50k)"),
@@ -548,9 +553,17 @@ _CFG_LEGS = {
     # directly. Per-shard server work only drops on a multi-chip mesh, so
     # on the 1-chip bench this leg pins NO-regression with the plane on;
     # on a multi-chip mesh it measures the win.
-    "shard": ("sketch", 8, "BASELINE", 10, False, 1, True,
+    "shard": ("sketch", 8, "BASELINE", 10, False, 1, True, False,
               "8-worker sketched rounds/sec/chip with --server_shard "
               "(ResNet9, sketch 5x500k k=50k, sharded server data plane)"),
+    # the headline sketch leg with the fused server epilogue
+    # (--fused_epilogue, docs/fused_epilogue.md); same config-3 baseline
+    # anchor so the fused-vs-composed delta reads straight off the two
+    # legs (mfu_attack_r5.md projects ~2.3 ms/round ≈ 32% MFU if the
+    # fusion fully lands).
+    "fused": ("sketch", 8, "BASELINE", 10, False, 1, False, True,
+              "8-worker sketched rounds/sec/chip with --fused_epilogue "
+              "(ResNet9, sketch 5x500k k=50k, one-sweep server epilogue)"),
 }
 
 
@@ -564,15 +577,16 @@ def run_config_measurement(name: str) -> None:
     from jax import lax
 
     _check_pallas_kernel()
-    mode, W, base_name, num_classes, non_iid, K, server_shard, label = \
-        _CFG_LEGS[name]
+    (mode, W, base_name, num_classes, non_iid, K, server_shard,
+     fused_epilogue, label) = _CFG_LEGS[name]
     base = {"BASELINE": BASELINE_ROUNDS_PER_SEC,
             "BASELINE_C1": BASELINE_C1_ROUNDS_PER_SEC,
             "BASELINE_C2": BASELINE_C2_ROUNDS_PER_SEC,
             "BASELINE_CIFAR100": BASELINE_CIFAR100_ROUNDS_PER_SEC}[base_name]
     steps, ps, server_state, client_states, batch = build(
         tiny=False, num_classes=num_classes, non_iid=non_iid, mode=mode,
-        num_workers=W, server_shard=server_shard)
+        num_workers=W, server_shard=server_shard,
+        fused_epilogue=fused_epilogue)
     if K > 1:
         inner = steps.train_step
 
@@ -685,6 +699,8 @@ _EXTRA_LEGS = {
            "c2_rounds_per_sec"),
     "shard": (["--run-cfg", "shard"], "BENCH_C12_TIMEOUT", 900,
               "shard_rounds_per_sec"),
+    "fused": (["--run-cfg", "fused"], "BENCH_C12_TIMEOUT", 900,
+              "fused_rounds_per_sec"),
 }
 
 
@@ -966,10 +982,11 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--run-cfg":
         sel = sys.argv[2] if len(sys.argv) >= 3 else "<missing>"
-        if sel not in ("c1", "c2", "shard"):
+        if sel not in ("c1", "c2", "shard", "fused"):
             # a missing/typo'd operand must never fall through to the full
             # parent orchestration and claim the chip for a headline bench
-            sys.exit(f"--run-cfg: unknown config {sel!r}; use c1|c2|shard")
+            sys.exit(f"--run-cfg: unknown config {sel!r}; use "
+                     f"c1|c2|shard|fused")
         run_config_measurement(sel)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--capture":

@@ -136,6 +136,19 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                              "reduce (~4x fewer ICI bytes) with its "
                              "residual carried in server error feedback; "
                              "requires --server_shard.")
+    # Fused server epilogue (docs/fused_epilogue.md): one Pallas megakernel
+    # replaces the composed threshold-mask + re-sketch d-plane sweeps of
+    # sketch mode's server step (both the replicated and --server_shard
+    # planes). fp32 bit-identical to the composed path; env kill-switch
+    # COMMEFFICIENT_FUSED_EPILOGUE=0 restores composed without a restartable
+    # flag change.
+    parser.add_argument("--fused_epilogue", action="store_true",
+                        dest="fused_epilogue",
+                        help="Fuse sketch mode's server epilogue "
+                             "(estimates->threshold mask->update emit->"
+                             "re-sketch) into one kernel pass over the "
+                             "d-plane (sketch mode only; composed path "
+                             "stays the default and the reference).")
     parser.add_argument("--metrics_drain_every", type=int, default=8,
                         help="Fetch per-round metrics in batches of N "
                              "rounds; 1 restores per-round (blocking) "

@@ -155,6 +155,7 @@ def server_config_from_args(args, grad_size: int) -> ServerConfig:
         do_dp=args.do_dp,
         dp_mode=args.dp_mode,
         noise_multiplier=args.noise_multiplier,
+        fused_epilogue=bool(getattr(args, "fused_epilogue", False)),
     )
 
 

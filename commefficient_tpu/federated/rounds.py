@@ -246,6 +246,15 @@ def build_round_step(
         assert not wcfg.do_topk_down, \
             "chunked_resident is incompatible with --topk_down stale weights"
     layout = sketch.chunk_layout if chunked else None
+    if scfg.fused_epilogue and wcfg.mode == "sketch" and chunked:
+        # one-time on-TPU self-check of the fused epilogue megakernel,
+        # triggered here (always eager host-side setup, and the one place
+        # that knows the config actually opted in) rather than from
+        # make_sketch — processes that never use the megakernel must not
+        # pay its compile+compare at every sketch build
+        from commefficient_tpu.ops.sketch import _check_fused_epilogue_once
+
+        _check_fused_epilogue_once(eager=True)
     if server_shard and wcfg.mode == "sketch":
         # the sharded sketch server produces its update in the chunk
         # layout (estimates/top-k/re-sketch slices are chunk-aligned)

@@ -38,7 +38,11 @@ import jax.numpy as jnp
 from commefficient_tpu.ops.flat import ChunkLayout
 from commefficient_tpu.ops.sketch import (
     CountSketch,
+    estimates_chunks,
     estimates_chunks_local,
+    fused_epilogue_chunks,
+    fused_epilogue_chunks_local,
+    fused_epilogue_mode,
     sketch_chunks,
     sketch_chunks_local,
     sketch_vec,
@@ -64,6 +68,15 @@ class ServerConfig:
     do_dp: bool = False
     dp_mode: str = "worker"
     noise_multiplier: float = 0.0
+    # Fused server epilogue (--fused_epilogue, docs/fused_epilogue.md):
+    # sketch mode's threshold-mask + update-emit + re-sketch run as one
+    # Pallas megakernel over the chunk plane instead of the composed
+    # topk_dense_nd + sketch_chunks sweeps. Sketch-mode + chunked-resident
+    # only; silently composed elsewhere (and under the
+    # COMMEFFICIENT_FUSED_EPILOGUE=0 kill-switch / VMEM guard — see
+    # ops/sketch.fused_epilogue_mode). fp32 results are bit-identical to
+    # the composed path (pinned in tests/test_fused_epilogue.py).
+    fused_epilogue: bool = False
 
     def __post_init__(self):
         assert self.mode in MODES, self.mode
@@ -324,9 +337,22 @@ def sharded_server_update(
         Tn = -(-sketch.T // n_shard)
         t0 = jax.lax.axis_index(axis) * Tn
         est_local = estimates_chunks_local(sketch, error, t0, Tn)
-        upd_local = topk_dense_nd(est_local, cfg.k, axis_name=axis)
-        resketched = jax.lax.psum(
-            sketch_chunks_local(sketch, upd_local, t0), axis)
+        fe_mode = fused_epilogue_mode(sketch) if cfg.fused_epilogue else "off"
+        if fe_mode != "off":
+            # per-shard one-sweep epilogue: the threshold comes from the
+            # psum'd count exchange exactly like topk_dense_nd's, the
+            # kernel emits this shard's update slice and PARTIAL re-sketch
+            # (bit-identical per chunk to sketch_chunks_local's), and the
+            # psum of partials replaces the composed psum — same table up
+            # to the summation order the sharded plane already documents
+            upd_local, part = fused_epilogue_chunks_local(
+                sketch, est_local, t0, cfg.k, axis_name=axis,
+                interpret=(fe_mode == "interpret"))
+            resketched = jax.lax.psum(part, axis)
+        else:
+            upd_local = topk_dense_nd(est_local, cfg.k, axis_name=axis)
+            resketched = jax.lax.psum(
+                sketch_chunks_local(sketch, upd_local, t0), axis)
         cell_nz = resketched != 0
         if cfg.error_type == "virtual":
             error = jnp.where(cell_nz, 0.0, error)
@@ -393,8 +419,21 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch,
     # path (the chunking is pure layout, the threshold descent counts over
     # the same coordinates)
     if layout is not None:
-        update = unsketch_chunks(sketch, error, cfg.k)
-        sketched_update = sketch_chunks(sketch, update)
+        fe_mode = fused_epilogue_mode(sketch) if cfg.fused_epilogue else "off"
+        if fe_mode != "off":
+            # one-sweep epilogue (docs/fused_epilogue.md): estimates are
+            # materialized once (the threshold descent reads them 8x, so
+            # re-deriving them from table windows per pass would cost more),
+            # then ONE kernel masks at the precomputed threshold, emits the
+            # update, and accumulates its re-sketch — the composed path's
+            # separate compare_select and sketch_chunks d-plane sweeps
+            # collapse into it. Bit-identical values by construction.
+            est = estimates_chunks(sketch, error)
+            update, sketched_update = fused_epilogue_chunks(
+                sketch, est, cfg.k, interpret=(fe_mode == "interpret"))
+        else:
+            update = unsketch_chunks(sketch, error, cfg.k)
+            sketched_update = sketch_chunks(sketch, update)
     else:
         update = unsketch(sketch, error, cfg.k)
 

@@ -770,6 +770,325 @@ def unsketch_chunks(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:
     return topk_dense_nd(estimates_chunks(cs, table), k)
 
 
+# --------------------------------------------------------------------------
+# fused server epilogue: estimates -> threshold mask -> update + re-sketch
+# --------------------------------------------------------------------------
+
+# |bit-pattern| masks, same values as ops/topk.py (kept literal here so the
+# kernel body has no cross-module closure)
+_FE_ABS_MASK = 0x7FFFFFFF
+_FE_INF_BITS = 0x7F800000
+
+
+def _fe_subblock(S: int) -> int:
+    """Sub-block height (sublanes) for the fused epilogue kernel. Smaller
+    than the query kernel's (512 vs 1024): the unwrapped re-sketch
+    accumulator ``(r, S + SB + pad, 128)`` must stay VMEM-resident across
+    the whole grid alongside the est/update pipeline buffers, and SB only
+    sizes the per-step working set, not the streamed bytes."""
+    return min(512, -(-S // 8) * 8)
+
+
+def _fe_ext_sublanes(S: int) -> int:
+    """Sublane height of the UNWRAPPED accumulator: a sub-block's rolled
+    contribution starts at sublane ``(g·SB + q) mod S`` ∈ [0, S) and spans
+    ``SB + 1`` rows (lane carry), so ``S + SB + 1`` rows hold every
+    contribution without cyclic wrap; rows ≥ S are folded back mod S by
+    ``_fold_ext_table`` after the kernel."""
+    return -(-(S + _fe_subblock(S) + 1) // 8) * 8
+
+
+def _fold_ext_table(cs: CountSketch, ext: jax.Array) -> jax.Array:
+    """``(r, S_ext, 128)`` kernel output → ``(r, c_pad)`` table. The kernel
+    folds its wrap region back per chunk (see its docstring), so rows ≥ S
+    are zero on exit and this is a pure slice — kept as a fold (add) so the
+    contract doesn't depend on the zeroing, at table-sized cost."""
+    S = cs.sublanes
+    tbl = ext[:, :S, :]
+    rest = ext[:, S:, :]
+    while rest.shape[1] > 0:
+        w = min(S, rest.shape[1])
+        tbl = tbl + jnp.pad(rest[:, :w], ((0, 0), (0, S - w), (0, 0)))
+        rest = rest[:, w:, :]
+    return tbl.reshape(cs.r, cs.c_pad)
+
+
+@functools.partial(jax.jit, static_argnames=("S", "T", "interpret"))
+def _fused_epilogue_pallas(est3, shift_q, shift_w, sign_keys, t0, p, *,
+                           S, T, interpret=False):
+    """The one-sweep server epilogue megakernel (docs/fused_epilogue.md):
+    one pass over the ``(T, S, 128)`` estimate chunks that
+
+      1. applies the PRECOMPUTED top-k threshold mask ``|est| ≥ p`` (p is
+         the k-th-magnitude int32 bit pattern from the radix descent,
+         ops/topk.resolve_threshold — tie-inclusive, NaN passthrough,
+         exactly ``_apply_threshold``'s semantics),
+      2. emits the masked update chunks (the transmitted update, unscaled
+         — lr multiplies outside where XLA fuses it into ``ps -= upd·lr``),
+      3. accumulates the re-sketch of the masked update into an UNWRAPPED
+         ``(r, S + SB + pad, 128)`` count-sketch accumulator that stays
+         VMEM-resident across the whole grid (constant out-block index):
+         per row the sub-block's sign-weighted values are lane-rotated by
+         ``w`` (hardware rotate unit), given their sublane lane-carry row,
+         and added at dynamic sublane offset ``(g·SB + q) mod S``; at each
+         chunk's last sub-block the wrap region (rows ≥ S) folds back onto
+         [0, S) and re-zeroes, so a cell's contributions land strictly in
+         chunk order.
+
+    Replaces the composed path's separate ``compare_select`` masking sweep
+    and ``sketch_chunks`` re-sketch sweep: est is read once and the update
+    written once — the re-sketch's own d-plane read disappears. The
+    per-chunk fold adds ~SB/S extra accumulator RMW traffic (~13% at the
+    FetchSGD geometry), in VMEM, not HBM.
+
+    Bit-compatibility with the composed path: per table cell and chunk
+    exactly one position contributes (the roll is a permutation), the grid
+    walks chunks in the same t order as ``sketch_chunks``'s scan, and the
+    per-chunk fold lands each chunk's wrapped contributions before the
+    next chunk's adds — so every cell sees the same f32 adds in the same
+    order as the composed re-sketch. The one deviation: masked/overhang
+    positions and the fold's pass-through rows contribute +0.0 where the
+    composed kernels add sign·0 = ±0.0 — cells whose every contribution
+    is a signed zero can differ in the SIGN of their zero (never in ``==``
+    or the ``!= 0`` cell-masking pattern the server consumes).
+
+    ``t0``/pre-sliced shifts: the sharded-server local variant, exactly as
+    in ``_sketch_vec_pallas``/``_estimates_pallas`` — with ``t0 == 0`` the
+    math is bit-identical to the full-range call.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = shift_q.shape[0]
+    SB = _fe_subblock(S)
+    G = -(-S // SB)
+    S_ext = _fe_ext_sublanes(S)
+    chunk_elems = S * _LANES
+
+    def kernel(q_ref, w_ref, key_ref, t0_ref, p_ref, est_ref, upd_ref,
+               tbl_ref):
+        t = pl.program_id(0)
+        g = pl.program_id(1)
+
+        @pl.when(jnp.logical_and(t == 0, g == 0))
+        def _():
+            tbl_ref[...] = jnp.zeros_like(tbl_ref)
+
+        est = est_ref[0]                                       # (SB, 128)
+        raw = jax.lax.bitcast_convert_type(est, jnp.int32)
+        m = raw & _FE_ABS_MASK
+        mag = jnp.where(m > _FE_INF_BITS, 0, m)
+        upd = jnp.where(mag >= p_ref[0], est, jnp.zeros_like(est))
+        upd = jnp.where(m > _FE_INF_BITS, est, upd)   # NaNs stay visible
+        upd_ref[0] = upd
+
+        # re-sketch contribution of this sub-block; rows past S are the
+        # partial last block's overhang — masked so garbage never lands
+        sub_i = g * SB + jax.lax.broadcasted_iota(jnp.int32, (SB, _LANES), 0)
+        contrib = jnp.where(sub_i < S, upd, jnp.zeros_like(upd))
+        base = (t0_ref[0] + t) * chunk_elems + g * (SB * _LANES)
+        idx = base + (
+            jax.lax.broadcasted_iota(jnp.int32, (SB, _LANES), 0) * _LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (SB, _LANES), 1))
+        zz = jnp.zeros((1, _LANES), jnp.float32)
+        l1 = jax.lax.broadcasted_iota(jnp.int32, (SB + 1, _LANES), 1)
+        for j in range(r):
+            sv = contrib * _signs_for(idx, key_ref[j])
+            w = w_ref[j, t]
+            q = q_ref[j, t]
+            z = pltpu.roll(sv, w, axis=1)
+            # lane-carry rows: y[b] = z[b] (lanes ≥ w) | z[b-1] (lanes < w)
+            # with z[-1] = z[SB] = 0 — the (SB+1)-row unwrapped image
+            y = jnp.where(l1 >= w,
+                          jnp.concatenate([z, zz], axis=0),
+                          jnp.concatenate([zz, z], axis=0))
+            s0 = g * SB + q
+            s0 = jnp.where(s0 >= S, s0 - S, s0)
+            tbl_ref[j, pl.ds(s0, SB + 1), :] += y
+
+            # per-chunk wrap fold: move rows ≥ S back onto [0, S) before
+            # the next chunk's adds, so per-cell add order matches the
+            # composed scan's exactly (static strips handle SB > S)
+            @pl.when(g == G - 1)
+            def _(j=j):
+                off = S
+                while off < S_ext:
+                    h = min(S, S_ext - off)
+                    wrap = tbl_ref[j, off:off + h, :]
+                    tbl_ref[j, 0:h, :] += wrap
+                    tbl_ref[j, off:off + h, :] = jnp.zeros(
+                        (h, _LANES), jnp.float32)
+                    off += h
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(T, G),
+        in_specs=[
+            pl.BlockSpec((1, SB, _LANES), lambda t, g, *_: (t, g, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, SB, _LANES), lambda t, g, *_: (t, g, 0)),
+            pl.BlockSpec((r, S_ext, _LANES), lambda t, g, *_: (0, 0, 0)),
+        ],
+        scratch_shapes=[],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((T, S, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((r, S_ext, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(shift_q, shift_w, sign_keys, t0, p, est3)
+
+
+def fused_epilogue_supported(cs: CountSketch) -> bool:
+    """VMEM-budget guard: the unwrapped accumulator plus the pipeline
+    buffers must fit comfortably under the ~16 MB/core VMEM. The FetchSGD
+    geometry (r=5, c=500k → ~11.3 MB accumulator) fits; a much wider/
+    deeper sketch falls back to the composed path."""
+    S = cs.sublanes
+    vmem = (cs.r * _fe_ext_sublanes(S) + 4 * _fe_subblock(S)) * _LANES * 4
+    return vmem <= 13 * 1024 * 1024
+
+
+def fused_epilogue_mode(cs: Optional[CountSketch] = None) -> str:
+    """``'kernel' | 'interpret' | 'off'`` — how (whether) the fused
+    epilogue runs. COMMEFFICIENT_FUSED_EPILOGUE: ``0`` is the operator
+    kill-switch (same pattern as COMMEFFICIENT_PALLAS_TOPK), ``interpret``
+    forces the kernel through the Pallas interpreter (the CPU-mesh test
+    path — bit-identical math, no Mosaic), unset/``1`` enables the real
+    kernel on TPU backends that pass the VMEM guard."""
+    import os
+
+    env = os.environ.get("COMMEFFICIENT_FUSED_EPILOGUE")
+    if env == "0":
+        return "off"
+    if env == "interpret":
+        # the interpreter has no VMEM constraint — never veto it with the
+        # TPU guard, or a guarded geometry silently turns the CPU-mesh
+        # bit-identity tests into composed-vs-composed
+        return "interpret"
+    if cs is not None and not fused_epilogue_supported(cs):
+        return "off"
+    return "kernel" if _use_pallas() else "off"
+
+
+def fused_epilogue_chunks(cs: CountSketch, est3: jax.Array, k: int,
+                          interpret: bool = False):
+    """Fused epilogue over the full chunk range: masked-update chunks plus
+    the ``(r, c_pad)`` re-sketch of that update, one d-plane read.
+
+    Drop-in for the composed pair
+    ``upd = topk_dense_nd(est3, k); tbl = sketch_chunks(cs, upd)`` —
+    same update bits, same table values (see the kernel docstring for the
+    ±0.0 caveat), same tie-inclusive threshold (the descent is shared via
+    ops/topk.resolve_threshold)."""
+    from commefficient_tpu.ops.topk import resolve_threshold
+
+    if _trace_state_clean():
+        _check_fused_epilogue_once(eager=True)
+    p = resolve_threshold(est3, k, interpret=interpret)
+    upd, ext = _fused_epilogue_pallas(
+        est3, cs.shift_q, cs.shift_w, cs.sign_keys, _T0, p.reshape(1),
+        S=cs.sublanes, T=cs.T, interpret=interpret)
+    return upd, _fold_ext_table(cs, ext)
+
+
+def fused_epilogue_chunks_local(cs: CountSketch, est3: jax.Array, t0, k: int,
+                                axis_name=None, interpret: bool = False):
+    """Sharded-server fused epilogue (docs/sharded_server.md): ``est3``
+    is this shard's ``Tn`` estimate chunks starting at global chunk ``t0``
+    (a traced scalar). The threshold is GLOBAL — the descent's counts
+    psum over ``axis_name`` (16 ints per pass) — and the returned table is
+    this shard's PARTIAL re-sketch (linearity: the psum of the shards'
+    partials is the full table, consumed for its zero-cell pattern only,
+    like ``sketch_chunks_local``'s). Per chunk bit-identical to the full
+    path's math."""
+    from commefficient_tpu.ops.topk import resolve_threshold
+
+    if _trace_state_clean():
+        _check_fused_epilogue_once(eager=True)
+    Tn = est3.shape[0]
+    p = resolve_threshold(est3, k, interpret=interpret, axis_name=axis_name)
+    q_cols, w_cols = _local_shift_cols(cs.shift_q, cs.shift_w, t0, Tn)
+    upd, ext = _fused_epilogue_pallas(
+        est3, q_cols, w_cols, cs.sign_keys,
+        jnp.asarray(t0, jnp.int32).reshape(1), p.reshape(1),
+        S=cs.sublanes, T=Tn, interpret=interpret)
+    return upd, _fold_ext_table(cs, ext)
+
+
+_FUSED_EPILOGUE_CHECKED = False
+
+
+def _check_fused_epilogue_once(eager: bool = False) -> None:
+    """One-time on-TPU self-check of the fused epilogue megakernel before
+    first use, mirroring ``_check_sketch_kernel_once``: compare update and
+    re-sketch table against the composed ``topk_dense_nd`` +
+    ``sketch_chunks`` pair at a multi-chunk geometry and disable the
+    kernel via its env kill-switch on any compile failure or mismatch —
+    the composed path is always available and correct. UNLIKE the
+    accumulate/query checks this is NOT triggered from ``make_sketch``:
+    those kernels run unconditionally, while the megakernel is opt-in
+    (--fused_epilogue), and a d=450k sketch build + Mosaic compile at
+    every TPU ``make_sketch`` would tax processes that never use it.
+    Triggers: ``rounds.build_round_step`` when the server config opts in
+    (always eager host-side setup), and an eager first call of
+    ``fused_epilogue_chunks``/``_local`` for direct users."""
+    global _FUSED_EPILOGUE_CHECKED
+    if _FUSED_EPILOGUE_CHECKED:
+        return
+    if fused_epilogue_mode() != "kernel":
+        # nothing to verify: the interpreter path IS the reference math,
+        # and 'off' must never compile a disabled kernel
+        return
+    if not eager and not _trace_state_clean():
+        return
+    _FUSED_EPILOGUE_CHECKED = True
+    import os
+    import warnings
+
+    try:
+        from commefficient_tpu.ops.topk import topk_dense_nd
+
+        cs = make_sketch(d=450_000, c=140_000, r=3, seed=11, num_blocks=2)
+        tbl = jnp.asarray(
+            np.random.RandomState(5).randn(*cs.table_shape), jnp.float32)
+        est = estimates_chunks(cs, tbl)
+        upd_f, tbl_f = fused_epilogue_chunks(cs, est, k=5_000)
+        upd_c = topk_dense_nd(est, 5_000)
+        tbl_c = sketch_chunks(cs, upd_c)
+        if not np.array_equal(np.asarray(upd_f), np.asarray(upd_c)):
+            raise AssertionError("fused update != composed update")
+        if not np.array_equal(np.asarray(tbl_f), np.asarray(tbl_c),
+                              equal_nan=True):
+            # == comparison: the documented ±0.0 sign deviation is allowed,
+            # value deviations are not
+            raise AssertionError("fused re-sketch != composed re-sketch")
+        # sharded local variant (t0 ≠ 0, pre-sliced shifts): must equal the
+        # composed local pair bit-for-bit on the same slice — outside a
+        # shard_map there is no psum'd threshold, so the reference is the
+        # slice-local composed path, not the full update
+        Tn = -(-cs.T // 2)
+        est_p = jnp.pad(est, ((0, 2 * Tn - cs.T), (0, 0), (0, 0)))
+        sl = est_p[Tn:2 * Tn]
+        u_l, t_l = fused_epilogue_chunks_local(cs, sl, jnp.int32(Tn), 5_000)
+        u_ref = topk_dense_nd(sl, 5_000)
+        t_ref = sketch_chunks_local(cs, u_ref, jnp.int32(Tn))
+        if not np.array_equal(np.asarray(u_l), np.asarray(u_ref)):
+            raise AssertionError("local fused update != composed local")
+        if not np.array_equal(np.asarray(t_l), np.asarray(t_ref)):
+            raise AssertionError("local fused table != composed local")
+    except Exception as e:  # noqa: BLE001 — any failure means: don't use it
+        os.environ["COMMEFFICIENT_FUSED_EPILOGUE"] = "0"
+        warnings.warn(
+            f"fused epilogue megakernel self-check failed "
+            f"({type(e).__name__}: {str(e)[:200]}); falling back to the "
+            f"composed topk+re-sketch path", RuntimeWarning)
+
+
 def l2estimate(table: jax.Array) -> jax.Array:
     """Median-of-rows estimate of the sketched vector's L2 norm
     (``CSVec.l2estimate``, used via reference utils.py:305-313)."""

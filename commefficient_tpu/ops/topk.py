@@ -53,9 +53,30 @@ _SUB = 512              # count-kernel block: (512, 128) i32 = 256 KiB VMEM
 # Pallas count-pass descent wins 37x at the FetchSGD geometry
 # (d=6,568,640: 0.30 ms vs 11.10 ms XLA, outputs bit-equal) but LOSES at
 # the GPT-2 geometry (d=124,444,417: 16.15 ms vs 14.57 ms) — above ~100M
-# the kernel's fixed blocking stops tracking HBM streams. Gate between the
-# two measured points, nearer the win.
+# the kernel's fixed (512, 128) blocking stops tracking HBM streams (1,900
+# block boundaries per pass leave no pipelining slack). Gate between the
+# two measured points, nearer the win. The blocking is now d-adaptive
+# (``_sub_for``) so the kernel stays armed above the gate for the re-run
+# A/B (scripts/tpu_measure.py topk_ab) to flip; the gate itself only moves
+# on a committed on-chip measurement.
 _PALLAS_TOPK_MAX_D = 32 * 1024 * 1024
+
+
+def _sub_for(d: int) -> int:
+    """Count/descent-kernel block sublanes chosen from d: (512, 128) i32 =
+    256 KiB blocks at FetchSGD scale (the measured 37x-win shape), 4x that
+    (1 MiB blocks, still trivially double-buffered in VMEM) above the 32M
+    gate where the round-5 A/B showed the fixed blocking losing the HBM
+    streams — 4x fewer block boundaries for the same bytes.
+
+    Radix width note (the other lever considered for d-scaling): widening
+    a pass from 4 to 8 bits would halve the HBM reads but needs 255
+    ≥-compares per element vs 15 — the measured per-pass kernel already
+    runs at the VPU:HBM balance point (~32 int ops per 4-byte element at
+    ~700 GB/s effective), so 8-bit passes are ~8x compute-bound and lose.
+    4-bit levels + fewer/larger blocks is the d-scaling fix; the arithmetic
+    is written out in docs/fused_epilogue.md."""
+    return _SUB if d <= _PALLAS_TOPK_MAX_D else 4 * _SUB
 
 
 def _use_pallas_topk(d: int) -> bool:
@@ -71,15 +92,16 @@ def _use_pallas_topk(d: int) -> bool:
     return is_tpu_backend() and d <= _PALLAS_TOPK_MAX_D
 
 
-@functools.partial(jax.jit, static_argnames=("T", "interpret"))
-def _count_ge_pallas(v3, ts, *, T, interpret=False):
+@functools.partial(jax.jit, static_argnames=("T", "sub", "interpret"))
+def _count_ge_pallas(v3, ts, *, T, sub=_SUB, interpret=False):
     """``counts[j] = sum(mag(v) >= ts[j])`` over the whole vector, one HBM
     read: blocks of the int32 bit patterns stream through VMEM while the 16
     threshold compares and their scalar reductions stay in registers/SMEM —
     the radix-descent inner pass with its memory traffic pinned to 4·d
     bytes (the pure-XLA formulation leaves the (d, 15) broadcast's fate to
     the fusion heuristics). ``ts`` must be padded to 16 with INT32_MAX
-    (counts 0 there: finite-|float| patterns never reach it)."""
+    (counts 0 there: finite-|float| patterns never reach it). ``sub`` is
+    the d-adaptive block height (``_sub_for``)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -99,7 +121,7 @@ def _count_ge_pallas(v3, ts, *, T, interpret=False):
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(T,),
-        in_specs=[pl.BlockSpec((1, _SUB, _LANES), lambda t, *_: (t, 0, 0))],
+        in_specs=[pl.BlockSpec((1, sub, _LANES), lambda t, *_: (t, 0, 0))],
         out_specs=pl.BlockSpec(memory_space=tpu_smem_space()),
     )
     return pl.pallas_call(
@@ -220,7 +242,7 @@ def _threshold_descent_fused(raw: jax.Array, k: int,
     kernel on the blocked flat view of ``raw`` (any shape) — shared by the
     flat and chunked-resident paths like ``_threshold_descent_pallas``."""
     flat = raw.reshape(-1)
-    sub = _SUB if flat.shape[0] <= _PALLAS_TOPK_MAX_D else 4 * _SUB
+    sub = _sub_for(flat.shape[0])
     v3, T = _blocks3(flat, sub)
     kk = jnp.asarray([k], jnp.int32)
     return _descent_pallas(v3, kk, T=T, sub=sub, interpret=interpret)[0]
@@ -239,14 +261,16 @@ def _threshold_descent_pallas(raw: jax.Array, k: int,
     the 16 per-candidate counts are psum'd — 16 ints per pass instead of
     materializing the full vector per chip. Counts are exact integers, so
     the resolved threshold is identical to the unsharded descent's."""
-    v3, T = _blocks3(raw.reshape(-1))
+    flat = raw.reshape(-1)
+    sub = _sub_for(flat.shape[0])
+    v3, T = _blocks3(flat, sub)
     p = jnp.int32(0)
     for shift in range(28, -1, -4):
         hi_nib = 8 if shift == 28 else 16
         ts = p + (jnp.arange(1, hi_nib, dtype=jnp.int32) << shift)
         ts = jnp.pad(ts, (0, 16 - (hi_nib - 1)),
                      constant_values=jnp.int32(_ABS_MASK))
-        counts = _count_ge_pallas(v3, ts, T=T, interpret=interpret)
+        counts = _count_ge_pallas(v3, ts, T=T, sub=sub, interpret=interpret)
         if axis_name is not None:
             counts = jax.lax.psum(counts, axis_name)
         sel = jnp.sum(counts >= k).astype(jnp.int32)
@@ -339,6 +363,45 @@ def _topk_threshold_1d(vec: jax.Array, k: int) -> jax.Array:
     return _apply_threshold(raw, vec, p)
 
 
+def resolve_threshold(vec: jax.Array, k: int, interpret: bool = False,
+                      axis_name=None) -> jax.Array:
+    """THE k-th-largest-magnitude bit-pattern resolver (scalar int32 p) for
+    an arbitrary-shape float32 array — the one dispatch point every caller
+    that needs the top-k threshold without the mask shares:
+    ``topk_dense_nd`` below, and the fused server epilogue
+    (ops/sketch.fused_epilogue_chunks, docs/fused_epilogue.md), whose
+    megakernel takes p precomputed so its single sweep can mask, emit the
+    update, and re-sketch in one pass.
+
+    Precedence (mirrors ``_select_threshold_impl``): kill-switch
+    (COMMEFFICIENT_PALLAS_TOPK=0) beats everything, then the fused
+    whole-descent kernel A/B opt-in (COMMEFFICIENT_PALLAS_TOPK_FUSED=1 —
+    deliberately bypasses the crossover gate: GPT-2-scale d is what the
+    A/B tests), then the per-pass kernel below the measured gate, then
+    pure XLA. Every implementation resolves exact integer counts, so they
+    agree bit-for-bit.
+
+    ``axis_name`` (sharded server, docs/sharded_server.md): ``vec`` is one
+    shard's slice inside a ``shard_map``; the per-pass counts psum over
+    the axis so p is the GLOBAL k-th magnitude. The fused whole-descent
+    kernel cannot psum between its in-kernel passes, so the sharded path
+    always uses the per-pass kernel or pure XLA."""
+    import os
+
+    from commefficient_tpu.utils import is_tpu_backend
+
+    raw = vec.view(jnp.int32)
+    if os.environ.get("COMMEFFICIENT_PALLAS_TOPK") == "0":
+        return _threshold_descent_xla(raw, k, axis_name=axis_name)
+    if (os.environ.get("COMMEFFICIENT_PALLAS_TOPK_FUSED") == "1"
+            and is_tpu_backend() and axis_name is None):
+        return _threshold_descent_fused(raw, k, interpret=interpret)
+    if _use_pallas_topk(vec.size) or interpret:
+        return _threshold_descent_pallas(raw, k, interpret=interpret,
+                                         axis_name=axis_name)
+    return _threshold_descent_xla(raw, k, axis_name=axis_name)
+
+
 def topk_dense_nd(vec: jax.Array, k: int, interpret: bool = False,
                   axis_name=None) -> jax.Array:
     """Shape-preserving global magnitude top-k over EVERY element of an
@@ -356,34 +419,10 @@ def topk_dense_nd(vec: jax.Array, k: int, interpret: bool = False,
     the measured Pallas crossover the count passes run through the fused
     count kernel on a blocked flat view (the one remaining reshape rides
     the same path the flat round always paid; above the crossover the
-    descent is reshape-free).
-
-    ``axis_name`` (sharded server, docs/sharded_server.md): ``vec`` is one
-    shard's slice inside a ``shard_map``; the counts psum over the axis so
-    the threshold is the GLOBAL k-th magnitude, and the returned mask
-    keeps this shard's members of the global top-k set. The fused
-    whole-descent kernel cannot psum between its in-kernel passes, so the
-    sharded path always uses the per-pass kernel or pure XLA."""
-    import os
-
-    from commefficient_tpu.utils import is_tpu_backend
-
+    descent is reshape-free). Threshold dispatch precedence lives in
+    ``resolve_threshold``."""
     raw = vec.view(jnp.int32)
-    # same precedence as the flat selector (_select_threshold_impl):
-    # kill-switch, then the fused-kernel A/B opt-in (which deliberately
-    # bypasses the crossover gate — GPT-2-scale d is what the A/B tests,
-    # and GPT-2 rounds run through THIS entry point), then the per-pass
-    # gate, then pure XLA
-    if os.environ.get("COMMEFFICIENT_PALLAS_TOPK") == "0":
-        p = _threshold_descent_xla(raw, k, axis_name=axis_name)
-    elif (os.environ.get("COMMEFFICIENT_PALLAS_TOPK_FUSED") == "1"
-            and is_tpu_backend() and axis_name is None):
-        p = _threshold_descent_fused(raw, k, interpret=interpret)
-    elif _use_pallas_topk(vec.size) or interpret:
-        p = _threshold_descent_pallas(raw, k, interpret=interpret,
-                                      axis_name=axis_name)
-    else:
-        p = _threshold_descent_xla(raw, k, axis_name=axis_name)
+    p = resolve_threshold(vec, k, interpret=interpret, axis_name=axis_name)
     return _apply_threshold(raw, vec, p)
 
 

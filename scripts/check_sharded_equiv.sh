@@ -6,10 +6,14 @@
 #     plane (reduce-scatter/threshold-exchange/all-gather exactness);
 #   - the int8 quantized reduce's conservation + EF-carry contracts and
 #     its documented tolerance vs fp32;
-#   - checkpoint round-trips of the sharded server state (both planes).
+#   - checkpoint round-trips of the sharded server state (both planes);
+#   - the fused server epilogue's bit-identity to the composed path on
+#     both planes (tests/test_fused_epilogue.py, docs/fused_epilogue.md —
+#     megakernel through the Pallas interpreter).
 # Any extra args are passed through to pytest (e.g. -k bit_identical).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
-    python -m pytest tests/test_sharded_server.py -q -p no:cacheprovider "$@"
+    python -m pytest tests/test_sharded_server.py tests/test_fused_epilogue.py \
+    -q -p no:cacheprovider "$@"

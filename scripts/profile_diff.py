@@ -28,7 +28,11 @@ ms/round grew by more than PCT percent — the CI regression hook.
   scripts/tpu_profile.py emits) must not balloon, and the server step's
   signature categories — "custom-call" (the Pallas sketch/top-k kernels)
   and the plain "reduce" bucket (threshold count passes) — must SHRINK
-  per chip, so any growth at all fails the gate.
+  per chip, so any growth at all fails the gate;
+- ``fused-epilogue`` — the --fused_epilogue claim (docs/fused_epilogue.md):
+  the "server epilogue (d-plane sweeps)" bucket must not grow at all
+  (capture the pair with scripts/tpu_profile.py, the second run under
+  TPU_PROFILE_FUSED=1).
 """
 
 from __future__ import annotations
@@ -45,6 +49,17 @@ _PRESETS: Dict[str, Dict[str, float]] = {
         "reduce (transmit collectives)": 25.0,
         "custom-call": 0.0,
         "reduce": 0.0,
+    },
+    # the --fused_epilogue claim (docs/fused_epilogue.md): the server
+    # epilogue's d-plane sweep bucket (scripts/tpu_profile.py's "server
+    # epilogue (d-plane sweeps)" category — estimates/count-pass/
+    # compare_select/multiply_subtract/megakernel spans) must not grow at
+    # all — the fusion removes sweeps, so any growth is a regression. The
+    # model itself must stay flat (convolutions unchanged by a server-side
+    # fusion; 10% covers tenancy noise between captures).
+    "fused-epilogue": {
+        "server epilogue (d-plane sweeps)": 0.0,
+        "convolution": 10.0,
     },
 }
 

@@ -61,8 +61,8 @@ touch "$STATE"
 is_done() { grep -qx "$1" "$STATE" 2>/dev/null; }
 mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 
-STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 learning profile \
-profile_gpt2 host_offload imagenet ops"}
+STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused fused_epilogue \
+learning profile profile_fused profile_gpt2 host_offload imagenet ops"}
 i=0
 for step in $STEPS; do
   i=$((i + 1))
@@ -90,7 +90,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact
       log "step $i: bench.py --capture $step (timeout 40m)"
@@ -126,6 +126,38 @@ for step in $STEPS; do
       rc=$?
       log "step $i rc=$rc (docs/measurements/tpu_profile_gpt2.md on success)"
       [ $rc -eq 0 ] && mark_done profile_gpt2
+      ;;
+    profile_fused)
+      # --fused_epilogue per-op capture + the sweep-count gate against the
+      # composed capture (docs/fused_epilogue.md). Needs the composed
+      # capture first (the 'profile' step).
+      log "step $i: tpu_profile.py fused-epilogue capture + diff (40m)"
+      TPU_PROFILE_FUSED=1 timeout 2400 python scripts/tpu_profile.py \
+        >"$OUT/profile_fused.log" 2>&1
+      rc=$?
+      if [ $rc -eq 0 ]; then
+        python scripts/profile_diff.py docs/measurements/tpu_profile.md \
+          docs/measurements/tpu_profile_fused.md --preset fused-epilogue \
+          >"$OUT/profile_fused_diff.log" 2>&1 || \
+          log "note: fused-epilogue sweep gate FAILED (see diff log)"
+        mark_done profile_fused
+      fi
+      log "step $i rc=$rc (docs/measurements/tpu_profile_fused.md on success)"
+      ;;
+    fused_epilogue)
+      # composed-vs-fused epilogue chain A/B + the re-armed topk A/B with
+      # the d-adaptive blocking, both FetchSGD geometries
+      # (docs/fused_epilogue.md gate decision rule)
+      log "step $i: tpu_measure.py fused_epilogue topk_ab (timeout 40m)"
+      timeout 2400 python scripts/tpu_measure.py fused_epilogue topk_ab \
+        >"$OUT/tpu_measure_fused.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_fused.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "fused epilogue chain" "$OUT/tpu_measure_fused.log" \
+          && grep -q "fused-descent topk" "$OUT/tpu_measure_fused.log"; then
+        mark_done fused_epilogue
+      fi
       ;;
     host_offload)
       # true 35 GB EMNIST-scale host-offloaded client state (VERDICT r4 #5)

@@ -214,14 +214,23 @@ def topk_ab_leg(d):
     COMMEFFICIENT_PALLAS_TOPK_FUSED=1 — flip only if it beats the per-pass
     kernel here with equal output). Any dense vector exercises the same
     code; no sketch build needed, so this costs minutes, not the full
-    wedge-prone ops chain."""
+    wedge-prone ops chain.
+
+    Since the d-scalable blocking landed (ops/topk._sub_for,
+    docs/fused_epilogue.md) both kernels run 1 MiB blocks above the 32M
+    gate — THE re-run this leg exists for: if the per-pass or fused kernel
+    now beats XLA at d=124M with equal outputs, move (or delete)
+    _PALLAS_TOPK_MAX_D and record the table in docs/fused_epilogue.md."""
     from commefficient_tpu.ops.topk import (
+        _sub_for,
         _topk_threshold_1d,
         _topk_threshold_1d_fused,
         _topk_threshold_1d_pallas,
     )
 
     v = jnp.asarray(np.random.RandomState(0).randn(d).astype(np.float32))
+    print(f"d={d}: kernel block sublanes = {_sub_for(d)} "
+          f"({_sub_for(d) * 128 * 4 // 1024} KiB blocks)", flush=True)
     ref = _topk_threshold_1d(v, 50_000)
     drain(ref)
     t_x = chained(lambda x: _topk_threshold_1d(x, 50_000), v, K=4)
@@ -234,6 +243,51 @@ def topk_ab_leg(d):
     same_f = bool(jnp.all(_topk_threshold_1d_fused(v, 50_000) == ref))
     print(f"d={d}: fused-descent topk {t_f:.2f} ms vs per-pass pallas "
           f"{t_p:.2f} ms | outputs equal: {same_f}", flush=True)
+
+
+def fused_epilogue_leg(d):
+    """Fused server epilogue A/B (docs/fused_epilogue.md): the composed
+    topk_dense_nd + sketch_chunks pair vs fused_epilogue_chunks on real
+    estimate chunks at the FetchSGD sketch geometry. Both arms chain
+    through an estimates_chunks round-trip (table -> est -> epilogue ->
+    table) so the chained scalar forces the whole pipeline; the arms
+    differ only in the epilogue, so the delta IS the fusion win. Output
+    equality is checked bitwise (update) / by == (table, ±0 allowed)."""
+    from commefficient_tpu.ops.topk import topk_dense_nd
+
+    geo = sk.make_sketch(d, c=500_000, r=5, seed=42, num_blocks=20)
+    if not sk.fused_epilogue_supported(geo):
+        print(f"d={d}: fused epilogue unsupported at this geometry "
+              f"(VMEM guard)", flush=True)
+        return
+    tbl = jnp.asarray(
+        np.random.RandomState(0).randn(*geo.table_shape), jnp.float32)
+    est = sk.estimates_chunks(geo, tbl)
+    k = 50_000
+    upd_c = topk_dense_nd(est, k)
+    tbl_c = sk.sketch_chunks(geo, upd_c)
+    upd_f, tbl_f = sk.fused_epilogue_chunks(geo, est, k)
+    same_u = bool(jnp.all(upd_f == upd_c))
+    same_t = bool(jnp.all(tbl_f == tbl_c))
+    print(f"d={d}: fused epilogue outputs equal: update={same_u} "
+          f"table={same_t}", flush=True)
+
+    def composed(t):
+        u = topk_dense_nd(sk.estimates_chunks(geo, t), k)
+        return sk.sketch_chunks(geo, u)
+
+    def fused(t):
+        return sk.fused_epilogue_chunks(geo, sk.estimates_chunks(geo, t),
+                                        k)[1]
+
+    t_c = leg("epilogue-composed", chained, composed, tbl, K=4)
+    if t_c is not None:
+        print(f"d={d}: composed epilogue chain {t_c:.2f} ms", flush=True)
+    t_f = leg("epilogue-fused", chained, fused, tbl, K=4)
+    if t_f is not None:
+        print(f"d={d}: fused epilogue chain {t_f:.2f} ms"
+              + (f" (delta {t_c - t_f:+.2f} ms = the fusion win)"
+                 if t_c is not None else ""), flush=True)
 
 
 def gpt2_leg(bf16):
@@ -326,7 +380,8 @@ def imagenet_leg(bf16, microbatch):
 
 def main():
     """Leg names via argv select a subset (default: all)."""
-    known = {"matmul", "cifar", "ops", "gpt2", "imagenet", "topk_ab"}
+    known = {"matmul", "cifar", "ops", "gpt2", "imagenet", "topk_ab",
+             "fused_epilogue"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -354,6 +409,9 @@ def main():
     if sel("topk_ab"):
         leg("topk_ab-6.5M", topk_ab_leg, 6_568_640)
         leg("topk_ab-124M", topk_ab_leg, 124_444_417)
+    if sel("fused_epilogue"):
+        leg("fused_epilogue-6.5M", fused_epilogue_leg, 6_568_640)
+        leg("fused_epilogue-124M", fused_epilogue_leg, 124_444_417)
 
 
 if __name__ == "__main__":

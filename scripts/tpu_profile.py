@@ -34,9 +34,16 @@ ROUNDS = int(os.environ.get("TPU_PROFILE_ROUNDS", 10))
 TARGET = os.environ.get("TPU_PROFILE_TARGET", "cifar")
 if TARGET not in ("cifar", "gpt2"):
     sys.exit(f"unknown TPU_PROFILE_TARGET {TARGET!r} (cifar|gpt2)")
+# TPU_PROFILE_FUSED=1 profiles the --fused_epilogue round and writes a
+# *_fused.md capture next to the composed one, so the fused-epilogue
+# before/after is two runs of this script + one profile_diff
+# (--preset fused-epilogue) — no hand-editing of captures.
+FUSED = os.environ.get("TPU_PROFILE_FUSED") == "1"
+_SUFFIX = "_fused" if FUSED else ""
 OUT_MD = os.path.join(
     _REPO, "docs", "measurements",
-    "tpu_profile.md" if TARGET == "cifar" else f"tpu_profile_{TARGET}.md")
+    f"tpu_profile{_SUFFIX}.md" if TARGET == "cifar"
+    else f"tpu_profile_{TARGET}{_SUFFIX}.md")
 _TITLES = {
     "cifar": ("fused CIFAR federated round",
               "full bench geometry (ResNet9 d={d}, 8 workers, sketch "
@@ -54,8 +61,30 @@ def _category(op_name: str) -> str:
     groups too."""
     n = op_name.lower()
     for pat, cat in (
-        (r"convolution|conv", "convolution (MXU)"),
+        # conv(?!ert): real convolutions only — the old bare "conv" also
+        # swept every convert_* dtype/pad fusion (d-plane traffic on
+        # GPT-2, which has zero convolutions) into the MXU bucket, which
+        # the fused-epilogue preset now gates as "model stays flat"
+        (r"convolution|conv(?!ert)", "convolution (MXU)"),
         (r"\bdot\b|matmul|gemm", "matmul (MXU)"),
+        # The server epilogue's d-plane sweeps (docs/fused_epilogue.md):
+        # every op that reads or writes a model-sized plane between the
+        # aggregated transmit and the weight update — the estimates query
+        # kernel, the radix-descent count passes (s32[15]/s32[7] fusions on
+        # the XLA path, the count/descent Pallas kernels otherwise), the
+        # threshold compare_select mask, the re-sketch (fused megakernel),
+        # and the lr-scale/EF multiply_subtract. The fused-epilogue claim
+        # is that this bucket's span count and ms/round SHRINK
+        # (profile_diff --preset fused-epilogue gates it). Caveat:
+        # _sketch_vec_pallas is NOT bucketed here — the same kernel name
+        # serves the worker-side gradient sketch, so the composed
+        # re-sketch's share hides under custom-call; the fused kernel
+        # (_fused_epilogue_pallas) has its own name exactly so the
+        # epilogue share becomes attributable.
+        (r"_fused_epilogue_pallas|_estimates_pallas|_count_ge_pallas"
+         r"|_descent_pallas|compare_select_fusion|multiply_subtract_fusion"
+         r"|convert_reduce_fusion[^=]*= s32\[(15|7|16)\]",
+         "server epilogue (d-plane sweeps)"),
         # the sharded server plane's transmit collectives (reduce-scatter
         # of the round transmit, update all-gather, the int8 collective's
         # all-to-all — docs/sharded_server.md) get their own bucket so
@@ -141,6 +170,8 @@ def write_report(plane, line, agg, wall_ms_per_round, backend, d, tiny,
     geom = (f"tiny CPU-fallback geometry (ResNet9 d={d:,}) — parser "
             f"self-test, NOT a perf artifact" if tiny else
             geom_t.format(d=f"{d:,}"))
+    if FUSED:
+        geom += ", --fused_epilogue"
     os.makedirs(os.path.dirname(out_md), exist_ok=True)
     with open(out_md, "w") as f:
         f.write(f"# Per-op profile: {title}\n\n")
@@ -157,6 +188,18 @@ def write_report(plane, line, agg, wall_ms_per_round, backend, d, tiny,
         for cat, (cnt, ps) in cat_rows:
             f.write(f"| {cat} | {cnt} | {ps / 1e9:.2f} | "
                     f"{ps / 1e9 / ROUNDS:.3f} | {100 * ps / total_ps:.1f}% |\n")
+        # the fused-epilogue target metric (docs/fused_epilogue.md): how
+        # many distinct d-plane epilogue ops the server step issues per
+        # round — the sweep count the megakernel exists to collapse.
+        # Span-count based, so it is robust to tenancy noise in a way the
+        # ms numbers are not.
+        ep_cnt, ep_ps = cats.get("server epilogue (d-plane sweeps)", (0, 0))
+        f.write(f"\nServer epilogue d-plane sweeps: "
+                f"**{ep_cnt / ROUNDS:.1f} ops/round** "
+                f"({ep_ps / 1e9 / ROUNDS:.3f} ms/round) — the sweep "
+                f"counter the fused epilogue targets "
+                f"(docs/fused_epilogue.md; gate via scripts/profile_diff.py "
+                f"--preset fused-epilogue).\n")
         f.write("\n## Top 40 ops\n\n")
         f.write("| op | count | total ms | ms/round | % busy |\n")
         f.write("|---|---|---|---|---|\n")
@@ -187,9 +230,10 @@ def main() -> int:
         if not on_tpu:
             print("gpt2 profile target is chip-only (d=124M)", flush=True)
             return 2
-        steps, ps, ss, cs, batch, _tokens = B.build_gpt2(bf16=True)
+        steps, ps, ss, cs, batch, _tokens = B.build_gpt2(bf16=True,
+                                                         fused_epilogue=FUSED)
     else:
-        steps, ps, ss, cs, batch = B.build(tiny=tiny)
+        steps, ps, ss, cs, batch = B.build(tiny=tiny, fused_epilogue=FUSED)
     d = int(ps.size)
 
     def drain(x):
@@ -206,7 +250,8 @@ def main() -> int:
     # per-target trace dir, cleared first: the parser takes the newest
     # xplane.pb, and a failed trace must NOT silently re-report an older
     # target's data under this target's filename
-    trace_dir = os.path.join(_REPO, "runs", f"tpu_profile_trace_{TARGET}")
+    trace_dir = os.path.join(_REPO, "runs",
+                             f"tpu_profile_trace_{TARGET}{_SUFFIX}")
     import shutil
 
     shutil.rmtree(trace_dir, ignore_errors=True)
