@@ -147,7 +147,8 @@ _T0 = time.monotonic()
 
 def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
           mode: str = "sketch", num_workers: int = NUM_WORKERS,
-          server_shard: bool = False, fused_epilogue: bool = False):
+          server_shard: bool = False, fused_epilogue: bool = False,
+          guards: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -197,7 +198,7 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
     sketch = make_sketch(d, c=c, r=r, seed=42, num_blocks=blocks) \
         if mode == "sketch" else None
     cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
-                      server_shard=server_shard)
+                      server_shard=server_shard, guards=guards)
     loss_train, loss_val = make_cv_losses(model)
     # the entrypoints' real execution path: shard_map+psum over a clients
     # mesh — a 1-device mesh on the single bench chip
@@ -531,7 +532,7 @@ def run_measurement(tiny: bool) -> None:
 
 # one measure-and-emit path for every CIFAR-family config leg:
 # name -> (mode, workers, baseline r/s, num_classes, non_iid, K,
-#          server_shard, fused_epilogue, label).
+#          server_shard, fused_epilogue, guards, label).
 # K multi-rounds per dispatch via lax.scan: the cheap c1/c2 rounds are
 # smaller than the ~40 ms tunnel rtt, so 20 single-round dispatches would
 # measure transport noise (and raising the dispatch count instead wedges
@@ -539,11 +540,11 @@ def run_measurement(tiny: bool) -> None:
 # dispatch keep the queue shallow while the timed region grows K x.
 _CFG_LEGS = {
     "c1": ("uncompressed", 1, "BASELINE_C1", 10, False, 20, False, False,
-           "1-worker uncompressed rounds/sec/chip (ResNet9)"),
+           False, "1-worker uncompressed rounds/sec/chip (ResNet9)"),
     "c2": ("true_topk", 8, "BASELINE_C2", 10, False, 10, False, False,
-           "8-worker true-topk rounds/sec/chip (ResNet9, k=50k)"),
+           False, "8-worker true-topk rounds/sec/chip (ResNet9, k=50k)"),
     "cifar100": ("sketch", 8, "BASELINE_CIFAR100", 100, True, 1, False,
-                 False,
+                 False, False,
                  "CIFAR100/FEMNIST-style non-IID sketched rounds/sec/chip "
                  "(ResNet9-100, 500 clients, 8 workers, sketch 5x500k "
                  "k=50k)"),
@@ -553,7 +554,7 @@ _CFG_LEGS = {
     # directly. Per-shard server work only drops on a multi-chip mesh, so
     # on the 1-chip bench this leg pins NO-regression with the plane on;
     # on a multi-chip mesh it measures the win.
-    "shard": ("sketch", 8, "BASELINE", 10, False, 1, True, False,
+    "shard": ("sketch", 8, "BASELINE", 10, False, 1, True, False, False,
               "8-worker sketched rounds/sec/chip with --server_shard "
               "(ResNet9, sketch 5x500k k=50k, sharded server data plane)"),
     # the headline sketch leg with the fused server epilogue
@@ -561,9 +562,18 @@ _CFG_LEGS = {
     # anchor so the fused-vs-composed delta reads straight off the two
     # legs (mfu_attack_r5.md projects ~2.3 ms/round ≈ 32% MFU if the
     # fusion fully lands).
-    "fused": ("sketch", 8, "BASELINE", 10, False, 1, False, True,
+    "fused": ("sketch", 8, "BASELINE", 10, False, 1, False, True, False,
               "8-worker sketched rounds/sec/chip with --fused_epilogue "
               "(ResNet9, sketch 5x500k k=50k, one-sweep server epilogue)"),
+    # the headline sketch leg with on-device health guards (--guards,
+    # docs/fault_tolerance.md); same config-3 baseline anchor, so
+    # guarded-vs-unguarded steady-state overhead reads straight off this
+    # leg vs the headline (the guard is two scalar isfinite reductions +
+    # a handful of d-plane selects riding the existing epilogue sweeps —
+    # expected low single-digit %).
+    "guards": ("sketch", 8, "BASELINE", 10, False, 1, False, False, True,
+               "8-worker sketched rounds/sec/chip with --guards (ResNet9, "
+               "sketch 5x500k k=50k, on-device health guards)"),
 }
 
 
@@ -578,7 +588,7 @@ def run_config_measurement(name: str) -> None:
 
     _check_pallas_kernel()
     (mode, W, base_name, num_classes, non_iid, K, server_shard,
-     fused_epilogue, label) = _CFG_LEGS[name]
+     fused_epilogue, guards, label) = _CFG_LEGS[name]
     base = {"BASELINE": BASELINE_ROUNDS_PER_SEC,
             "BASELINE_C1": BASELINE_C1_ROUNDS_PER_SEC,
             "BASELINE_C2": BASELINE_C2_ROUNDS_PER_SEC,
@@ -586,7 +596,7 @@ def run_config_measurement(name: str) -> None:
     steps, ps, server_state, client_states, batch = build(
         tiny=False, num_classes=num_classes, non_iid=non_iid, mode=mode,
         num_workers=W, server_shard=server_shard,
-        fused_epilogue=fused_epilogue)
+        fused_epilogue=fused_epilogue, guards=guards)
     if K > 1:
         inner = steps.train_step
 
@@ -701,6 +711,8 @@ _EXTRA_LEGS = {
               "shard_rounds_per_sec"),
     "fused": (["--run-cfg", "fused"], "BENCH_C12_TIMEOUT", 900,
               "fused_rounds_per_sec"),
+    "guards": (["--run-cfg", "guards"], "BENCH_C12_TIMEOUT", 900,
+               "guards_rounds_per_sec"),
 }
 
 
@@ -982,11 +994,11 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--run-cfg":
         sel = sys.argv[2] if len(sys.argv) >= 3 else "<missing>"
-        if sel not in ("c1", "c2", "shard", "fused"):
+        if sel not in ("c1", "c2", "shard", "fused", "guards"):
             # a missing/typo'd operand must never fall through to the full
             # parent orchestration and claim the chip for a headline bench
             sys.exit(f"--run-cfg: unknown config {sel!r}; use "
-                     f"c1|c2|shard|fused")
+                     f"c1|c2|shard|fused|guards")
         run_config_measurement(sel)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--capture":

@@ -26,6 +26,30 @@ ERROR_TYPES = ["none", "local", "virtual"]
 DP_MODES = ["worker", "server"]
 
 
+def parse_inject_fault(spec: str):
+    """``--inject_fault`` spec → {round_index: poison_value}. The spec is
+    'ROUND:KIND[,ROUND:KIND...]' with KIND in {nan, inf}; a malformed spec
+    fails here at parse time, not rounds into a run."""
+    values = {"nan": float("nan"), "inf": float("inf")}
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            rnd, kind = part.split(":")
+            rnd = int(rnd)
+        except ValueError:
+            raise ValueError(
+                f"--inject_fault: bad entry {part!r}; expected ROUND:KIND "
+                f"(e.g. '5:nan' or '2:nan,7:inf')") from None
+        assert kind in values, (
+            f"--inject_fault: unknown kind {kind!r}; use nan|inf")
+        assert rnd >= 0, f"--inject_fault: round {rnd} must be >= 0"
+        out[rnd] = values[kind]
+    return out
+
+
 def _model_names():
     from commefficient_tpu import models
 
@@ -67,8 +91,25 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     # run state every N epochs, restart from it bit-exactly
     parser.add_argument("--checkpoint_every", type=int, default=0,
                         help="Save full run state every N epochs (0 = off).")
+    # Preemption-safe round-granular resume (docs/fault_tolerance.md): save
+    # the full run state — including the FedSampler position and partial
+    # epoch metrics — every N rounds mid-epoch, so a SIGKILL'd run resumed
+    # with --resume auto loses at most N rounds and reproduces the
+    # uninterrupted fp32 trajectory bit-exactly.
+    parser.add_argument("--checkpoint_every_rounds", type=int, default=0,
+                        help="Save full run state every N rounds mid-epoch "
+                             "(0 = off; engine in-flight window is drained "
+                             "before each save).")
     parser.add_argument("--resume", type=str, default="",
-                        help="Path of a run-state checkpoint to resume from.")
+                        help="Path of a run-state checkpoint to resume "
+                             "from, or 'auto' to pick the newest VALID "
+                             "run_state*.npz under --checkpoint_path "
+                             "(corrupt/truncated files are skipped).")
+    parser.add_argument("--keep_checkpoints", type=int, default=0,
+                        help="Retain only the newest N run_state*.npz under "
+                             "--checkpoint_path, pruning older ones after "
+                             "each save (0 = keep all; existing workflows "
+                             "unchanged).")
     parser.add_argument("--finetune_path", type=str, default="./finetune")
     parser.add_argument("--finetuned_from", type=str, choices=_dataset_names(),
                         help="Name of the dataset you pretrained on.")
@@ -243,6 +284,36 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--client_dropout", type=float, default=0.0,
                         help="Per-round probability that a sampled client "
                              "drops out (0 disables).")
+    # On-device health guards + quarantine (docs/fault_tolerance.md): a
+    # scalar finiteness/magnitude verdict per round, riding the batched
+    # metric drain (zero extra host syncs). A tripped round's contribution
+    # — INCLUDING its error-feedback carry — is discarded on device the
+    # same round; repeated trips roll back to a device-resident snapshot
+    # and eventually abort with a clear error.
+    parser.add_argument("--guards", action="store_true", dest="guards",
+                        help="Enable per-round on-device health guards: "
+                             "non-finite (or over-magnitude) rounds are "
+                             "quarantined without touching (velocity, "
+                             "error) and training continues.")
+    parser.add_argument("--guard_max_abs", type=float, default=0.0,
+                        help="Magnitude guard: trip when any updated PS "
+                             "weight exceeds this absolute value "
+                             "(0 = finiteness-only).")
+    parser.add_argument("--snapshot_every", type=int, default=64,
+                        help="Refresh the device-resident last-good server "
+                             "snapshot every N healthy drained rounds "
+                             "(guards only; 0 disables rollback).")
+    parser.add_argument("--max_guard_trips", type=int, default=3,
+                        help="Consecutive guard trips before aborting with "
+                             "a fatal error (guards only).")
+    # Fault-injection debug hook (tests/test_fault_tolerance.py): poison
+    # the aggregated transmit of the given dispatch round(s) so guard
+    # detection/quarantine is testable end-to-end.
+    parser.add_argument("--inject_fault", type=str, default="",
+                        help="Debug: 'ROUND:KIND[,ROUND:KIND...]' with KIND "
+                             "in {nan,inf} — overwrite one element of that "
+                             "round's aggregated transmit with the value "
+                             "before the server phase.")
 
     # GPT2 args
     parser.add_argument("--model_checkpoint", type=str, default="gpt2")
@@ -286,6 +357,20 @@ def validate_args(args):
             f"--seq_devices {args.seq_devices}")
     assert 0.0 <= args.client_dropout < 1.0, (
         f"--client_dropout {args.client_dropout} must be in [0, 1)")
+    if args.checkpoint_every_rounds:
+        assert args.train_dataloader_workers == 0, (
+            "--checkpoint_every_rounds needs --train_dataloader_workers 0: "
+            "a prefetch thread draws batches (and augmentation randomness) "
+            "ahead of the training loop, so the saved sampler/RNG position "
+            "would not match the rounds actually applied")
+    assert args.max_guard_trips >= 1, "--max_guard_trips must be >= 1"
+    assert args.snapshot_every >= 0, "--snapshot_every must be >= 0"
+    if args.inject_fault:
+        parse_inject_fault(args.inject_fault)  # fail fast on a bad spec
+        if not args.guards:
+            print("NOTE: --inject_fault without --guards will poison the "
+                  "run with nothing to catch it (intentional only for "
+                  "demonstrating the failure mode)")
     if args.reduce_dtype == "int8":
         assert args.server_shard, (
             "--reduce_dtype int8 quantizes the transmit reduce of the "

@@ -10,6 +10,16 @@ exhausted.
 ``iter_structured`` additionally yields (client_ids, list-of-index-arrays) so
 the TPU loader can build static-shaped client-major batches without
 re-deriving the client split.
+
+Preemption-safe round-granular resume (docs/fault_tolerance.md):
+``get_state``/``set_state`` capture and restore the active epoch's position
+(the within-client permutation and per-client cursors). Together with the
+global numpy RNG state — which drives both the per-round
+``np.random.choice`` and the transform augmentation draws, and is captured
+by ``save_run_state`` — a restored sampler replays the REMAINDER of a
+half-finished epoch exactly. The per-round cursor advance happens before
+the ``yield`` so every yielded batch is already reflected in
+``get_state()`` at the moment the training loop holds it.
 """
 
 from __future__ import annotations
@@ -26,15 +36,26 @@ class FedSampler:
         self.num_workers = num_workers
         self.local_batch_size = local_batch_size
         self.shuffle_clients = shuffle_clients
+        self._permuted = None   # active epoch's within-client permutation
+        self._cursor = None     # active epoch's per-client consumption
+        self._pending_state = None
 
     def _gen(self, structured):
         data_per_client = np.asarray(self.dataset.data_per_client)
         cumsum = np.hstack([[0], np.cumsum(data_per_client)])
-        permuted = np.hstack([
-            s + np.random.permutation(n)
-            for s, n in zip(cumsum, data_per_client)
-        ]) if len(data_per_client) else np.array([], dtype=int)
-        cursor = np.zeros(self.dataset.num_clients, dtype=np.int64)
+        if self._pending_state is not None:
+            # resume mid-epoch (set_state): replay the saved permutation
+            # and cursors instead of drawing a fresh epoch
+            permuted = np.asarray(self._pending_state["permuted"], np.int64)
+            cursor = np.array(self._pending_state["cursor"], np.int64)
+            self._pending_state = None
+        else:
+            permuted = np.hstack([
+                s + np.random.permutation(n)
+                for s, n in zip(cumsum, data_per_client)
+            ]) if len(data_per_client) else np.array([], dtype=int)
+            cursor = np.zeros(self.dataset.num_clients, dtype=np.int64)
+        self._permuted, self._cursor = permuted, cursor
 
         while True:
             alive = np.where(cursor < data_per_client)[0]
@@ -49,11 +70,29 @@ class FedSampler:
                 sizes = np.clip(remaining, 0, self.local_batch_size)
             starts = cumsum[workers] + cursor[workers]
             per_client = [permuted[s:s + sz] for s, sz in zip(starts, sizes)]
+            # advance BEFORE yielding: a get_state() taken while the
+            # consumer holds this batch already counts it as consumed
+            # (the round-granular checkpoint's save point)
+            cursor[workers] += sizes
             if structured:
                 yield workers, per_client
             else:
                 yield np.hstack(per_client)
-            cursor[workers] += sizes
+
+    def get_state(self):
+        """Position of the active epoch (None before the first round) —
+        everything a mid-epoch ``set_state`` needs besides the global numpy
+        RNG state."""
+        if self._permuted is None:
+            return None
+        return {"permuted": self._permuted.copy(),
+                "cursor": self._cursor.copy()}
+
+    def set_state(self, state) -> None:
+        """Arm a restored mid-epoch position: the NEXT ``__iter__`` /
+        ``iter_structured`` continues that epoch from the saved cursors."""
+        self._pending_state = {"permuted": np.asarray(state["permuted"]),
+                               "cursor": np.asarray(state["cursor"])}
 
     def __iter__(self):
         return self._gen(structured=False)

@@ -8,10 +8,14 @@ from commefficient_tpu.federated.engine import (
     RoundResult,
 )
 from commefficient_tpu.federated.checkpoint import (
+    find_resume_checkpoint,
     load_checkpoint,
     load_matching,
     load_run_state,
+    prune_run_states,
+    resume_run,
     save_checkpoint,
+    save_round_state,
     save_run_state,
 )
 from commefficient_tpu.federated.rounds import (
@@ -35,10 +39,14 @@ __all__ = [
     "LambdaLR",
     "PipelinedRoundEngine",
     "RoundResult",
+    "find_resume_checkpoint",
     "load_checkpoint",
     "load_matching",
     "load_run_state",
+    "prune_run_states",
+    "resume_run",
     "save_checkpoint",
+    "save_round_state",
     "save_run_state",
     "ClientStates",
     "RoundConfig",

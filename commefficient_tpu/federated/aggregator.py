@@ -76,13 +76,26 @@ class RoundHandle(NamedTuple):
     scalar popcount in regime (a), per-participant changed-coordinate counts
     in regime (b)); fetching any of them is the blocking host sync the
     pipelined engine batches into its every-N drain. ``valid``/
-    ``participating``/``upload`` are host data already."""
+    ``participating``/``upload`` are host data already.
+
+    ``guard`` (--guards, docs/fault_tolerance.md) is the round's on-device
+    health verdict — a device bool attached by ``seal_round`` after the
+    server phase and materialized with the batched drain, so guard
+    bookkeeping adds zero per-round host syncs."""
 
     metrics: Tuple[Any, ...]
     valid: np.ndarray
     participating: np.ndarray
     download: Optional[Any]
     upload: np.ndarray
+    guard: Optional[Any] = None
+
+
+@jax.jit
+def _device_copy(tree):
+    # distinct device buffers with the inputs' shardings — snapshots must
+    # survive the round steps donating the live resident state
+    return jax.tree_util.tree_map(jnp.copy, tree)
 
 
 @jax.jit
@@ -242,11 +255,22 @@ class FedModel:
         # Sharded server data plane (--server_shard, docs/sharded_server.md)
         self._server_shard = bool(getattr(args, "server_shard", False))
         self._reduce_dtype = getattr(args, "reduce_dtype", None) or "float32"
+        # On-device health guards + quarantine (--guards,
+        # docs/fault_tolerance.md): the jitted server phase gates each
+        # round's state transition on server.round_health and returns the
+        # verdict as one extra device scalar; host bookkeeping (trip
+        # counters, snapshot/rollback, fatal escalation) happens at drain
+        # time in finish_round / _note_guard.
+        self._guards = bool(getattr(args, "guards", False))
+        self._guard_max_abs = float(getattr(args, "guard_max_abs", 0.0)
+                                    or 0.0)
         cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=self.grad_size,
                           do_test=args.do_test, tp_sliced=tp_sliced,
                           ep_sliced=ep_sliced,
                           server_shard=self._server_shard,
-                          reduce_dtype=self._reduce_dtype)
+                          reduce_dtype=self._reduce_dtype,
+                          guards=self._guards,
+                          guard_max_abs=self._guard_max_abs)
         from commefficient_tpu.federated.losses import make_cv_losses  # noqa: F401
 
         self.steps = build_round_step(
@@ -332,6 +356,27 @@ class FedModel:
         # would make drop patterns depend on queue timing. Captured and
         # restored by the run-state checkpoint (resume-safe).
         self._drop_rng = np.random.RandomState(args.seed + 2)
+
+        # ---- fault-tolerance bookkeeping (docs/fault_tolerance.md) ----
+        # guard verdict of the most recent server phase, waiting for
+        # seal_round to attach it to that round's handle
+        self._pending_guard = None
+        self.guard_trips = 0          # total tripped rounds this process
+        self._consecutive_trips = 0
+        self._max_guard_trips = int(getattr(args, "max_guard_trips", 3))
+        self._snapshot_every = int(getattr(args, "snapshot_every", 0) or 0)
+        self._rounds_since_snapshot = 0
+        self._snapshot = None         # device-resident last-good state
+        self._optimizer = None        # backlink set by FedOptimizer
+        # --inject_fault debug hook: {dispatch_round: poison value}
+        self._rounds_dispatched = 0
+        inject = getattr(args, "inject_fault", "") or ""
+        if isinstance(inject, str) and inject:
+            from commefficient_tpu.config import parse_inject_fault
+
+            self._inject = parse_inject_fault(inject)
+        else:
+            self._inject = dict(inject) if inject else {}
 
         # ---- download-byte tracking (fed_aggregator.py:170-194) ----
         # accounting state mirrors the resident ps layout (flat or chunked);
@@ -478,6 +523,19 @@ class FedModel:
         ctx, self._model_state, metrics = self.steps.client_step(
             self.ps_weights, states_in, self._model_state, jbatch,
             lr, self._next_rng())
+        round_no = self._rounds_dispatched
+        self._rounds_dispatched += 1
+        poison = self._inject.get(round_no)
+        if poison is not None:
+            # --inject_fault debug hook (docs/fault_tolerance.md): overwrite
+            # one element of this round's aggregated transmit — the exact
+            # poison a non-finite client contribution would land — so guard
+            # detection/quarantine is testable end-to-end. A device-side
+            # scatter, no host sync.
+            g = ctx.gradient
+            ctx = ctx._replace(gradient=g.at[(0,) * g.ndim].set(poison))
+            print(f"inject_fault: poisoned round {round_no} transmit "
+                  f"with {poison}")
         self._round_ctx = ctx
         return RoundHandle(metrics=metrics, valid=wmask > 0,
                            participating=participating,
@@ -489,13 +547,100 @@ class FedModel:
         reference-shaped list: [loss_arr(, acc_arr, ...), download, upload].
 
         Fetches go through ``profiling.materialize`` so the host-sync
-        monitor counts them (docs/round_engine.md)."""
+        monitor counts them (docs/round_engine.md). The guard verdict (when
+        ``--guards`` attached one via ``seal_round``) is materialized here
+        too — part of the same batched drain — and drives the host-side
+        quarantine ladder (``_note_guard``)."""
         from commefficient_tpu.profiling import materialize
 
         *ms, count = (materialize(m) for m in handle.metrics)
         download = self._materialize_download(handle.participating,
                                               handle.download)
+        if handle.guard is not None:
+            self._note_guard(bool(materialize(handle.guard)))
         return [m[handle.valid] for m in ms] + [download, handle.upload]
+
+    # -- fault tolerance (--guards, docs/fault_tolerance.md) ---------------
+
+    def seal_round(self, handle: RoundHandle) -> RoundHandle:
+        """Attach the just-applied server phase's health verdict to its
+        round handle (called by the engine after ``opt.step()``; the
+        verdict stays a device scalar until the batched drain)."""
+        if self._pending_guard is None:
+            return handle
+        sealed = handle._replace(guard=self._pending_guard)
+        self._pending_guard = None
+        return sealed
+
+    def _note_guard(self, ok: bool) -> None:
+        """Host-side reaction ladder to a drained guard verdict:
+
+        1. isolated trip — the in-step quarantine already discarded the
+           round (state untouched); log and continue;
+        2. a second consecutive trip — the same-round select is evidently
+           not clearing the condition (e.g. the resident state itself went
+           bad before guards were enabled, or a magnitude guard keeps
+           firing): restore the device-resident last-good snapshot;
+        3. ``--max_guard_trips`` consecutive trips — fatal, with a clear
+           message (a permanently tripping guard means data or config is
+           broken; silently skipping every round forever is not training).
+        """
+        if ok:
+            self._consecutive_trips = 0
+            self._rounds_since_snapshot += 1
+            if self._snapshot_every and \
+                    self._rounds_since_snapshot >= self._snapshot_every:
+                self._take_snapshot()
+            return
+        self.guard_trips += 1
+        self._consecutive_trips += 1
+        print(f"HEALTH GUARD tripped (trip {self.guard_trips}, "
+              f"{self._consecutive_trips} consecutive): round quarantined — "
+              "contribution and error-feedback carry discarded")
+        if self._consecutive_trips >= self._max_guard_trips:
+            raise RuntimeError(
+                f"health guard tripped {self._consecutive_trips} consecutive "
+                f"rounds (--max_guard_trips {self._max_guard_trips}): the "
+                "aggregated transmit or updated weights are persistently "
+                "non-finite/over-magnitude. Inspect the data pipeline and "
+                "LR schedule; resume from the last good run-state "
+                "checkpoint with --resume auto.")
+        if self._consecutive_trips >= 2 and self._snapshot is not None:
+            self._restore_snapshot()
+
+    def _take_snapshot(self) -> None:
+        """Refresh the device-resident last-good snapshot (ps weights,
+        server state, model_state). Copies, not references: the round steps
+        donate the resident buffers, so a bare reference would be
+        invalidated by the very next round."""
+        if self._optimizer is None:
+            return
+        self._snapshot = _device_copy(
+            (self.ps_weights, self._optimizer.server_state,
+             self._model_state))
+        self._rounds_since_snapshot = 0
+
+    def _restore_snapshot(self) -> None:
+        """Roll server state back to the last-good snapshot and continue.
+        Hands out a fresh copy (the restored arrays get donated by the next
+        round; the snapshot itself must survive further rollbacks).
+
+        Scope (documented in docs/fault_tolerance.md): per-client state is
+        NOT part of the snapshot — at EMNIST scale those tables are ~35 GB
+        per copy — so after a rollback the participating clients'
+        error-feedback/momentum rows are a few rounds AHEAD of the rewound
+        server state. They are guaranteed finite (the guard gates their
+        scatter) and EF-style accumulators absorb the skew over subsequent
+        rounds; rollback is an escalated-recovery approximation, not a
+        bit-exact rewind — bit-exact recovery is the checkpoint path
+        (--resume auto)."""
+        ps, ss, ms = _device_copy(self._snapshot)
+        self.ps_weights = ps
+        self._optimizer.server_state = ss
+        self._model_state = ms
+        self._prev_ps = ps
+        print("HEALTH GUARD: consecutive trips — rolled server state back "
+              "to the last-good snapshot; training continues")
 
     def _apply_server(self, server_state, lr):
         """Phase 2 for FedOptimizer.step(): server rule + state scatter.
@@ -506,9 +651,10 @@ class FedModel:
         ctx = self._round_ctx
         rng = self._next_rng()
         if self._row_stream is None:
-            new_ps, new_ss, self.client_states = self.steps.server_step(
+            out = self.steps.server_step(
                 self.ps_weights, server_state, self.client_states, ctx,
                 lr, rng)
+            new_ps, new_ss, self.client_states = out[:3]
         else:
             stream = self._stream_round
             proxy = stream.proxy
@@ -518,11 +664,17 @@ class FedModel:
                 errors=ctx.err_rows if proxy.errors is not None else None,
                 weights=(ctx.stale_rows if proxy.weights is not None
                          else None))
-            new_ps, new_ss, new_proxy = self.steps.server_step(
+            out = self.steps.server_step(
                 self.ps_weights, server_state, proxy, ctx, lr, rng)
+            new_ps, new_ss, new_proxy = out[:3]
             self.client_states = self._row_stream.scatter(
                 self.client_states, stream, old, new_proxy)
             self._stream_round = None
+        if self._guards:
+            # the round's health verdict — a device scalar held for
+            # seal_round; fetching it here would be the per-round blocking
+            # sync the engine exists to remove
+            self._pending_guard = out[3]
         self.ps_weights = new_ps
         self._round_ctx = None
         return new_ss
@@ -617,6 +769,9 @@ class FedOptimizer:
         self.args = args
         self.param_groups = param_groups or [(None, 1.0)]
         self._lr_factor = 0.0
+        # backlink for the guard snapshot/rollback path — the server state
+        # lives here, the guard bookkeeping in FedModel (finish_round)
+        fed_model._optimizer = self
         # placed on the round step's output shardings (replicated, or the
         # --server_shard residency) for the same round-1 retrace reason as
         # FedModel's PS state; device_put creates a distinct buffer per

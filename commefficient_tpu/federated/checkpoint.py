@@ -7,17 +7,84 @@ plus a load path for ``--finetune`` (reference cv_train.py:377-384).
 
 Format: a single ``.npz`` whose keys are '/'-joined param paths — readable
 with plain numpy, no framework dependency.
+
+Fault tolerance (docs/fault_tolerance.md): run-state checkpoints carry a
+CRC32 content checksum in ``meta_json`` so a torn/bit-rotted file is
+detected at load instead of silently restoring garbage; ``--resume auto``
+(``find_resume_checkpoint``) picks the newest checkpoint that loads AND
+checksums clean, falling back past corrupt ones; ``save_run_state`` can
+additionally capture MID-EPOCH state (FedSampler position, rounds done,
+partial epoch metrics) so a preempted run resumes at round granularity with
+a bit-identical fp32 trajectory; ``prune_run_states`` implements the
+``--keep_checkpoints N`` retention.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict
+import re
+import zlib
+from typing import Any, Dict, Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+
+def _read_npz(path: str) -> Dict[str, np.ndarray]:
+    """Read every array of an ``.npz``, translating the cryptic
+    ``zipfile``/``np.load`` failures a truncated or bit-rotted file raises
+    into one actionable message (satellite of the fault-tolerance PR)."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        size = -1
+    try:
+        with np.load(path) as data:
+            return {k: data[k] for k in data.files}
+    except FileNotFoundError:
+        # a mistyped --resume path is NOT a corrupt checkpoint — the
+        # 'corrupt' wording would steer the user into discarding a file
+        # that never existed
+        raise
+    except Exception as e:  # zipfile.BadZipFile, ValueError, EOFError, OSError
+        raise RuntimeError(
+            f"checkpoint corrupt or truncated ({path}, {size} bytes): "
+            f"{type(e).__name__}: {e}; try an earlier run_state or "
+            f"--resume auto") from e
+
+
+def _content_checksum(arrays: Dict[str, np.ndarray]) -> int:
+    """CRC32 over every array's name, dtype and raw bytes, in sorted key
+    order — cheap, numpy-only, and stable across the savez round trip.
+    ``meta_json`` itself is excluded (it carries the checksum). The CRC
+    reads each array's buffer in place (no ``tobytes()`` copy — a GPT-2
+    run state is GBs and the save path sits inside the preemption
+    window)."""
+    crc = 0
+    for key in sorted(arrays):
+        if key == "meta_json":
+            continue
+        a = np.ascontiguousarray(arrays[key])
+        crc = zlib.crc32(key.encode(), crc)
+        crc = zlib.crc32(str(a.dtype).encode(), crc)
+        crc = zlib.crc32(a, crc)
+    return crc
+
+
+def _verify_checksum(flat: Dict[str, np.ndarray], meta: dict,
+                     path: str) -> None:
+    want = meta.get("checksum")
+    if want is None:  # pre-checksum checkpoint: nothing to verify against
+        return
+    got = _content_checksum(flat)
+    if got != want:
+        size = os.path.getsize(path) if os.path.exists(path) else -1
+        raise RuntimeError(
+            f"checkpoint corrupt or truncated ({path}, {size} bytes): "
+            f"content checksum mismatch (stored {want:#010x}, computed "
+            f"{got:#010x}); try an earlier run_state or --resume auto")
 
 
 def _flatten(tree, prefix=()):
@@ -51,14 +118,14 @@ def save_checkpoint(path: str, params, model_state=None):
 def load_checkpoint(path: str):
     if not path.endswith(".npz"):
         path = path + ".npz"
-    with np.load(path) as data:
-        flat = {k: data[k] for k in data.files}
+    flat = _read_npz(path)
     tree = _unflatten(flat)
     return tree.get("params", {}), tree.get("model_state", {})
 
 
 def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
-                   next_epoch: int, totals=(0.0, 0.0)) -> str:
+                   next_epoch: int, totals=(0.0, 0.0),
+                   mid_epoch: Optional[dict] = None) -> str:
     """Full mid-training run-state checkpoint for ``--resume`` — a
     capability the reference lacks (its checkpointing is save-only,
     reference cv_train.py:418-421; SURVEY.md §5 'Checkpoint / resume').
@@ -68,8 +135,26 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
     model_state (e.g. BatchNorm stats), the jax rng key, the global numpy
     RNG (drives FedSampler's client sampling), LR-scheduler step count,
     download-accounting state, and byte totals. One ``.npz``, plain numpy.
+
+    ``mid_epoch`` (preemption-safe round-granular resume,
+    docs/fault_tolerance.md) additionally captures the position INSIDE the
+    epoch named by ``next_epoch``::
+
+        {"rounds_done": int,              # rounds of that epoch consumed
+         "sampler": FedSampler.get_state(),
+         "extras": {name: np.ndarray}}    # partial epoch accumulators
+
+    The caller must have drained the round engine first (every dispatched
+    round applied AND its metrics consumed) — the saved sampler/RNG
+    position describes exactly the rounds folded into the saved state.
     """
     fm = fed_model
+    assert getattr(fm, "_round_ctx", None) is None, (
+        "save_run_state called with a round in flight (begin_round without "
+        "opt.step()); drain the engine before saving")
+    assert getattr(fm, "_stream_round", None) is None, (
+        "save_run_state called with a host-offload row stream in flight; "
+        "drain the engine before saving")
     layout = getattr(fm, "layout", None)
 
     def canon(arr):
@@ -122,6 +207,11 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
     else:
         arrays["acct/last_changed"] = canon(fm._last_changed)
         arrays["acct/client_part_round"] = fm._client_part_round
+    # the download accounting marks round k's changed coordinates at round
+    # k+1's dispatch (cur vs _prev_ps); _prev_ps therefore lags ps_weights
+    # by one round at any save point and must be captured, or the restored
+    # run never charges the last pre-save round's changes
+    arrays["acct/prev_ps"] = canon(fm._prev_ps)
     meta = {
         "next_epoch": int(next_epoch),
         "lr_step_count": int(lr_scheduler._step_count),
@@ -135,6 +225,23 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
         # must rewrap with the same one
         "rng_impl": getattr(fm, "_rng_impl", "threefry2x32"),
     }
+    if mid_epoch is not None:
+        sampler = mid_epoch.get("sampler")
+        assert sampler is not None, (
+            "mid-epoch save needs the FedSampler position "
+            "(FedSampler.get_state())")
+        arrays["sampler/permuted"] = np.asarray(sampler["permuted"],
+                                                np.int64)
+        arrays["sampler/cursor"] = np.asarray(sampler["cursor"], np.int64)
+        extras = mid_epoch.get("extras") or {}
+        for name, val in extras.items():
+            arrays["mid/" + name] = np.asarray(val)
+        meta["mid_epoch"] = {"rounds_done": int(mid_epoch["rounds_done"]),
+                             "extras": sorted(extras)}
+    # content checksum (verified on load and by --resume auto discovery):
+    # a torn write that survives the atomic-rename pattern — e.g. a torn
+    # COPY of a checkpoint, or on-disk corruption — fails loudly
+    meta["checksum"] = _content_checksum(arrays)
     arrays["meta_json"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
     if not path.endswith(".npz"):
@@ -158,17 +265,148 @@ def maybe_save_run_state(args, epoch: int, fed_model, optimizer, lr_scheduler,
             fed_model, optimizer, lr_scheduler, next_epoch=epoch + 1,
             totals=totals)
         print(f"run state saved to {path} (epoch {epoch + 1})")
+        prune_run_states(args.checkpoint_path,
+                         getattr(args, "keep_checkpoints", 0))
 
 
-def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
+def save_round_state(args, epoch: int, rounds_done: int, sampler_state,
+                     fed_model, optimizer, lr_scheduler, totals,
+                     extras=None) -> str:
+    """The entrypoints' shared mid-epoch ``--checkpoint_every_rounds`` hook
+    (docs/fault_tolerance.md). ``epoch`` is the 0-based epoch IN PROGRESS;
+    the file is named ``run_state_ep{epoch+1}_r{rounds_done}`` and resume
+    re-enters that epoch at that round."""
+    path = save_run_state(
+        os.path.join(args.checkpoint_path,
+                     f"run_state_ep{epoch + 1}_r{rounds_done}"),
+        fed_model, optimizer, lr_scheduler, next_epoch=epoch,
+        totals=totals,
+        mid_epoch={"rounds_done": rounds_done, "sampler": sampler_state,
+                   "extras": extras or {}})
+    print(f"run state saved to {path} "
+          f"(epoch {epoch + 1}, round {rounds_done})")
+    prune_run_states(args.checkpoint_path,
+                     getattr(args, "keep_checkpoints", 0))
+    return path
+
+
+_RUN_STATE_RE = re.compile(r"run_state_ep(\d+)(?:_r(\d+))?\.npz$")
+
+
+def _run_state_progress(path: str):
+    """Training progress encoded in a run-state filename, as an ordering
+    key: ``run_state_ep{N}`` (N epochs COMPLETED) → ``(N, 0)``;
+    ``run_state_ep{N}_r{R}`` (epoch N in progress, R rounds done) →
+    ``(N-1, R)`` — so a completed epoch outranks any mid-point of that
+    epoch and is outranked by the next epoch's first save. None for names
+    this module did not write."""
+    m = _RUN_STATE_RE.search(os.path.basename(path))
+    if m is None:
+        return None
+    epoch = int(m.group(1))
+    return (epoch, 0) if m.group(2) is None else (epoch - 1, int(m.group(2)))
+
+
+def _run_state_files(checkpoint_path: str):
+    """run_state*.npz candidates, newest first (``.tmp.npz`` write
+    intermediates from a crash mid-save are never candidates). "Newest" is
+    the training PROGRESS from the filename, not mtime: mtimes tie on
+    coarse-granularity filesystems and are rewritten wholesale by a
+    checkpoint dir restored via cp/rsync, and a lexicographic tiebreak
+    would rank r8 above r16. mtime breaks ties only among names this
+    module did not write."""
+    try:
+        names = os.listdir(checkpoint_path)
+    except OSError:
+        return []
+    cands = [os.path.join(checkpoint_path, n) for n in names
+             if n.startswith("run_state") and n.endswith(".npz")
+             and ".tmp." not in n]
+
+    def key(path):
+        progress = _run_state_progress(path)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            # vanished between listdir and sort (a concurrent prune or
+            # cleaner) — rank last; the per-candidate read in
+            # find_resume_checkpoint skips it rather than crashing the
+            # very discovery that exists to survive such races
+            mtime = float("-inf")
+        return ((1,) + progress if progress is not None else (0,),
+                mtime, path)
+
+    return sorted(cands, key=key, reverse=True)
+
+
+def prune_run_states(checkpoint_path: str, keep: int) -> None:
+    """``--keep_checkpoints N`` retention: drop all but the newest N
+    run-state files. ``keep`` <= 0 keeps everything (the default, so
+    existing workflows are unchanged)."""
+    if not keep or keep <= 0:
+        return
+    for path in _run_state_files(checkpoint_path)[keep:]:
+        try:
+            os.remove(path)
+            print(f"pruned old run state {path} (--keep_checkpoints {keep})")
+        except OSError as e:
+            print(f"could not prune {path}: {e}")
+
+
+def find_resume_checkpoint(checkpoint_path: str,
+                           return_contents: bool = False):
+    """``--resume auto`` discovery: the newest run-state checkpoint under
+    ``checkpoint_path`` that reads AND checksums clean. Corrupt or
+    truncated candidates (e.g. a file torn by the very preemption being
+    recovered from) are reported and skipped, falling back to the next
+    newest; returns None when nothing valid exists (callers start fresh).
+
+    Validation requires a full read + CRC pass; ``return_contents=True``
+    returns ``(path, (flat, meta))`` so the caller can hand the validated
+    contents straight to ``load_run_state(preloaded=...)`` instead of
+    re-reading a run state that is GBs at GPT-2 scale."""
+    for path in _run_state_files(checkpoint_path):
+        try:
+            flat = _read_npz(path)
+            meta = json.loads(bytes(flat.pop("meta_json")).decode())
+            _verify_checksum(flat, meta, path)
+            return (path, (flat, meta)) if return_contents else path
+        except Exception as e:  # corrupt candidate — fall back to older
+            print(f"--resume auto: skipping {path}: {e}")
+    return None
+
+
+def load_run_state(path: str, fed_model, optimizer, lr_scheduler,
+                   preloaded=None):
     """Restore a ``save_run_state`` checkpoint in place; returns
-    ``(next_epoch, (total_download, total_upload))``."""
+    ``(next_epoch, (total_download, total_upload), mid)`` where ``mid`` is
+    None for an epoch-boundary checkpoint or, for a mid-epoch one,
+    ``{"rounds_done": int, "sampler": FedSampler state, "extras": {...}}``
+    — the caller re-enters epoch ``next_epoch`` at that round
+    (docs/fault_tolerance.md). Corrupt/truncated files and content-checksum
+    mismatches raise one clear RuntimeError instead of a zipfile/np.load
+    traceback. ``preloaded`` takes the already-read-and-verified
+    ``(flat, meta)`` from ``find_resume_checkpoint(return_contents=True)``
+    so ``--resume auto`` reads each checkpoint once, not twice."""
     fm = fed_model
     if not path.endswith(".npz"):
         path = path + ".npz"
-    with np.load(path) as data:
-        flat = {k: data[k] for k in data.files}
-    meta = json.loads(bytes(flat.pop("meta_json")).decode())
+    if preloaded is not None:
+        flat, meta = preloaded
+        flat = dict(flat)  # the restore pops keys; keep the caller's intact
+    else:
+        flat = _read_npz(path)
+        meta = json.loads(bytes(flat.pop("meta_json")).decode())
+        _verify_checksum(flat, meta, path)
+    mid = None
+    if meta.get("mid_epoch") is not None:
+        mid = {
+            "rounds_done": int(meta["mid_epoch"]["rounds_done"]),
+            "sampler": {"permuted": flat.pop("sampler/permuted"),
+                        "cursor": flat.pop("sampler/cursor")},
+            "extras": {name: flat.pop("mid/" + name)
+                       for name in meta["mid_epoch"]["extras"]},
+        }
 
     # Fail with a clear message on a geometry mismatch (different model,
     # sketch size, or mode) instead of letting it surface later as a
@@ -291,12 +529,63 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler):
         fm._last_changed = resident(flat["acct/last_changed"], tail_fill=-1)
         fm._client_part_round = np.asarray(flat["acct/client_part_round"])
         fm._round_idx = meta["round_idx"]
-    fm._prev_ps = fm.ps_weights
+    if "acct/prev_ps" in flat:
+        fm._prev_ps = resident(flat["acct/prev_ps"])
+    else:  # pre-fault-tolerance checkpoint: accept the one-round undercount
+        fm._prev_ps = fm.ps_weights
 
     lr_scheduler._step_count = meta["lr_step_count"]
     lr_scheduler.optimizer.set_lr_factor(
         lr_scheduler.lr_lambda(meta["lr_step_count"]))
-    return meta["next_epoch"], (meta["total_download"], meta["total_upload"])
+    return (meta["next_epoch"],
+            (meta["total_download"], meta["total_upload"]), mid)
+
+
+def restore_mid_epoch(resume_mid, loader, client_download, client_upload):
+    """The training loops' shared mid-epoch re-entry (ONE copy — both
+    entrypoints' ``run_batches`` call it): arm the sampler at the saved
+    position and fold the partial per-client byte accumulators in place.
+    Returns ``(rounds_done, extras)`` — the caller restores its
+    workload-specific metric lists from ``extras`` (cv: losses+accs,
+    gpt2: losses) and offsets its loop indices by ``rounds_done``.
+    ``(0, {})`` when not resuming mid-epoch."""
+    if resume_mid is None:
+        return 0, {}
+    loader.sampler.set_state(resume_mid["sampler"])
+    extras = resume_mid.get("extras", {})
+    if "download" in extras:
+        client_download += extras["download"]
+    if "upload" in extras:
+        client_upload += extras["upload"]
+    return int(resume_mid["rounds_done"]), extras
+
+
+def resume_run(args, fed_model, optimizer, lr_scheduler):
+    """The entrypoints' shared ``--resume`` hook (ONE copy — cv_train and
+    gpt2_train both call it): resolve the path ('auto' = newest checkpoint
+    that reads and checksums clean, handing the validated contents to the
+    load so the file is read once; corrupt candidates are skipped),
+    restore in place, and report. Returns ``(start_epoch, totals, mid)``;
+    ``(0, (0.0, 0.0), None)`` when not resuming."""
+    path, blob = args.resume or None, None
+    if path == "auto":
+        found = find_resume_checkpoint(args.checkpoint_path,
+                                       return_contents=True)
+        if found is None:
+            print(f"--resume auto: no valid run-state checkpoint under "
+                  f"{args.checkpoint_path}; starting fresh")
+            path = None
+        else:
+            path, blob = found
+    if not path:
+        return 0, (0.0, 0.0), None
+    start_epoch, totals, mid = load_run_state(path, fed_model, optimizer,
+                                              lr_scheduler, preloaded=blob)
+    at = f"epoch {start_epoch + 1}"
+    if mid is not None:
+        at += f", round {mid['rounds_done']}"
+    print(f"resumed run state from {path} (continuing at {at})")
+    return start_epoch, totals, mid
 
 
 def load_matching(template_params, ckpt_params):

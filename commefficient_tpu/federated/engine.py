@@ -91,6 +91,12 @@ class PipelinedRoundEngine:
             self.lr_scheduler.step()
         handle = self.model.begin_round(batch)
         self.opt.step()
+        seal = getattr(self.model, "seal_round", None)
+        if seal is not None:
+            # attach the server phase's on-device health verdict (--guards,
+            # docs/fault_tolerance.md) to the handle it belongs to; still a
+            # device scalar — it drains with the batched metrics
+            handle = seal(handle)
         self._pending.append((self._next_index, handle))
         self._next_index += 1
         self.rounds_submitted += 1

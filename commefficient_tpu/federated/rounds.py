@@ -53,6 +53,7 @@ from commefficient_tpu.compat import shard_map
 from commefficient_tpu.federated.server import (
     ServerConfig,
     ServerState,
+    round_health,
     server_update,
 )
 from commefficient_tpu.federated.worker import (
@@ -187,9 +188,25 @@ class RoundConfig:
     # (ops/collectives.py) with its residual carried in ServerState.qres.
     # Opt-in; requires server_shard.
     reduce_dtype: str = "float32"
+    # On-device health guards (--guards, docs/fault_tolerance.md): the
+    # server phase computes a scalar finiteness/magnitude verdict
+    # (server.round_health) and gates the WHOLE state transition on it —
+    # a tripped round leaves ps_weights, server (velocity, error, qres)
+    # and the client-state scatter untouched (the poisoned contribution is
+    # discarded, NOT absorbed into the error-feedback carry). When on,
+    # server_step/train_step return the verdict as one extra device scalar
+    # (drained with the batched metrics; zero extra host syncs).
+    guards: bool = False
+    # Magnitude ceiling for the guard (0 = finiteness-only).
+    guard_max_abs: float = 0.0
 
 
 class FederatedSteps(NamedTuple):
+    """With ``RoundConfig.guards`` on, ``server_step`` returns one extra
+    trailing element (the device health-verdict scalar of
+    server.round_health) and ``train_step`` likewise — callers that enable
+    guards unpack the extra scalar; the arity is unchanged otherwise."""
+
     train_step: Callable   # fused round
     client_step: Callable  # phase 1: gradients + client state rows
     server_step: Callable  # phase 2: server rule + state scatter
@@ -683,6 +700,22 @@ def build_round_step(
                 rng=rng, layout=layout)
         new_ps = ps_weights - update
 
+        # On-device health guard (--guards, docs/fault_tolerance.md): one
+        # scalar verdict gates the WHOLE state transition. A select against
+        # the pre-round state (never arithmetic like `update * ok` — a NaN
+        # times zero is still NaN) makes a tripped round a no-op: weights,
+        # server (velocity, error, qres) and every client-state scatter
+        # below keep their pre-round values, so the poisoned contribution
+        # is discarded rather than telescoped through error feedback.
+        guard_ok = None
+        if cfg.guards:
+            guard_ok = round_health(ctx.gradient, new_ps,
+                                    cfg.guard_max_abs)
+            new_ps = jnp.where(guard_ok, new_ps, ps_weights)
+            new_server_state = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(guard_ok, new, old),
+                new_server_state, server_state)
+
         ids = ctx.ids
 
         # Server-side masking of client state, fused into the scatter:
@@ -723,7 +756,12 @@ def build_round_step(
                 return None
             final = new_rows if keep is None else new_rows * keep
             w = ctx.wmask.reshape((-1,) + (1,) * (old_rows.ndim - 1))
-            return state_arr.at[ids].add((final - old_rows) * w)
+            delta = (final - old_rows) * w
+            if guard_ok is not None:
+                # quarantined round: every participating row keeps its
+                # pre-round state (select, not multiply — NaN rows)
+                delta = jnp.where(guard_ok, delta, jnp.zeros_like(delta))
+            return state_arr.at[ids].add(delta)
 
         cs = ClientStates(
             velocities=scatter(client_states.velocities, ctx.vel_rows,
@@ -744,10 +782,17 @@ def build_round_step(
                                                              wcfg.k, True))(
                 ctx.stale_rows)
             w = ctx.wmask.reshape(-1, 1)
-            cs = cs._replace(weights=cs.weights.at[ids].add(
-                (used - ctx.stale_rows) * w))
+            stale_delta = (used - ctx.stale_rows) * w
+            if guard_ok is not None:
+                # a quarantined round is discarded end to end — its clients'
+                # stale weights must not advance either
+                stale_delta = jnp.where(guard_ok, stale_delta,
+                                        jnp.zeros_like(stale_delta))
+            cs = cs._replace(weights=cs.weights.at[ids].add(stale_delta))
         if flat_caller:
             new_ps = layout.unchunk(new_ps)
+        if cfg.guards:
+            return new_ps, new_server_state, cs, guard_ok
         return new_ps, new_server_state, cs
 
     # ---- fused round (bench / dry-run path) ----------------------------
@@ -760,11 +805,14 @@ def build_round_step(
         ctx, new_model_state, metrics = client_step(ps_weights, client_states,
                                                     model_state, batch, lr,
                                                     rng)
-        new_ps, new_server_state, cs = server_step(ps_weights, server_state,
-                                                   client_states, ctx, lr,
-                                                   sub)
+        out = server_step(ps_weights, server_state, client_states, ctx, lr,
+                          sub)
+        new_ps, new_server_state, cs = out[:3]
         if flat_caller:
             new_ps = layout.unchunk(new_ps)
+        if cfg.guards:
+            return (new_ps, new_server_state, cs, new_model_state, metrics,
+                    out[3])
         return new_ps, new_server_state, cs, new_model_state, metrics
 
     def val_step(ps_weights, model_state, batch):

@@ -178,6 +178,25 @@ def place_server_state(state: ServerState, mesh, mode: str,
         qres=None if state.qres is None else put(state.qres, sh0))
 
 
+def round_health(transmit, new_ps, max_abs: float = 0.0):
+    """Scalar health verdict of one round's server transition
+    (docs/fault_tolerance.md): True iff the aggregated transmit AND the
+    candidate updated PS weights are all finite (and, when ``max_abs`` > 0,
+    every updated weight is within the magnitude ceiling).
+
+    Both reductions ride the jitted round step — a few scalar ``isfinite``
+    sweeps over planes the epilogue already reads — and the verdict stays on
+    device in the round handle, so the engine's zero-blocking-fetch
+    invariant holds with guards on (pinned in tests/test_engine.py). With
+    error feedback a single non-finite contribution telescopes into
+    (velocity, error) forever, which is why the check gates the WHOLE state
+    transition (rounds.server_step), not just the weight write."""
+    ok = jnp.all(jnp.isfinite(transmit)) & jnp.all(jnp.isfinite(new_ps))
+    if max_abs > 0:
+        ok = ok & (jnp.max(jnp.abs(new_ps)) <= max_abs)
+    return ok
+
+
 def server_update(
     gradient: jax.Array,
     state: ServerState,

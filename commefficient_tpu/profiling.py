@@ -31,12 +31,37 @@ from __future__ import annotations
 
 import contextlib
 import os
+import sys
 import threading
 
 import jax
 
 __all__ = ["StepProfiler", "annotate", "SyncCounter", "host_sync_monitor",
-           "materialize"]
+           "materialize", "Heartbeat"]
+
+
+class Heartbeat:
+    """Per-round liveness lines for an external supervisor
+    (scripts/crash_matrix.py, docs/fault_tolerance.md).
+
+    When armed (``COMMEFFICIENT_HEARTBEAT=1``, or ``enabled=True``), every
+    drained round emits one ``HEARTBEAT round=N`` line to stderr,
+    flushed immediately — so a supervisor that SIGKILLs the process at a
+    randomized round still holds an exact trail of how far training got.
+    Disabled (the default) it is a no-op on the hot path."""
+
+    def __init__(self, enabled: bool | None = None):
+        if enabled is None:
+            enabled = os.environ.get("COMMEFFICIENT_HEARTBEAT") == "1"
+        self.enabled = bool(enabled)
+
+    def round(self, index: int, epoch: int | None = None) -> None:
+        if not self.enabled:
+            return
+        line = f"HEARTBEAT round={index}"
+        if epoch is not None:
+            line += f" epoch={epoch}"
+        print(line, file=sys.stderr, flush=True)
 
 
 def annotate(name: str):

@@ -38,12 +38,14 @@ from commefficient_tpu.federated import (
 from commefficient_tpu.federated.checkpoint import (
     load_checkpoint,
     load_matching,
-    load_run_state,
     maybe_save_run_state,
+    restore_mid_epoch,
+    resume_run,
     save_checkpoint,
+    save_round_state,
 )
 from commefficient_tpu.federated.losses import make_cv_losses
-from commefficient_tpu.profiling import StepProfiler
+from commefficient_tpu.profiling import Heartbeat, StepProfiler
 from commefficient_tpu.ops.flat import ravel_pytree
 from commefficient_tpu.utils import (
     PiecewiseLinear,
@@ -96,7 +98,7 @@ def get_data_loaders(args):
 
 
 def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
-                args):
+                args, epoch=0, resume_mid=None, totals=(0.0, 0.0)):
     if not training and epoch_fraction != 1:
         raise ValueError("Must do full epochs for val")
     model.train(training)
@@ -108,6 +110,15 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
         client_download = np.zeros(num_clients)
         client_upload = np.zeros(num_clients)
         spe = loader.steps_per_epoch()
+        # Preemption-safe round-granular resume (docs/fault_tolerance.md):
+        # re-enter a half-finished epoch at the saved round — the sampler
+        # replays its saved position (the global np RNG was restored by
+        # load_run_state) and the partial epoch accumulators reload, so the
+        # remaining rounds reproduce the uninterrupted run bit-for-bit.
+        i0, ex = restore_mid_epoch(resume_mid, loader, client_download,
+                                   client_upload)
+        losses.extend(np.asarray(ex.get("losses", [])).tolist())
+        accs.extend(np.asarray(ex.get("accs", [])).tolist())
         # Pipelined round engine (federated/engine.py): each loop iteration
         # dispatches a round without blocking on its results; metrics are
         # fetched in batches of --metrics_drain_every. The NaN abort
@@ -119,6 +130,8 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
             window=getattr(args, "round_window", 2),
             drain_every=getattr(args, "metrics_drain_every", 8))
         nan_loss = False
+        heartbeat = Heartbeat()
+        save_every = int(getattr(args, "checkpoint_every_rounds", 0) or 0)
 
         def consume(results):
             nonlocal nan_loss, client_download, client_upload
@@ -133,15 +146,30 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
                 client_upload += upload
                 losses.extend(loss.tolist())
                 accs.extend(acc.tolist())
+                heartbeat.round(i0 + res.index + 1, epoch=epoch)
 
         try:
             for i, batch in enumerate(loader):
-                if i > spe * epoch_fraction:
+                if i0 + i > spe * epoch_fraction:
                     break
                 prof.step(i)
                 consume(engine.submit(batch))
                 if nan_loss:
                     return np.nan, np.nan, np.nan, np.nan
+                if save_every and (i0 + i + 1) % save_every == 0:
+                    # drain the in-flight window first: the saved sampler /
+                    # RNG position must describe exactly the rounds whose
+                    # state AND metrics are folded into the checkpoint
+                    consume(engine.drain())
+                    if nan_loss:
+                        return np.nan, np.nan, np.nan, np.nan
+                    save_round_state(
+                        args, epoch, i0 + i + 1, loader.sampler.get_state(),
+                        model, opt, lr_scheduler, totals,
+                        extras={"download": client_download,
+                                "upload": client_upload,
+                                "losses": np.asarray(losses, np.float64),
+                                "accs": np.asarray(accs, np.float64)})
                 if args.do_test:
                     break
             consume(engine.drain())
@@ -161,7 +189,8 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
 
 
 def train(model, opt, lr_scheduler, train_loader, test_loader, args, writer,
-          loggers=(), timer=None, start_epoch=0, totals=(0.0, 0.0)):
+          loggers=(), timer=None, start_epoch=0, totals=(0.0, 0.0),
+          resume_mid=None):
     timer = timer or Timer()
     total_download, total_upload = totals
     if args.eval_before_start and start_epoch == 0:
@@ -177,7 +206,9 @@ def train(model, opt, lr_scheduler, train_loader, test_loader, args, writer,
             epoch_fraction = 1
         train_loss, train_acc, download, upload = run_batches(
             model, opt, lr_scheduler, train_loader, True, epoch_fraction,
-            args)
+            args, epoch=epoch,
+            resume_mid=(resume_mid if epoch == start_epoch else None),
+            totals=(total_download, total_upload))
         if np.isnan(train_loss):
             print("TERMINATING TRAINING DUE TO NAN LOSS")
             return
@@ -363,17 +394,14 @@ def main(argv=None):
             writer = SummaryWriter(log_dir=log_dir)
         except ImportError:
             print("tensorboard unavailable; console logging only")
-    start_epoch, totals = 0, (0.0, 0.0)
-    if args.resume:
-        start_epoch, totals = load_run_state(args.resume, fed_model, opt,
-                                             lr_scheduler)
-        print(f"resumed run state from {args.resume} "
-              f"(continuing at epoch {start_epoch + 1})")
+    start_epoch, totals, resume_mid = resume_run(args, fed_model, opt,
+                                                 lr_scheduler)
     print(f"Finished initializing in {timer():.2f} seconds")
 
     summary = train(fed_model, opt, lr_scheduler, train_loader, test_loader,
                     args, writer, loggers=(TableLogger(),), timer=timer,
-                    start_epoch=start_epoch, totals=totals)
+                    start_epoch=start_epoch, totals=totals,
+                    resume_mid=resume_mid)
     fed_model.finalize()
     if args.do_checkpoint:
         os.makedirs(args.checkpoint_path, exist_ok=True)

@@ -37,9 +37,12 @@ from commefficient_tpu.federated import (
 from commefficient_tpu.federated.checkpoint import (
     load_checkpoint,
     load_matching,
-    load_run_state,
     maybe_save_run_state,
+    restore_mid_epoch,
+    resume_run,
+    save_round_state,
 )
+from commefficient_tpu.profiling import Heartbeat
 from commefficient_tpu.federated.losses import make_gpt2_losses
 from commefficient_tpu.models.gpt2 import (
     GPT2DoubleHeads,
@@ -92,7 +95,8 @@ def _wrap(collate):
 
 
 def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
-                epoch=None, epoch_fraction=1, logger=None, writer=None):
+                epoch=None, epoch_fraction=1, logger=None, writer=None,
+                resume_mid=None, totals=(0.0, 0.0)):
     model.train(training)
     if training:
         prof = StepProfiler(args.profile_dir, num_steps=args.profile_steps,
@@ -102,6 +106,14 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
         client_download = np.zeros(num_clients)
         client_upload = np.zeros(num_clients)
         losses = []
+        # round-granular resume (docs/fault_tolerance.md): same contract as
+        # cv_train.run_batches — sampler position replayed, partial epoch
+        # accumulators reloaded, loop indices offset by the rounds done
+        i0, ex = restore_mid_epoch(resume_mid, loader, client_download,
+                                   client_upload)
+        losses.extend(np.asarray(ex.get("losses", [])).tolist())
+        heartbeat = Heartbeat()
+        save_every = int(getattr(args, "checkpoint_every_rounds", 0) or 0)
         # Pipelined round engine (federated/engine.py): rounds are
         # dispatched sync-free and metrics arrive in batches of
         # --metrics_drain_every, so logger rows are appended at drain time.
@@ -126,6 +138,7 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
                 client_upload += upload
                 loss = float(np.mean(loss))
                 losses.append(loss)
+                heartbeat.round(i0 + res.index + 1, epoch=epoch)
                 row_batch_idx, row_lr = meta_by_round.pop(res.index)
                 batch_stats = {
                     "train_time": interval / len(results),
@@ -143,15 +156,26 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
             for batch_idx, batch in enumerate(loader):
                 if batch_idx > 2 and args.do_test and batch_idx < spe - 10:
                     continue
-                if batch_idx > spe * epoch_fraction:
+                if i0 + batch_idx > spe * epoch_fraction:
                     break
                 prof.step(batch_idx)
                 done = engine.submit(batch)
                 # the scheduler stepped inside submit(); record this round's
                 # batch index and LR so its drained row logs what it ran with
                 meta_by_round[engine.rounds_submitted - 1] = (
-                    batch_idx + 1, lr_scheduler.get_last_lr()[0])
+                    i0 + batch_idx + 1, lr_scheduler.get_last_lr()[0])
                 consume(done)
+                if save_every and (i0 + batch_idx + 1) % save_every == 0:
+                    # drain the in-flight window so the saved sampler/RNG
+                    # position matches the rounds folded into the state
+                    consume(engine.drain())
+                    save_round_state(
+                        args, epoch or 0, i0 + batch_idx + 1,
+                        loader.sampler.get_state(), model, opt,
+                        lr_scheduler, totals,
+                        extras={"download": client_download,
+                                "upload": client_upload,
+                                "losses": np.asarray(losses, np.float64)})
             consume(engine.drain())
         finally:
             prof.close()
@@ -180,7 +204,7 @@ def test_gpt2(model, val_loader, args, logger=None, timer=None, writer=None):
 
 def train_gpt2(model, opt, scheduler, train_loader, val_loader, args,
                log_dir, writer=None, logger=None, timer=None, start_epoch=0,
-               totals=(0.0, 0.0)):
+               totals=(0.0, 0.0), resume_mid=None):
     timer = timer or Timer()
     total_download, total_upload = totals
     for epoch in range(start_epoch, math.ceil(args.num_epochs)):
@@ -191,7 +215,9 @@ def train_gpt2(model, opt, scheduler, train_loader, val_loader, args,
         _, download, upload = run_batches(
             model, opt, scheduler, train_loader, args, timer, training=True,
             epoch=epoch, epoch_fraction=epoch_fraction, logger=logger,
-            writer=writer)
+            writer=writer,
+            resume_mid=(resume_mid if epoch == start_epoch else None),
+            totals=(total_download, total_upload))
         if epoch == 0:
             # download tracking valid in epoch 1 only (reference
             # gpt2_train.py:132-145)
@@ -381,16 +407,12 @@ def train(argv=None):
         stats = test_gpt2(fed_model, val_loader, args, logger=TableLogger(),
                           timer=timer)
     else:
-        start_epoch, totals = 0, (0.0, 0.0)
-        if args.resume:
-            start_epoch, totals = load_run_state(args.resume, fed_model, opt,
-                                                 scheduler)
-            print(f"resumed run state from {args.resume} "
-                  f"(continuing at epoch {start_epoch + 1})")
+        start_epoch, totals, resume_mid = resume_run(args, fed_model, opt,
+                                                     scheduler)
         stats = train_gpt2(fed_model, opt, scheduler, train_loader,
                            val_loader, args, log_dir, logger=TableLogger(),
                            timer=timer, start_epoch=start_epoch,
-                           totals=totals)
+                           totals=totals, resume_mid=resume_mid)
     fed_model.finalize()
     return stats
 

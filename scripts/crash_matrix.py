@@ -1,0 +1,228 @@
+"""Crash matrix: SIGKILL cv_train at randomized rounds, resume, compare.
+
+The preemption drill of docs/fault_tolerance.md, runnable standalone or
+through tests/test_fault_tolerance.py::TestCrashMatrix:
+
+1. run cv_train as a subprocess on the synthetic CIFAR split with
+   ``--checkpoint_every_rounds`` and ``COMMEFFICIENT_HEARTBEAT=1``
+   (profiling.Heartbeat prints one flushed stderr line per drained round);
+2. SIGKILL it the moment a randomized heartbeat round is reached — the
+   hardest preemption there is: no cleanup, no atexit, possibly mid-save
+   (the atomic tmp-rename in save_run_state is what keeps that survivable);
+3. rerun the identical command with ``--resume auto`` — discovery picks the
+   newest run-state checkpoint that reads and checksums clean — to
+   completion;
+4. assert the resumed run's final weights are BIT-IDENTICAL to an
+   uninterrupted baseline run's (numpy array_equal on every tensor of the
+   saved model checkpoint).
+
+The sketched fp32 trajectory is bit-identical between the replicated and
+``--server_shard`` planes (tests/test_sharded_server.py), so one baseline
+serves both planes' kill/resume legs.
+
+Usage:
+    python scripts/crash_matrix.py [--trials N] [--seed S] [--workdir DIR]
+                                   [--planes replicated,shard]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # standalone invocation from anywhere
+    sys.path.insert(0, _REPO)
+
+# tiny synthetic split: 8 per class x 10 classes = 80 items, W=2 x B=4
+# -> 10 rounds/epoch x 2 epochs; --checkpoint_every_rounds 3 means a kill
+# anywhere loses at most 3 rounds of work
+PER_CLASS = 8
+ROUNDS_PER_EPOCH = 10
+EPOCHS = 2
+
+
+def child_env() -> dict:
+    env = dict(os.environ)
+    # The persistent XLA compile cache (tests/conftest.py exports
+    # JAX_COMPILATION_CACHE_DIR into pytest's environment) is OFF for the
+    # children — a hard requirement, root-caused during this harness's
+    # development: these children are SIGKILLed BY DESIGN, a kill landing
+    # mid-cache-write tears the entry on disk, and jax 0.4.37's cache read
+    # path deserializes torn entries without validation — after which
+    # EVERY later process compiling the same geometry aborts or segfaults
+    # mid-round (reproduced: torn entries from pre-gate kill experiments
+    # made the suite's resume tests crash 4/4 until the cache dir was
+    # deleted). Children therefore neither write (tearable) nor read
+    # (possibly-torn) the shared cache; they pay the ~15 s tiny-geometry
+    # compile instead.
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env.update({
+        "COMMEFFICIENT_TINY_MODEL": "1",
+        "COMMEFFICIENT_SYNTHETIC_PER_CLASS": str(PER_CLASS),
+        "COMMEFFICIENT_HEARTBEAT": "1",
+        "HF_HUB_OFFLINE": "1",
+        "TRANSFORMERS_OFFLINE": "1",
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": _REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    return env
+
+
+def train_argv(dataset_dir: str, ckpt_dir: str, shard: bool) -> list:
+    argv = [
+        sys.executable, os.path.join(_REPO, "cv_train.py"),
+        "--dataset_name", "CIFAR10", "--dataset_dir", dataset_dir,
+        "--num_epochs", str(EPOCHS), "--num_workers", "2",
+        "--local_batch_size", "4", "--valid_batch_size", "8",
+        "--iid", "--num_clients", "4",
+        "--mode", "sketch", "--error_type", "virtual",
+        "--local_momentum", "0", "--virtual_momentum", "0.9",
+        "--k", "200", "--num_cols", "1024", "--num_rows", "3",
+        "--num_blocks", "2",
+        "--lr_scale", "0.01", "--pivot_epoch", "0.5", "--seed", "0",
+        "--train_dataloader_workers", "0",
+        # drain_every 1 so each heartbeat lands the moment its round is
+        # consumed — the kill point is then a true round boundary draw
+        "--metrics_drain_every", "1",
+        "--checkpoint", "--checkpoint_path", ckpt_dir,
+        "--checkpoint_every_rounds", "3",
+    ]
+    if shard:
+        argv += ["--server_shard", "--num_devices", "2"]
+    return argv
+
+
+def run_to_completion(argv, timeout=900) -> None:
+    proc = subprocess.run(argv, env=child_env(), cwd=_REPO,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(f"child failed rc={proc.returncode}:\n"
+                           + proc.stdout[-3000:])
+
+
+def run_and_kill(argv, kill_after_round: int, timeout=900) -> int:
+    """Start the training child and SIGKILL it the moment its
+    ``kill_after_round``-th heartbeat line lands (heartbeat round indices
+    restart per epoch, so the supervisor counts LINES — one per drained
+    training round across the whole run). Returns the count at the kill;
+    the child may race a round further before the signal lands — that is
+    the point, preemption is not polite."""
+    proc = subprocess.Popen(argv, env=child_env(), cwd=_REPO,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    seen = 0
+    killed = False
+    deadline = time.monotonic() + timeout
+    try:
+        for line in proc.stderr:
+            if time.monotonic() > deadline:
+                break
+            if line.startswith("HEARTBEAT round="):
+                seen += 1
+                if seen >= kill_after_round:
+                    proc.send_signal(signal.SIGKILL)
+                    killed = True
+                    break
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+    if not killed:
+        raise RuntimeError(
+            f"child finished after {seen} rounds, before the kill round "
+            f"{kill_after_round} was reached — shrink the kill window")
+    return seen
+
+
+def final_weights(ckpt_dir: str):
+    from commefficient_tpu.federated.checkpoint import load_checkpoint
+
+    params, model_state = load_checkpoint(os.path.join(ckpt_dir, "ResNet9"))
+    flat = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, prefix + (str(k),))
+        else:
+            flat["/".join(prefix)] = np.asarray(node)
+
+    walk(params, ("params",))
+    walk(model_state, ("model_state",))
+    return flat
+
+
+def assert_identical(a: dict, b: dict, what: str) -> None:
+    assert set(a) == set(b), (
+        f"{what}: tensor sets differ: {set(a) ^ set(b)}")
+    for key in sorted(a):
+        np.testing.assert_array_equal(
+            a[key], b[key], err_msg=f"{what}: {key} diverged")
+
+
+def run_matrix(workdir: str, trials: int = 1, seed: int = 0,
+               planes=("replicated", "shard")) -> None:
+    rng = random.Random(seed)
+    data = os.path.join(workdir, "data")
+    base_ckpt = os.path.join(workdir, "baseline")
+
+    print(f"[crash_matrix] baseline run ({EPOCHS} epochs x "
+          f"{ROUNDS_PER_EPOCH} rounds)")
+    run_to_completion(train_argv(data, base_ckpt, shard=False))
+    want = final_weights(base_ckpt)
+
+    total_rounds = EPOCHS * ROUNDS_PER_EPOCH
+    for plane in planes:
+        shard = plane == "shard"
+        for trial in range(trials):
+            # randomized mid-epoch kill point, away from the very last
+            # rounds so the resume leg has real work left to replay
+            kill_round = rng.randint(2, total_rounds - 3)
+            ckpt = os.path.join(workdir, f"{plane}_t{trial}")
+            argv = train_argv(data, ckpt, shard=shard)
+            print(f"[crash_matrix] {plane} trial {trial}: SIGKILL at "
+                  f"round {kill_round}")
+            killed_at = run_and_kill(argv, kill_round)
+            print(f"[crash_matrix] killed at round {killed_at}; resuming "
+                  f"with --resume auto")
+            run_to_completion(argv + ["--resume", "auto"])
+            assert_identical(want, final_weights(ckpt),
+                             f"{plane} trial {trial} (killed at round "
+                             f"{killed_at})")
+            print(f"[crash_matrix] {plane} trial {trial}: fp32 trajectory "
+                  f"bit-identical to the uninterrupted run")
+    print("[crash_matrix] PASS")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--trials", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--planes", default="replicated,shard")
+    args = ap.parse_args(argv)
+    planes = tuple(p for p in args.planes.split(",") if p)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="crash_matrix_")
+    print(f"[crash_matrix] workdir {workdir}")
+    run_matrix(workdir, trials=args.trials, seed=args.seed, planes=planes)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

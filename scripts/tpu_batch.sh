@@ -61,7 +61,7 @@ touch "$STATE"
 is_done() { grep -qx "$1" "$STATE" 2>/dev/null; }
 mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 
-STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused fused_epilogue \
+STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards fused_epilogue \
 learning profile profile_fused profile_gpt2 host_offload imagenet ops"}
 i=0
 for step in $STEPS; do
@@ -90,7 +90,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact
       log "step $i: bench.py --capture $step (timeout 40m)"

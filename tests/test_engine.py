@@ -186,6 +186,29 @@ def _engine(window=2, drain_every=8, **over):
 
 
 class TestSyncAudit:
+    def test_zero_syncs_between_drains_with_guards(self):
+        """The on-device health guard (--guards, docs/fault_tolerance.md)
+        must preserve the engine's zero-blocking-fetch invariant: the
+        verdict is a device scalar riding the round handle, materialized
+        only by the batched drain. 5 steady-state guarded rounds perform
+        ZERO blocking device→host transfers."""
+        fm, engine = _engine(drain_every=10, guards=True, snapshot_every=4,
+                             max_guard_trips=3, guard_max_abs=0.0)
+        engine.submit(_host_batch([0, 1], seed=0))  # compile round
+        with host_sync_monitor() as counter:
+            for rnd in range(1, 6):
+                done = engine.submit(_host_batch([rnd % 4, (rnd + 1) % 4],
+                                                 seed=rnd))
+                assert done == [], "must not drain before drain_every"
+                assert counter.count == 0, \
+                    f"round {rnd}: {counter.count} blocking host syncs " \
+                    "with guards enabled"
+            results = engine.drain()
+            assert len(results) == 6
+            assert counter.count > 0, \
+                "drain must go through the counted materialize seam"
+        assert fm.guard_trips == 0, "healthy rounds must not trip the guard"
+
     def test_zero_syncs_between_drains(self):
         """5 steady-state rounds through the engine perform ZERO blocking
         device→host transfers; the every-N drain is the one batched fetch

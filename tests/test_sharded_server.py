@@ -430,7 +430,7 @@ class TestShardedCheckpoint:
         fm2, opt2, sched2 = self._fed_model(mode=mode, error_type=et,
                                             virtual_momentum=vm,
                                             reduce_dtype=rdtype)
-        next_epoch, _ = load_run_state(path, fm2, opt2, sched2)
+        next_epoch, _, _ = load_run_state(path, fm2, opt2, sched2)
         assert next_epoch == 1
         for name in ("velocity", "error", "qres"):
             a = getattr(opt.server_state, name)
