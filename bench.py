@@ -148,7 +148,7 @@ _T0 = time.monotonic()
 def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
           mode: str = "sketch", num_workers: int = NUM_WORKERS,
           server_shard: bool = False, fused_epilogue: bool = False,
-          guards: bool = False):
+          guards: bool = False, stream_sketch: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -198,7 +198,8 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
     sketch = make_sketch(d, c=c, r=r, seed=42, num_blocks=blocks) \
         if mode == "sketch" else None
     cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
-                      server_shard=server_shard, guards=guards)
+                      server_shard=server_shard, guards=guards,
+                      stream_sketch=stream_sketch)
     loss_train, loss_val = make_cv_losses(model)
     # the entrypoints' real execution path: shard_map+psum over a clients
     # mesh — a 1-device mesh on the single bench chip
@@ -246,13 +247,15 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
     return steps, flat, server_state, client_states, batch
 
 
-def build_gpt2(bf16: bool = False, fused_epilogue: bool = False):
+def build_gpt2(bf16: bool = False, fused_epilogue: bool = False,
+               stream_sketch: bool = False):
     """GPT-2 PersonaChat sketched federated round (BASELINE.md config 5):
     full 124M double-heads geometry, 4 clients/round, 2 candidates x 256
     tokens per example, sketch 5x500k/k=50k (reference gpt2_train.py:255-313
     run shape). ``bf16`` switches the fwd/bwd compute to bf16 (--bf16);
     ``fused_epilogue`` turns on the one-sweep server epilogue
-    (docs/fused_epilogue.md) for the profiling A/B."""
+    (docs/fused_epilogue.md) and ``stream_sketch`` the streaming client
+    phase (docs/stream_sketch.md) for their profiling A/Bs."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -294,7 +297,8 @@ def build_gpt2(bf16: bool = False, fused_epilogue: bool = False):
                         grad_size=d, virtual_momentum=0.9,
                         fused_epilogue=fused_epilogue)
     sketch = make_sketch(d, c=c, r=r, seed=42, num_blocks=blocks)
-    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d)
+    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
+                      stream_sketch=stream_sketch)
     loss_train, loss_val = make_gpt2_losses(
         model, compute_dtype=jnp.bfloat16 if bf16 else None)
     mesh = default_client_mesh(W)
@@ -532,7 +536,7 @@ def run_measurement(tiny: bool) -> None:
 
 # one measure-and-emit path for every CIFAR-family config leg:
 # name -> (mode, workers, baseline r/s, num_classes, non_iid, K,
-#          server_shard, fused_epilogue, guards, label).
+#          server_shard, fused_epilogue, guards, stream_sketch, label).
 # K multi-rounds per dispatch via lax.scan: the cheap c1/c2 rounds are
 # smaller than the ~40 ms tunnel rtt, so 20 single-round dispatches would
 # measure transport noise (and raising the dispatch count instead wedges
@@ -540,11 +544,12 @@ def run_measurement(tiny: bool) -> None:
 # dispatch keep the queue shallow while the timed region grows K x.
 _CFG_LEGS = {
     "c1": ("uncompressed", 1, "BASELINE_C1", 10, False, 20, False, False,
-           False, "1-worker uncompressed rounds/sec/chip (ResNet9)"),
+           False, False, "1-worker uncompressed rounds/sec/chip (ResNet9)"),
     "c2": ("true_topk", 8, "BASELINE_C2", 10, False, 10, False, False,
-           False, "8-worker true-topk rounds/sec/chip (ResNet9, k=50k)"),
+           False, False,
+           "8-worker true-topk rounds/sec/chip (ResNet9, k=50k)"),
     "cifar100": ("sketch", 8, "BASELINE_CIFAR100", 100, True, 1, False,
-                 False, False,
+                 False, False, False,
                  "CIFAR100/FEMNIST-style non-IID sketched rounds/sec/chip "
                  "(ResNet9-100, 500 clients, 8 workers, sketch 5x500k "
                  "k=50k)"),
@@ -555,6 +560,7 @@ _CFG_LEGS = {
     # on the 1-chip bench this leg pins NO-regression with the plane on;
     # on a multi-chip mesh it measures the win.
     "shard": ("sketch", 8, "BASELINE", 10, False, 1, True, False, False,
+              False,
               "8-worker sketched rounds/sec/chip with --server_shard "
               "(ResNet9, sketch 5x500k k=50k, sharded server data plane)"),
     # the headline sketch leg with the fused server epilogue
@@ -563,6 +569,7 @@ _CFG_LEGS = {
     # legs (mfu_attack_r5.md projects ~2.3 ms/round ≈ 32% MFU if the
     # fusion fully lands).
     "fused": ("sketch", 8, "BASELINE", 10, False, 1, False, True, False,
+              False,
               "8-worker sketched rounds/sec/chip with --fused_epilogue "
               "(ResNet9, sketch 5x500k k=50k, one-sweep server epilogue)"),
     # the headline sketch leg with on-device health guards (--guards,
@@ -572,8 +579,19 @@ _CFG_LEGS = {
     # a handful of d-plane selects riding the existing epilogue sweeps —
     # expected low single-digit %).
     "guards": ("sketch", 8, "BASELINE", 10, False, 1, False, False, True,
+               False,
                "8-worker sketched rounds/sec/chip with --guards (ResNet9, "
                "sketch 5x500k k=50k, on-device health guards)"),
+    # the headline sketch leg with the streaming client-phase sketch
+    # (--stream_sketch, docs/stream_sketch.md); same config-3 baseline
+    # anchor so the stream-vs-composed delta reads straight off the two
+    # legs. NOTE the leg includes the wd segment-sketch (bench wd=5e-4),
+    # so it measures the honest production shape, not the wd=0 best case.
+    "stream": ("sketch", 8, "BASELINE", 10, False, 1, False, False, False,
+               True,
+               "8-worker sketched rounds/sec/chip with --stream_sketch "
+               "(ResNet9, sketch 5x500k k=50k, streaming client-phase "
+               "sketch)"),
 }
 
 
@@ -588,7 +606,7 @@ def run_config_measurement(name: str) -> None:
 
     _check_pallas_kernel()
     (mode, W, base_name, num_classes, non_iid, K, server_shard,
-     fused_epilogue, guards, label) = _CFG_LEGS[name]
+     fused_epilogue, guards, stream_sketch, label) = _CFG_LEGS[name]
     base = {"BASELINE": BASELINE_ROUNDS_PER_SEC,
             "BASELINE_C1": BASELINE_C1_ROUNDS_PER_SEC,
             "BASELINE_C2": BASELINE_C2_ROUNDS_PER_SEC,
@@ -596,7 +614,8 @@ def run_config_measurement(name: str) -> None:
     steps, ps, server_state, client_states, batch = build(
         tiny=False, num_classes=num_classes, non_iid=non_iid, mode=mode,
         num_workers=W, server_shard=server_shard,
-        fused_epilogue=fused_epilogue, guards=guards)
+        fused_epilogue=fused_epilogue, guards=guards,
+        stream_sketch=stream_sketch)
     if K > 1:
         inner = steps.train_step
 
@@ -713,6 +732,8 @@ _EXTRA_LEGS = {
               "fused_rounds_per_sec"),
     "guards": (["--run-cfg", "guards"], "BENCH_C12_TIMEOUT", 900,
                "guards_rounds_per_sec"),
+    "stream": (["--run-cfg", "stream"], "BENCH_C12_TIMEOUT", 900,
+               "stream_rounds_per_sec"),
 }
 
 
@@ -994,11 +1015,11 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--run-cfg":
         sel = sys.argv[2] if len(sys.argv) >= 3 else "<missing>"
-        if sel not in ("c1", "c2", "shard", "fused", "guards"):
+        if sel not in ("c1", "c2", "shard", "fused", "guards", "stream"):
             # a missing/typo'd operand must never fall through to the full
             # parent orchestration and claim the chip for a headline bench
             sys.exit(f"--run-cfg: unknown config {sel!r}; use "
-                     f"c1|c2|shard|fused|guards")
+                     f"c1|c2|shard|fused|guards|stream")
         run_config_measurement(sel)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--capture":

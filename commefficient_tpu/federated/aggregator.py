@@ -264,11 +264,18 @@ class FedModel:
         self._guards = bool(getattr(args, "guards", False))
         self._guard_max_abs = float(getattr(args, "guard_max_abs", 0.0)
                                     or 0.0)
+        # Streaming client-phase sketch (--stream_sketch,
+        # docs/stream_sketch.md): the fused client phase sketches each
+        # gradient leaf at its flat offset instead of materializing the
+        # d-vector; rounds.build_round_step composes silently when the
+        # config is outside the legal window (the fused-epilogue pattern).
+        self._stream_sketch = bool(getattr(args, "stream_sketch", False))
         cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=self.grad_size,
                           do_test=args.do_test, tp_sliced=tp_sliced,
                           ep_sliced=ep_sliced,
                           server_shard=self._server_shard,
                           reduce_dtype=self._reduce_dtype,
+                          stream_sketch=self._stream_sketch,
                           guards=self._guards,
                           guard_max_abs=self._guard_max_abs)
         from commefficient_tpu.federated.losses import make_cv_losses  # noqa: F401

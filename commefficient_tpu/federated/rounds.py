@@ -43,8 +43,6 @@ from dataclasses import dataclass, replace as dc_replace
 from functools import partial
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
@@ -65,11 +63,14 @@ from commefficient_tpu.federated.worker import (
     microbatch_plan,
     next_rng,
     probe_n_metrics,
+    sketch_grad_tree,
     split_microbatches,
 )
+from commefficient_tpu.ops.flat import chunked_unravel, leaf_segments
 from commefficient_tpu.ops.sketch import (
     CountSketch,
     sketch_chunks,
+    sketch_chunks_accum,
     sketch_vec,
 )
 
@@ -188,6 +189,22 @@ class RoundConfig:
     # (ops/collectives.py) with its residual carried in ServerState.qres.
     # Opt-in; requires server_shard.
     reduce_dtype: str = "float32"
+    # Streaming client-phase sketch (--stream_sketch,
+    # docs/stream_sketch.md): the fused client phase's microbatch scan
+    # carries the (r, c_pad) count-sketch TABLE instead of the d-sized
+    # gradient accumulator — each gradient leaf is sketched at its flat
+    # offset (ops/flat.leaf_segments) right after the backward pass
+    # produces it, the seq/model/pp/expert psums ride the small table
+    # (sketch linearity), and weight decay folds in as one extra
+    # segment-sketch of the resident chunked weights. Kills the client
+    # phase's d-sized concatenate/pad/reshape movement (the 22.6% category
+    # of docs/measurements/tpu_profile_gpt2.md) and shrinks the scan carry
+    # from O(d) to O(table). Requires the fused-gradient + sketch-after-sum
+    # + chunked-resident window; silently composed elsewhere (and under
+    # the COMMEFFICIENT_STREAM_SKETCH=0 kill-switch), mirroring the
+    # fused-epilogue rollout. The composed path stays the default and the
+    # bit-exact reference.
+    stream_sketch: bool = False
     # On-device health guards (--guards, docs/fault_tolerance.md): the
     # server phase computes a scalar finiteness/magnitude verdict
     # (server.round_health) and gates the WHOLE state transition on it —
@@ -336,36 +353,72 @@ def build_round_step(
     # fused sketch mode only ever rides the sketch-after-sum path
     assert not (fused_grad and wcfg.mode == "sketch" and not sketch_after_sum)
 
+    # Streaming client-phase sketch (--stream_sketch, docs/stream_sketch.md):
+    # legal only inside the fused-gradient + sketch-after-sum +
+    # chunked-resident window (one gradient per shard, nothing nonlinear
+    # between the backward pass and the table). Silently composed elsewhere
+    # and under the COMMEFFICIENT_STREAM_SKETCH=0 kill-switch — the
+    # fused-epilogue rollout pattern; the composed path stays the default
+    # and the bit-exact reference.
+    import os as _os
+
+    stream = (bool(cfg.stream_sketch)
+              and fused_grad and sketch_after_sum and chunked
+              and _os.environ.get("COMMEFFICIENT_STREAM_SKETCH", "1") != "0")
+
     # Tensor/expert parallelism: flat grad-rescale masks built once,
     # host-side — 1.0 on segments whose weights the model computes
     # slice-locally per shard of the axis, 1/n where every shard computed
     # the identical full grad (see worker.WorkerConfig.model_axis /
     # .expert_axis).
-    def _flat_scale(axis_name, sliced_pred, pred_attr):
+    # the template pytree of the flat layout (eval_shape: no device
+    # allocation at GPT-2 scale) and its per-leaf offset map — computed
+    # once per build, shared by the tp/ep rescale masks and the streaming
+    # sketch's per-leaf scales and offsets, so the layouts cannot drift
+    # (ops/flat.leaf_segments)
+    _layout_cache = {}
+
+    def _template():
+        if "tpl" not in _layout_cache:
+            _layout_cache["tpl"] = jax.eval_shape(
+                unravel, jax.ShapeDtypeStruct((cfg.grad_size,), jnp.float32))
+        return _layout_cache["tpl"]
+
+    def _segs():
+        if "segs" not in _layout_cache:
+            _layout_cache["segs"] = leaf_segments(_template())
+        return _layout_cache["segs"]
+
+    def _leaf_scale_vals(axis_name, sliced_pred, pred_attr):
+        """Per-leaf rescale values (1.0 on slice-local segments, 1/n on
+        replicated ones) in ravel order."""
         assert mesh is not None and axis_name in mesh.axis_names, \
             f"axis {axis_name!r} not in mesh axes"
         assert sliced_pred is not None, \
             f"worker axis {axis_name!r} set but RoundConfig.{pred_attr} " \
             f"is missing"
         n = mesh.shape[axis_name]
-        tpl = unravel(jnp.zeros(cfg.grad_size, jnp.float32))
-        leaves = jax.tree_util.tree_leaves_with_path(tpl)
-        segs = []
-        for path, leaf in leaves:
-            keys = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                            for p in path).lower()
-            val = 1.0 if sliced_pred(keys) else 1.0 / n
-            segs.append(jnp.full(int(np.prod(leaf.shape)), val, jnp.float32))
-        scale = jnp.concatenate(segs)
+        return tuple(1.0 if sliced_pred(s.path) else 1.0 / n
+                     for s in _segs())
+
+    def _flat_scale(axis_name, sliced_pred, pred_attr):
+        vals = _leaf_scale_vals(axis_name, sliced_pred, pred_attr)
+        scale = jnp.concatenate([
+            jnp.full(s.size, v, jnp.float32)
+            for s, v in zip(_segs(), vals)])
         assert scale.size == cfg.grad_size, \
             f"{pred_attr} scale layout does not match the flat vector"
         return scale
 
+    # A streaming build never touches the d-sized masks (its per-leaf
+    # constants come from _leaf_scale_vals below) — materializing them
+    # anyway would park ~2×d f32 of dead mask in HBM at GPT-2 scale,
+    # eroding the O(d)→O(table) memory win the flag exists for.
     tp_scale = None
-    if wcfg.model_axis is not None:
+    if wcfg.model_axis is not None and not stream:
         tp_scale = _flat_scale(wcfg.model_axis, cfg.tp_sliced, "tp_sliced")
     ep_scale = None
-    if wcfg.expert_axis is not None:
+    if wcfg.expert_axis is not None and not stream:
         # composes with every other axis, each on its own mesh dimension:
         # seq (token-partial grads, scale 1), model (orthogonal param
         # sets: each axis's scale mask marks the other's params
@@ -381,6 +434,30 @@ def build_round_step(
         else tp_scale
     ep_scale_res = layout.chunk(ep_scale) if (chunked and ep_scale is not None) \
         else ep_scale
+
+    # Streaming-path machinery: the leaf offset map of the flat layout,
+    # a model-boundary unravel that reads leaves straight out of the
+    # (T, S, 128) resident plane (no d-sized flatten — the last d-sized
+    # movement op of the composed client phase), and the per-leaf tp×ep
+    # rescale constants applied BEFORE sketching (the flat masks are
+    # per-leaf constants; the reorder past the psum is exact for
+    # power-of-two mesh axes — docs/stream_sketch.md).
+    stream_segs = stream_unravel = stream_scales = None
+    if stream:
+        stream_segs = _segs()
+        assert stream_segs[-1].offset + stream_segs[-1].size \
+            == cfg.grad_size, "leaf layout does not cover the flat vector"
+        stream_unravel = chunked_unravel(layout, _template())
+        vals = [1.0] * len(stream_segs)
+        if wcfg.model_axis is not None:
+            tp_vals = _leaf_scale_vals(wcfg.model_axis, cfg.tp_sliced,
+                                       "tp_sliced")
+            vals = [a * b for a, b in zip(vals, tp_vals)]
+        if wcfg.expert_axis is not None:
+            ep_vals = _leaf_scale_vals(wcfg.expert_axis, cfg.ep_sliced,
+                                       "ep_sliced")
+            vals = [a * b for a, b in zip(vals, ep_vals)]
+        stream_scales = tuple(vals) if any(v != 1.0 for v in vals) else None
 
     # Pipeline parallelism (parallel/pipeline.py): the loss callbacks carry
     # the GPipe schedule; the round only needs the one-gradient psum over
@@ -463,6 +540,95 @@ def build_round_step(
             + (counts,)
         return g_sum, new_ms, metrics
 
+    def fused_clients_stream(ps_weights, model_state, batch, rng_keys,
+                             worker_mask):
+        """Streaming client phase (--stream_sketch, docs/stream_sketch.md):
+        like ``fused_clients``, but the microbatch scan's carry holds the
+        shard's (r, c_pad) count-sketch TABLE instead of the d-sized
+        gradient accumulator. The backward pass differentiates w.r.t. the
+        parameter PYTREE (not the flat vector), so its transpose never
+        concatenates the d-vector; each leaf gradient is sketched at its
+        flat offset as soon as ``grad_fn`` returns (worker.sketch_grad_tree
+        — leaves in offset order continue the composed fold's per-cell add
+        order), the seq/model/pp/expert psums ride the small table (sketch
+        linearity), and weight decay folds in as one extra segment-sketch
+        of the resident chunked weights. Returns (local TABLE, stacked
+        per-client model_state, per-client metrics) — the table slots into
+        ``clients_shard`` where the composed path's
+        ``sketch_chunks(local_sum)`` result would.
+
+        Bit-compatibility with the composed path (pinned in
+        tests/test_stream_sketch.py): with a single microbatch, zero
+        weight decay, and client-axis-only parallelism the table — and
+        therefore the whole fp32 trajectory — matches ``fused_clients`` +
+        ``sketch_chunks`` up to the sign of all-zero cells. Multiple
+        microbatches, wd ≠ 0, or seq/model/pp/expert axes reorder f32
+        summation (documented in docs/stream_sketch.md), exactly the class
+        of deviation the sharded server plane already documents."""
+        W = worker_mask.shape[0]
+        B = batch["mask"].shape[1]
+        mb, n_iters, pad = microbatch_plan(B, wcfg.microbatch_size)
+        stacked = split_microbatches(batch, mb, n_iters, pad, example_dim=1)
+        mstates0 = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), model_state)
+        # the ONE model boundary: leaves sliced straight from the resident
+        # chunk plane (ops/flat.chunked_unravel — every op < d-sized)
+        params = stream_unravel(ps_weights)
+
+        def step_loss(p, mstates, micro, subs):
+            def per_client(ms, b, r):
+                return compute_loss_train(p, ms, b, r, True)
+
+            loss_sums, msums, counts, new_ms = jax.vmap(per_client)(
+                mstates, micro, subs)
+            total = jnp.sum(loss_sums * worker_mask)
+            return total, (loss_sums, msums, counts, new_ms)
+
+        grad_fn = jax.value_and_grad(step_loss, has_aux=True)
+
+        n_metrics = probe_n_metrics(
+            compute_loss_train, params, model_state,
+            jax.tree_util.tree_map(lambda x: x[0, 0], stacked))
+
+        def body(carry, micro):
+            table, loss_acc, m_acc, n_acc, mstates, keys = carry
+            keys2, subs = jax.vmap(next_rng)(keys)
+            (_, (loss_sums, msums, counts, new_ms)), g_tree = grad_fn(
+                params, mstates, micro, subs)
+            # leaf gradients -> table, right where the backward made them
+            table = sketch_grad_tree(sketch, table, g_tree, stream_segs,
+                                     scales=stream_scales)
+            m_acc = tuple(a + m for a, m in zip(m_acc, msums))
+            return (table, loss_acc + loss_sums, m_acc, n_acc + counts,
+                    new_ms, keys2), None
+
+        init = (jnp.zeros(sketch.table_shape, jnp.float32), jnp.zeros(W),
+                tuple(jnp.zeros(W) for _ in range(n_metrics)), jnp.zeros(W),
+                mstates0, rng_keys)
+        (table, loss_sums, m_sums, counts, new_ms, _), _ = jax.lax.scan(
+            body, init, stacked)
+
+        # the composed path's post-scan psums, riding the table: sketches
+        # are linear, so psum(sketch(g)) == sketch(psum(g)); the tp/ep
+        # rescales already happened per leaf above
+        for ax in (wcfg.seq_axis, wcfg.model_axis, wcfg.pp_axis,
+                   wcfg.expert_axis):
+            if ax is not None:
+                table = jax.lax.psum(table, ax)
+        if wcfg.weight_decay != 0:
+            # (wd/num_workers)·Σ_i mask_i·count_i · w, as one extra
+            # full-range segment-sketch of the resident chunked weights —
+            # AFTER the axis psums (w is replicated across them, exactly
+            # like the composed path adds wd after its psums)
+            wd_scale = jnp.sum(worker_mask * counts)
+            coef = (wcfg.weight_decay / wcfg.num_workers) * wd_scale
+            table = sketch_chunks_accum(sketch, table, ps_weights * coef)
+
+        denom = jnp.maximum(counts, 1.0)
+        metrics = (loss_sums / denom,) + tuple(m / denom for m in m_sums) \
+            + (counts,)
+        return table, new_ms, metrics
+
     def one_client(ps_weights, vel_row, err_row, stale_row, model_state,
                    batch_row, lr, rng, slot_mask):
         # choose weights (topk-down stale path, fed_worker.py:150-159)
@@ -516,8 +682,13 @@ def build_round_step(
                       batch, lr, rng_keys, worker_mask):
         """Runs on one device over its W/n client slots; psums the transmit."""
         if fused_grad:
-            local_sum, new_ms, metrics = fused_clients(
-                ps_weights, model_state, batch, rng_keys, worker_mask)
+            if stream:
+                # streaming path: local_sum IS already the shard's table
+                local_sum, new_ms, metrics = fused_clients_stream(
+                    ps_weights, model_state, batch, rng_keys, worker_mask)
+            else:
+                local_sum, new_ms, metrics = fused_clients(
+                    ps_weights, model_state, batch, rng_keys, worker_mask)
             # no per-client state on any fused-eligible config: the inert
             # placeholder rows pass through untouched
             new_vel, new_err = vel_rows, err_rows
@@ -533,11 +704,12 @@ def build_round_step(
             )(vel_rows, err_rows, stale_rows, model_state, batch, lr,
               rng_keys, worker_mask)
             local_sum = jnp.sum(transmit, axis=0)
-        if sketch_after_sum:
+        if sketch_after_sum and not stream:
             # one sketch of the shard's dense gradient sum (see fusion note
             # above); the psum then rides the small (r, c_pad) table exactly
             # as the per-client path would. The fused chunked gradient is
             # already in the kernel's (T, S, 128) layout — no pad/reshape.
+            # (The streaming path above already produced the table.)
             if chunked and fused_grad:
                 local_sum = sketch_chunks(sketch, local_sum)
             else:

@@ -45,8 +45,6 @@ from commefficient_tpu.ops.sketch import (
     fused_epilogue_mode,
     sketch_chunks,
     sketch_chunks_local,
-    sketch_vec,
-    unsketch,
     unsketch_chunks,
 )
 from commefficient_tpu.ops.topk import topk, topk_dense_nd
@@ -454,12 +452,20 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch,
             update = unsketch_chunks(sketch, error, cfg.k)
             sketched_update = sketch_chunks(sketch, update)
     else:
-        update = unsketch(sketch, error, cfg.k)
-
-        # re-sketch the dense update; its nonzero cells are where error
-        # feedback and momentum masking happen (reference
-        # fed_aggregator.py:592-611)
-        sketched_update = sketch_vec(sketch, update)
+        # flat caller: ONE shared (T, S, 128) view end-to-end. The old
+        # formulation (unsketch → flat update → sketch_vec) flattened the
+        # estimate chunks and then re-padded the SAME flat plane for the
+        # re-sketch — the twin d-sized pad/reshape pairs of the GPT-2
+        # profile (~3.1 ms/round, docs/measurements/tpu_profile_gpt2.md).
+        # Thresholding the chunked estimates in place and re-sketching the
+        # chunked update keeps the one flat materialization at the return
+        # boundary; values are identical (pure layout + the same
+        # threshold-descent counts). The nonzero cells of the re-sketch
+        # are where error feedback and momentum masking happen (reference
+        # fed_aggregator.py:592-611).
+        upd3 = unsketch_chunks(sketch, error, cfg.k)
+        sketched_update = sketch_chunks(sketch, upd3)
+        update = sketch.chunk_layout.unchunk(upd3)
     cell_nz = sketched_update != 0
     if cfg.error_type == "virtual":
         error = jnp.where(cell_nz, 0.0, error)

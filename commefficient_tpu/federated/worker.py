@@ -56,7 +56,12 @@ import jax
 import jax.numpy as jnp
 
 from commefficient_tpu.ops.clip import clip_by_l2
-from commefficient_tpu.ops.sketch import CountSketch, l2estimate, sketch_vec
+from commefficient_tpu.ops.sketch import (
+    CountSketch,
+    l2estimate,
+    sketch_segment_accum,
+    sketch_vec,
+)
 from commefficient_tpu.ops.topk import topk
 
 
@@ -174,6 +179,35 @@ def probe_n_metrics(compute_loss, params, model_state, example_batch) -> int:
         lambda: compute_loss(params, model_state, example_batch,
                              jax.random.key(0), True))
     return len(probe[1])
+
+
+def sketch_grad_tree(sketch: CountSketch, table, grad_tree, segments,
+                     scales=None, interpret: bool = False):
+    """Stream a gradient PYTREE into a running count-sketch table —
+    the streaming client phase's replacement for
+    ``sketch_vec(sketch, ravel(grad_tree))`` (docs/stream_sketch.md):
+    every leaf is accumulated at its global flat offset
+    (ops/flat.leaf_segments) right where the backward pass produced it, so
+    the concatenated d-vector is never materialized. Leaves stream in
+    offset order, so per table cell the f32 adds continue the composed
+    path's chunk-ordered fold — bit-identical up to the sign of all-zero
+    cells (ops/sketch.sketch_segment_accum). ``scales`` (optional, one
+    float per leaf) is the tp/ep grad-rescale value applied per leaf
+    BEFORE sketching — a per-leaf constant of the flat rescale masks, and
+    exact under the psum reorder for power-of-two mesh axes
+    (docs/stream_sketch.md). bf16 leaves are cast to f32 per element
+    (exact), matching the composed path's pad/convert."""
+    leaves = jax.tree_util.tree_leaves(grad_tree)
+    assert len(leaves) == len(segments), (len(leaves), len(segments))
+    assert scales is None or len(scales) == len(segments)
+    for i, (leaf, seg) in enumerate(zip(leaves, segments)):
+        assert int(leaf.size) == seg.size, (leaf.shape, seg)
+        x = leaf.reshape(-1).astype(jnp.float32)
+        if scales is not None and float(scales[i]) != 1.0:
+            x = x * jnp.float32(scales[i])
+        table = sketch_segment_accum(sketch, table, x, seg.offset,
+                                     interpret=interpret)
+    return table
 
 
 def _microbatch_grads(compute_loss, params, model_state, batch, rng,

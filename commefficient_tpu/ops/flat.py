@@ -25,7 +25,9 @@ the resident data plane.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, NamedTuple, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +40,67 @@ def ravel_pytree(params: Any) -> Tuple[jax.Array, Callable[[jax.Array], Any]]:
     """Flatten a parameter pytree into a float32 vector + unravel closure."""
     flat, unravel = _ravel_pytree(params)
     return flat.astype(jnp.float32), unravel
+
+
+class LeafSegment(NamedTuple):
+    """One pytree leaf's place in ``ravel_pytree``'s flat layout."""
+
+    path: str    # '/'-joined lowercase param path (rounds._flat_scale form)
+    offset: int  # global flat element offset of the leaf's first element
+    size: int    # number of elements (C-order ravel of the leaf)
+
+
+def leaf_segments(tree: Any) -> Tuple[LeafSegment, ...]:
+    """Per-leaf ``(path, offset, size)`` of ``ravel_pytree``'s flat layout:
+    leaves in ``tree_flatten`` order, each raveled C-order, offsets the
+    running cumulative size — THE offset map the streaming client phase
+    (docs/stream_sketch.md) uses to sketch each gradient leaf at its global
+    coordinate base instead of materializing the concatenated d-vector,
+    and the one the tp/ep flat grad-rescale masks are built from
+    (rounds._flat_scale), so the two layouts cannot drift. ``tree`` may be
+    real arrays or ``jax.eval_shape`` structs (only shapes are read)."""
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    segs = []
+    start = 0
+    for path, leaf in leaves:
+        keys = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path).lower()
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        segs.append(LeafSegment(path=keys, offset=start, size=n))
+        start += n
+    return tuple(segs)
+
+
+def chunked_unravel(layout: "ChunkLayout",
+                    template: Any) -> Callable[[jax.Array], Any]:
+    """Parameter pytree directly from the ``(T, S, 128)`` resident layout
+    with NO d-sized flatten: each leaf slices only its covering chunk rows
+    (a pure slice), flattens that block (≤ leaf size + 2 chunks), and
+    reshapes to the leaf shape. Bitwise the same values as
+    ``unravel(layout.unchunk(c3))`` for the matching ``ravel_pytree``
+    layout — the streaming client phase's model boundary
+    (docs/stream_sketch.md), where the composed path's single
+    padded-size reshape is the last d-sized movement op standing.
+    ``template`` may be real arrays or ``jax.eval_shape`` structs."""
+    segs = leaf_segments(template)
+    flat_leaves, treedef = jax.tree_util.tree_flatten(template)
+    shapes = [l.shape for l in flat_leaves]
+    dtypes = [l.dtype for l in flat_leaves]
+    ce = layout.S * LANES  # elements per chunk
+
+    def unravel_chunks(c3: jax.Array) -> Any:
+        assert c3.shape == layout.shape, (c3.shape, layout.shape)
+        leaves = []
+        for seg, shp, dt in zip(segs, shapes, dtypes):
+            t0 = seg.offset // ce
+            t1 = -(-(seg.offset + seg.size) // ce)
+            block = c3[t0:t1].reshape((t1 - t0) * ce)
+            lo = seg.offset - t0 * ce
+            x = jax.lax.slice_in_dim(block, lo, lo + seg.size)
+            leaves.append(x.reshape(shp).astype(dt))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    return unravel_chunks
 
 
 @dataclass(frozen=True)

@@ -479,6 +479,22 @@ def _check_sketch_kernel_once(eager: bool = False) -> None:
         want_l = _sketch_chunks_jax(cs, v3[t0v:t0v + Tn], jnp.int32(t0v))
         if not np.array_equal(np.asarray(got_l), np.asarray(want_l)):
             raise AssertionError("local accumulate != pure XLA partial")
+        # streaming segment accumulate (docs/stream_sketch.md): the
+        # running-table kernel must bit-continue the pure fold at an
+        # unaligned element offset spanning a chunk boundary
+        tbl0 = jnp.asarray(
+            np.random.RandomState(8).randn(cs.r, cs.c_pad), jnp.float32)
+        a, b = 137, cs.c_pad + 50_011
+        seg3, t_a = _segment_chunks(cs, v[a:b], a)
+        got_a = _sketch_accum_pallas(
+            tbl0.reshape(cs.r, cs.sublanes, _LANES), seg3,
+            cs.shift_q[:, t_a:t_a + seg3.shape[0]],
+            cs.shift_w[:, t_a:t_a + seg3.shape[0]], cs.sign_keys,
+            np.full(1, t_a, np.int32), S=cs.sublanes,
+            T=seg3.shape[0]).reshape(cs.r, cs.c_pad)
+        want_a = _sketch_accum_chunks_jax(cs, tbl0, seg3, t_a)
+        if not np.array_equal(np.asarray(got_a), np.asarray(want_a)):
+            raise AssertionError("segment accumulate != pure XLA fold")
     except Exception as e:  # noqa: BLE001 — any failure means: don't use it
         os.environ["COMMEFFICIENT_PALLAS_SKETCH"] = "0"
         warnings.warn(
@@ -520,6 +536,162 @@ def sketch_chunks(cs: CountSketch, v3: jax.Array) -> jax.Array:
                                  _T0, S=cs.sublanes, T=cs.T)
         return out.reshape(cs.r, cs.c_pad)
     return _sketch_chunks_jax(cs, v3)
+
+
+@functools.partial(jax.jit, static_argnames=("S", "T", "interpret"))
+def _sketch_accum_pallas(tbl3, v3, shift_q, shift_w, sign_keys, t0, *, S, T,
+                         interpret=False):
+    """``_sketch_vec_pallas`` with a RUNNING-TABLE init: the output row
+    starts from ``tbl3``'s row instead of zeros, then accumulates the T
+    chunks exactly like the zero-init kernel. Per (row, cell) the f32 adds
+    are ``tbl + c_0 + c_1 + ...`` in chunk order — bit-continuing the pure
+    scan's left fold, which is what lets the streaming client phase
+    (docs/stream_sketch.md) sketch a gradient leaf-by-leaf and still match
+    the composed ravel-then-``sketch_vec`` path's per-cell add order.
+    ``t0`` is the chunks' global index offset as in ``_sketch_vec_pallas``
+    (shift arrays arrive pre-sliced to the local chunk range)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = shift_q.shape[0]
+    chunk_elems = S * _LANES
+
+    def kernel(q_ref, w_ref, key_ref, t0_ref, tbl_ref, v_ref, out_ref, dbl):
+        row = pl.program_id(0)
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _():
+            out_ref[...] = tbl_ref[...]
+
+        idx = (t0_ref[0] + t) * chunk_elems + (
+            jax.lax.broadcasted_iota(jnp.int32, (S, _LANES), 0) * _LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (S, _LANES), 1))
+        sv = v_ref[0] * _signs_for(idx, key_ref[row])
+        # identical roll scheme to _sketch_vec_pallas (see its docstring)
+        w = w_ref[row, t]
+        z = pltpu.roll(sv, w, axis=1)
+        dbl[:S] = z
+        dbl[S:] = z
+        q = q_ref[row, t]
+        j = jax.lax.broadcasted_iota(jnp.int32, (S, _LANES), 1)
+        out_ref[0] += jnp.where(j >= w, dbl[pl.ds(S - q, S), :],
+                                dbl[pl.ds(S - q - 1, S), :])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(r, T),
+        in_specs=[
+            pl.BlockSpec((1, S, _LANES), lambda row, t, *_: (row, 0, 0)),
+            pl.BlockSpec((1, S, _LANES), lambda row, t, *_: (t, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, S, _LANES), lambda row, t, *_: (row, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((2 * S, _LANES), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, S, _LANES), jnp.float32),
+        interpret=interpret,
+    )(shift_q, shift_w, sign_keys, t0, tbl3, v3)
+    return out
+
+
+def _sketch_accum_chunks_jax(cs: CountSketch, table: jax.Array,
+                             v3: jax.Array, t_a: int) -> jax.Array:
+    """Pure-XLA running-table accumulate of ``Tn`` chunks starting at
+    STATIC global chunk ``t_a``: the same scan body as
+    ``_sketch_chunks_jax`` with ``init = table`` — per cell, one f32 add
+    per chunk onto the incoming value, in chunk order."""
+    S = cs.sublanes
+    Tn = v3.shape[0]
+    q_cols = cs.shift_q[:, t_a:t_a + Tn]
+    w_cols = cs.shift_w[:, t_a:t_a + Tn]
+    t_bases = (t_a + jnp.arange(Tn, dtype=jnp.int32)) * (S * _LANES)
+
+    def body(tbl, xs):
+        chunk, q_r, w_r, t_base = xs
+        sv = chunk[None, :, :] * _chunk_signs(cs, t_base)
+        rolled = jax.vmap(_roll2d)(sv, q_r, w_r)
+        return tbl + rolled, None
+
+    tbl, _ = jax.lax.scan(
+        body, table.reshape(cs.r, S, _LANES), (v3, q_cols.T, w_cols.T,
+                                               t_bases))
+    return tbl.reshape(cs.r, cs.c_pad)
+
+
+def _segment_chunks(cs: CountSketch, seg: jax.Array, e0: int):
+    """STATIC-offset segment prep: zero-pad the 1-D segment out to the
+    chunk boundaries it touches and reshape to the ``(Tn, S, 128)`` chunk
+    layout of chunks ``[t_a, t_a + Tn)``. Pads are segment-sized (+ < 2
+    chunks), never d-sized — the point of the streaming path. Zero-padded
+    positions contribute sign·0 = ±0.0 to their cells, the one documented
+    deviation from the composed path (cells whose every contribution is a
+    signed zero can differ in the SIGN of their zero; never in ``==``)."""
+    n = int(seg.size)
+    ce = cs.c_pad
+    t_a = e0 // ce
+    lpad = e0 - t_a * ce
+    Tn = -(-(lpad + n) // ce)
+    v = jnp.pad(seg.reshape(-1).astype(jnp.float32),
+                (lpad, Tn * ce - lpad - n))
+    return v.reshape(Tn, cs.sublanes, _LANES), t_a
+
+
+def sketch_segment_accum(cs: CountSketch, table: jax.Array, seg: jax.Array,
+                         e0: int, interpret: bool = False) -> jax.Array:
+    """Accumulate a contiguous flat-coordinate segment — ``seg`` holds
+    coordinates ``[e0, e0 + seg.size)`` of the conceptual d-vector — into
+    a RUNNING ``(r, c_pad)`` table. ``e0`` is a STATIC int (leaf offsets
+    of a pytree layout are trace-time constants, ops/flat.leaf_segments),
+    which is what generalizes the sharded-server ``t0`` chunk offset down
+    to element granularity: the segment is padded to its covering chunk
+    range (small, static pads) and the chunk-offset kernels do the rest.
+
+    Streaming a d-vector through consecutive segments in offset order is
+    bit-identical to ``sketch_vec`` of the whole vector up to the sign of
+    all-zero cells (see ``_segment_chunks``): per cell exactly one
+    coordinate per chunk contributes, the fold visits chunks in the same
+    order, and boundary chunks only add extra ±0.0 terms."""
+    e0 = int(e0)
+    n = int(seg.size)
+    assert 0 <= e0 and e0 + n <= cs.d, (e0, n, cs.d)
+    assert table.shape == cs.table_shape, (table.shape, cs.table_shape)
+    if n == 0:
+        return table
+    v3, t_a = _segment_chunks(cs, seg, e0)
+    if _trace_state_clean():
+        _check_sketch_kernel_once(eager=True)
+    if _use_pallas_sketch() or interpret:
+        out = _sketch_accum_pallas(
+            table.reshape(cs.r, cs.sublanes, _LANES), v3,
+            cs.shift_q[:, t_a:t_a + v3.shape[0]],
+            cs.shift_w[:, t_a:t_a + v3.shape[0]], cs.sign_keys,
+            np.full(1, t_a, np.int32), S=cs.sublanes, T=v3.shape[0],
+            interpret=interpret)
+        return out.reshape(cs.r, cs.c_pad)
+    return _sketch_accum_chunks_jax(cs, table, v3, t_a)
+
+
+def sketch_chunks_accum(cs: CountSketch, table: jax.Array, v3: jax.Array,
+                        interpret: bool = False) -> jax.Array:
+    """Full-range running-table accumulate: ``table`` plus the sketch of a
+    vector already in the ``(T, S, 128)`` resident chunk layout, with the
+    per-cell adds bit-continuing the incoming table's fold (the streaming
+    client phase's weight-decay term rides this — one extra segment-sketch
+    of the resident chunked weights, docs/stream_sketch.md)."""
+    assert v3.shape == (cs.T, cs.sublanes, _LANES), v3.shape
+    assert table.shape == cs.table_shape, (table.shape, cs.table_shape)
+    if _trace_state_clean():
+        _check_sketch_kernel_once(eager=True)
+    if _use_pallas_sketch() or interpret:
+        out = _sketch_accum_pallas(
+            table.reshape(cs.r, cs.sublanes, _LANES), v3, cs.shift_q,
+            cs.shift_w, cs.sign_keys, _T0, S=cs.sublanes, T=cs.T,
+            interpret=interpret)
+        return out.reshape(cs.r, cs.c_pad)
+    return _sketch_accum_chunks_jax(cs, table, v3, 0)
 
 
 def sketch_chunks_local(cs: CountSketch, v3: jax.Array, t0,
@@ -753,10 +925,18 @@ def estimates_chunks_local(cs: CountSketch, table: jax.Array, t0, Tn: int,
 def unsketch(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:
     """Dense ``(d,)`` vector holding the estimated values of the k
     largest-magnitude coordinates, zero elsewhere (``CSVec.unSketch(k)``,
-    reference fed_aggregator.py:590)."""
-    from commefficient_tpu.ops.topk import topk
+    reference fed_aggregator.py:590).
 
-    return topk(estimates(cs, table), k)
+    Routed through ONE shared ``(T, S, 128)`` view: the GPT-2 profile
+    (docs/measurements/tpu_profile_gpt2.md) showed the flat formulation —
+    flatten the estimates, threshold flat, re-pad the flat update for the
+    re-sketch — paying twin d-sized ``pad``/``reshape`` pairs
+    (~3.1 ms/round) for the SAME plane; thresholding the chunked
+    estimates in place (``topk_dense_nd``) keeps the one flat
+    materialization at the very end. Identical values: the chunking is
+    pure layout and the threshold descent counts the same d coordinates
+    (the masked zero tail can never win a nonzero threshold)."""
+    return cs.chunk_layout.unchunk(unsketch_chunks(cs, table, k))
 
 
 def unsketch_chunks(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:

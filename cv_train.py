@@ -349,6 +349,11 @@ def main(argv=None):
         "models have no expert axis — use gpt2_train.py")
     if args.lr_scale is None:
         args.lr_scale = 0.4  # cifar10-fast default peak LR
+    if args.stream_sketch:
+        print("stream-sketch client phase requested: gradients stream "
+              "leaf-by-leaf into the count-sketch table "
+              "(docs/stream_sketch.md; COMMEFFICIENT_STREAM_SKETCH=0 "
+              "restores the composed path)")
     print(args)
     timer = Timer()
     np.random.seed(args.seed)

@@ -238,6 +238,14 @@ def train(argv=None):
     args = parse_args(default_lr=4e-2, argv=argv)
     if not args.dataset_name:
         args.dataset_name = "PERSONA"
+    if args.stream_sketch:
+        # the GPT-2 client phase is where the streaming sketch pays off:
+        # the d=124M flat-gradient concat/pad/convert churn was 22.6% of
+        # device busy time (docs/measurements/tpu_profile_gpt2.md)
+        print("stream-sketch client phase requested: gradients stream "
+              "leaf-by-leaf into the count-sketch table "
+              "(docs/stream_sketch.md; COMMEFFICIENT_STREAM_SKETCH=0 "
+              "restores the composed path)")
     print(args)
     timer = Timer()
 

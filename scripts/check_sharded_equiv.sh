@@ -9,11 +9,17 @@
 #   - checkpoint round-trips of the sharded server state (both planes);
 #   - the fused server epilogue's bit-identity to the composed path on
 #     both planes (tests/test_fused_epilogue.py, docs/fused_epilogue.md —
-#     megakernel through the Pallas interpreter).
+#     megakernel through the Pallas interpreter);
+#   - the streaming client-phase sketch's bit-identity to the composed
+#     ravel+sketch path, replicated/--server_shard × composed/
+#     --fused_epilogue, plus the no-d-sized-movement and table-sized-carry
+#     structural asserts (tests/test_stream_sketch.py,
+#     docs/stream_sketch.md).
 # Any extra args are passed through to pytest (e.g. -k bit_identical).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_sharded_server.py tests/test_fused_epilogue.py \
+    tests/test_stream_sketch.py \
     -q -p no:cacheprovider "$@"

@@ -33,6 +33,10 @@ ms/round grew by more than PCT percent — the CI regression hook.
   the "server epilogue (d-plane sweeps)" bucket must not grow at all
   (capture the pair with scripts/tpu_profile.py, the second run under
   TPU_PROFILE_FUSED=1).
+- ``stream-sketch`` — the --stream_sketch claim (docs/stream_sketch.md):
+  the "client flatten/movement (d-sized)" bucket must not grow at all and
+  is expected to collapse (capture the pair with scripts/tpu_profile.py,
+  the second run under TPU_PROFILE_STREAM=1).
 """
 
 from __future__ import annotations
@@ -60,6 +64,18 @@ _PRESETS: Dict[str, Dict[str, float]] = {
     "fused-epilogue": {
         "server epilogue (d-plane sweeps)": 0.0,
         "convolution": 10.0,
+    },
+    # the --stream_sketch claim (docs/stream_sketch.md): the client
+    # phase's d-sized flat-vector movement ("client flatten/movement
+    # (d-sized)" — the 1-D concatenate/pad/reshape/convert bucket
+    # scripts/tpu_profile.py emits) must not grow at all — the streaming
+    # path deletes those ops, so any growth is a regression. The model
+    # (convolution on CIFAR, matmul on GPT-2) must stay flat; 10% covers
+    # tenancy noise between captures.
+    "stream-sketch": {
+        "client flatten/movement (d-sized)": 0.0,
+        "convolution": 10.0,
+        "matmul": 10.0,
     },
 }
 

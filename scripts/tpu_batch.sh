@@ -61,8 +61,14 @@ touch "$STATE"
 is_done() { grep -qx "$1" "$STATE" 2>/dev/null; }
 mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 
-STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards fused_epilogue \
-learning profile profile_fused profile_gpt2 host_offload imagenet ops"}
+# NOTE (stream-sketch PR): the fused_epilogue A/B step below is still
+# gated pending a chip window — delete its line from runs/.tpu_steps_done
+# (or the whole state file) at the next window so it re-runs alongside the
+# new stream/stream_sketch/profile_stream legs; one pass decides both
+# defaults (docs/stream_sketch.md, docs/fused_epilogue.md).
+STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
+stream_sketch fused_epilogue learning profile profile_fused profile_stream \
+profile_gpt2 host_offload imagenet ops"}
 i=0
 for step in $STEPS; do
   i=$((i + 1))
@@ -90,7 +96,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact
       log "step $i: bench.py --capture $step (timeout 40m)"
@@ -143,6 +149,36 @@ for step in $STEPS; do
         mark_done profile_fused
       fi
       log "step $i rc=$rc (docs/measurements/tpu_profile_fused.md on success)"
+      ;;
+    stream_sketch)
+      # composed-vs-streaming client phase A/B at the headline CIFAR
+      # geometry (docs/stream_sketch.md gate decision rule)
+      log "step $i: tpu_measure.py stream_sketch A/B (timeout 30m)"
+      timeout 1800 python scripts/tpu_measure.py stream_sketch \
+        >"$OUT/tpu_measure_stream.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_stream.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "streaming round" "$OUT/tpu_measure_stream.log"; then
+        mark_done stream_sketch
+      fi
+      ;;
+    profile_stream)
+      # --stream_sketch per-op capture + the movement-count gate against
+      # the composed capture (docs/stream_sketch.md). Needs the composed
+      # capture first (the 'profile' step).
+      log "step $i: tpu_profile.py stream-sketch capture + diff (40m)"
+      TPU_PROFILE_STREAM=1 timeout 2400 python scripts/tpu_profile.py \
+        >"$OUT/profile_stream.log" 2>&1
+      rc=$?
+      if [ $rc -eq 0 ]; then
+        python scripts/profile_diff.py docs/measurements/tpu_profile.md \
+          docs/measurements/tpu_profile_stream.md --preset stream-sketch \
+          >"$OUT/profile_stream_diff.log" 2>&1 || \
+          log "note: stream-sketch movement gate FAILED (see diff log)"
+        mark_done profile_stream
+      fi
+      log "step $i rc=$rc (docs/measurements/tpu_profile_stream.md on success)"
       ;;
     fused_epilogue)
       # composed-vs-fused epilogue chain A/B + the re-armed topk A/B with

@@ -290,6 +290,45 @@ def fused_epilogue_leg(d):
                  if t_c is not None else ""), flush=True)
 
 
+def stream_sketch_leg():
+    """Streaming client-phase sketch A/B (docs/stream_sketch.md): the
+    composed fused round (flat gradient built, then one sketch) vs
+    --stream_sketch (leaf-streamed table carry) at the headline CIFAR
+    geometry, same batch, same state. One round from identical state is
+    compared first: with the bench wd=5e-4 the weight-decay term rides a
+    separate segment-sketch, so the comparison is allclose, not bitwise —
+    the wd=0 bit-identity (and both server planes × both epilogues) is
+    pinned on CPU in tests/test_stream_sketch.py. The delta of the two
+    timed legs IS the movement win (the builds differ only in
+    RoundConfig.stream_sketch)."""
+    steps_c, ps_c, ss_c, cs_c, batch = B.build(tiny=False)
+    steps_s, ps_s, ss_s, cs_s, _ = B.build(tiny=False, stream_sketch=True)
+    # one-round output comparison from identical state. train_step
+    # donates ps/server/client state, so the comparison runs on COPIES —
+    # the timed loops below still own the original buffers.
+    def _copies(t):
+        return jax.tree_util.tree_map(jnp.copy, t)
+
+    out_c = steps_c.train_step(_copies(ps_c), _copies(ss_c), _copies(cs_c),
+                               {}, batch, 0.1, jax.random.key(7))
+    out_s = steps_s.train_step(_copies(ps_s), _copies(ss_s), _copies(cs_s),
+                               {}, batch, 0.1, jax.random.key(7))
+    a = np.asarray(steps_c.layout.unchunk(out_c[0]))
+    b = np.asarray(steps_s.layout.unchunk(out_s[0]))
+    close = bool(np.allclose(a, b, rtol=1e-5, atol=1e-7))
+    print(f"stream-sketch one-round ps allclose: {close} "
+          f"(max |Δ| {float(np.abs(a - b).max()):.2e}; wd!=0 reorders f32 "
+          f"sums — wd=0 bit-identity pinned in tests/test_stream_sketch.py)",
+          flush=True)
+    dt_c, rtt, _ = time_rounds(steps_c, (ps_c, ss_c, cs_c, {}), batch)
+    print(f"stream-sketch A/B composed round: {dt_c * 1e3:.2f} ms "
+          f"({1 / dt_c:.1f} r/s), rtt {rtt * 1e3:.0f} ms", flush=True)
+    dt_s, _, _ = time_rounds(steps_s, (ps_s, ss_s, cs_s, {}), batch)
+    print(f"stream-sketch A/B streaming round: {dt_s * 1e3:.2f} ms "
+          f"({1 / dt_s:.1f} r/s) | delta {(dt_c - dt_s) * 1e3:+.2f} ms = "
+          f"the movement win", flush=True)
+
+
 def gpt2_leg(bf16):
     steps, ps, ss, cs, batch, tokens = B.build_gpt2(bf16=bf16)
     # train_step donates ps/client_states: after this call the local
@@ -381,7 +420,7 @@ def imagenet_leg(bf16, microbatch):
 def main():
     """Leg names via argv select a subset (default: all)."""
     known = {"matmul", "cifar", "ops", "gpt2", "imagenet", "topk_ab",
-             "fused_epilogue"}
+             "fused_epilogue", "stream_sketch"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -412,6 +451,8 @@ def main():
     if sel("fused_epilogue"):
         leg("fused_epilogue-6.5M", fused_epilogue_leg, 6_568_640)
         leg("fused_epilogue-124M", fused_epilogue_leg, 124_444_417)
+    if sel("stream_sketch"):
+        leg("stream_sketch", stream_sketch_leg)
 
 
 if __name__ == "__main__":

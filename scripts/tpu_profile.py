@@ -38,8 +38,14 @@ if TARGET not in ("cifar", "gpt2"):
 # *_fused.md capture next to the composed one, so the fused-epilogue
 # before/after is two runs of this script + one profile_diff
 # (--preset fused-epilogue) — no hand-editing of captures.
+# TPU_PROFILE_STREAM=1 does the same for the --stream_sketch client phase
+# (*_stream.md capture; gate with profile_diff --preset stream-sketch).
 FUSED = os.environ.get("TPU_PROFILE_FUSED") == "1"
-_SUFFIX = "_fused" if FUSED else ""
+STREAM = os.environ.get("TPU_PROFILE_STREAM") == "1"
+if FUSED and STREAM:
+    sys.exit("set only one of TPU_PROFILE_FUSED / TPU_PROFILE_STREAM per "
+             "capture — a combined capture has no baseline to diff against")
+_SUFFIX = "_fused" if FUSED else ("_stream" if STREAM else "")
 OUT_MD = os.path.join(
     _REPO, "docs", "measurements",
     f"tpu_profile{_SUFFIX}.md" if TARGET == "cifar"
@@ -85,6 +91,25 @@ def _category(op_name: str) -> str:
          r"|_descent_pallas|compare_select_fusion|multiply_subtract_fusion"
          r"|convert_reduce_fusion[^=]*= s32\[(15|7|16)\]",
          "server epilogue (d-plane sweeps)"),
+        # Client flatten/movement (docs/stream_sketch.md): the d-sized
+        # 1-D layout ops the streaming sketch exists to delete — the
+        # flat-gradient concatenate of the backward pass, the pad/reshape
+        # pairs into and out of the (T, S, 128) chunk plane, the bf16/f32
+        # converts of the flat vector, and the flat slices/copies of the
+        # weight unravel. Matched by the leading mnemonic AND a 1-D result
+        # ≥ 10^6 elements (7+ digits — covers both the d=6.5M CIFAR and
+        # d=124M GPT-2 planes), so model activations (multi-dim) and the
+        # small per-leaf ops the streaming path keeps stay out of the
+        # bucket.
+        # Must come AFTER the epilogue pattern (its d-plane fusions keep
+        # their own bucket) and BEFORE the generic data-movement bucket.
+        # Caveat: the (T, S, 128)-RESULT half of a flat→chunk conversion
+        # (e.g. reshape.950) stays under "data movement" — its 1-D pad
+        # twin is in this bucket and the pair lives or dies together, so
+        # the gate still fires on any regression.
+        (r"\b(concatenate|pad|reshape|convert|slice|split|copy)[-_.\w]*\s*="
+         r"\s*\(?(f32|bf16|f16|s32|u32|pred)\[\d{7,}\]",
+         "client flatten/movement (d-sized)"),
         # the sharded server plane's transmit collectives (reduce-scatter
         # of the round transmit, update all-gather, the int8 collective's
         # all-to-all — docs/sharded_server.md) get their own bucket so
@@ -172,6 +197,8 @@ def write_report(plane, line, agg, wall_ms_per_round, backend, d, tiny,
             geom_t.format(d=f"{d:,}"))
     if FUSED:
         geom += ", --fused_epilogue"
+    if STREAM:
+        geom += ", --stream_sketch"
     os.makedirs(os.path.dirname(out_md), exist_ok=True)
     with open(out_md, "w") as f:
         f.write(f"# Per-op profile: {title}\n\n")
@@ -200,6 +227,17 @@ def write_report(plane, line, agg, wall_ms_per_round, backend, d, tiny,
                 f"counter the fused epilogue targets "
                 f"(docs/fused_epilogue.md; gate via scripts/profile_diff.py "
                 f"--preset fused-epilogue).\n")
+        # the streaming-sketch target metric (docs/stream_sketch.md): the
+        # d-sized 1-D concatenate/pad/reshape/convert movement count the
+        # leaf-streamed client phase exists to delete. Span-count based
+        # like the epilogue counter, so it is tenancy-robust.
+        fm_cnt, fm_ps = cats.get("client flatten/movement (d-sized)", (0, 0))
+        f.write(f"\nClient flatten/movement (d-sized): "
+                f"**{fm_cnt / ROUNDS:.1f} ops/round** "
+                f"({fm_ps / 1e9 / ROUNDS:.3f} ms/round) — the movement "
+                f"counter --stream_sketch targets (docs/stream_sketch.md; "
+                f"gate via scripts/profile_diff.py --preset "
+                f"stream-sketch).\n")
         f.write("\n## Top 40 ops\n\n")
         f.write("| op | count | total ms | ms/round | % busy |\n")
         f.write("|---|---|---|---|---|\n")
@@ -230,10 +268,11 @@ def main() -> int:
         if not on_tpu:
             print("gpt2 profile target is chip-only (d=124M)", flush=True)
             return 2
-        steps, ps, ss, cs, batch, _tokens = B.build_gpt2(bf16=True,
-                                                         fused_epilogue=FUSED)
+        steps, ps, ss, cs, batch, _tokens = B.build_gpt2(
+            bf16=True, fused_epilogue=FUSED, stream_sketch=STREAM)
     else:
-        steps, ps, ss, cs, batch = B.build(tiny=tiny, fused_epilogue=FUSED)
+        steps, ps, ss, cs, batch = B.build(tiny=tiny, fused_epilogue=FUSED,
+                                           stream_sketch=STREAM)
     d = int(ps.size)
 
     def drain(x):

@@ -1,0 +1,458 @@
+"""Streaming client-phase sketch (--stream_sketch, docs/stream_sketch.md).
+
+Contracts pinned on the forced-8-device CPU mesh:
+
+1. op level: streaming a vector through ``sketch_segment_accum`` calls in
+   offset order — any segmentation, any (mis)alignment, bf16 or f32
+   segments — equals the composed ``sketch_vec`` of the whole vector
+   (``==``: all-zero cells may differ in zero sign), on both the pure
+   path and the Pallas accumulate kernel through the interpreter;
+2. tree level: ``worker.sketch_grad_tree`` over a gradient pytree with
+   the ``ops/flat.leaf_segments`` offset map equals
+   ``sketch_vec(ravel_pytree(tree))`` across leaf-count/dtype mixes
+   (bf16 grads, fp32 table), and ``ops/flat.chunked_unravel`` rebuilds
+   the pytree from the resident chunk plane bit-exactly;
+3. round level: fp32 ``--stream_sketch`` trajectories and server/client
+   state are BIT-IDENTICAL to the composed fused path's across
+   replicated/``--server_shard`` × composed/``--fused_epilogue``
+   (megakernel through the Pallas interpreter), single microbatch and
+   wd=0 — the exact-equality window docs/stream_sketch.md documents;
+4. structure: the jitted streaming client phase contains NO d-sized
+   concatenate/pad/reshape (HLO inspection) and its scan carry is
+   table-sized, not d-sized (jaxpr walk) — while the composed build
+   demonstrably trips both detectors, so the asserts are not vacuous;
+5. rollout: COMMEFFICIENT_STREAM_SKETCH=0 restores the composed client
+   phase even with the flag on.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from commefficient_tpu.federated.rounds import (
+    RoundConfig,
+    build_round_step,
+    init_client_states,
+)
+from commefficient_tpu.federated.server import (
+    ServerConfig,
+    init_server_state,
+)
+from commefficient_tpu.federated.worker import WorkerConfig, sketch_grad_tree
+from commefficient_tpu.ops.flat import (
+    chunked_unravel,
+    leaf_segments,
+    ravel_pytree,
+)
+from commefficient_tpu.ops.sketch import (
+    make_sketch,
+    sketch_chunks_accum,
+    sketch_segment_accum,
+    sketch_vec,
+)
+from tests.test_sharded_server import N, _mesh
+
+
+# ---- 1. op-level: segment streaming == composed sketch ------------------
+
+class TestSegmentAccum:
+    # (d, c, r, segment boundaries) — unaligned cuts, single-element
+    # segments, cuts ON chunk/lane boundaries, one-segment degenerate
+    CASES = [
+        (5000, 512, 3, (0, 137, 138, 512, 129, 4000, 5000)),
+        (5000, 512, 3, (0, 5000)),
+        (1200, 128, 2, (0, 1, 2, 129, 128 * 4, 1200)),
+    ]
+
+    @staticmethod
+    def _cuts(bounds):
+        cuts = sorted(set(bounds))
+        return list(zip(cuts[:-1], cuts[1:]))
+
+    @pytest.mark.parametrize("d,c,r,bounds", CASES,
+                             ids=[f"d{d}-{len(b)}segs" for d, c, r, b
+                                  in CASES])
+    @pytest.mark.parametrize("interpret", [False, True],
+                             ids=["pure", "interpret"])
+    def test_streams_equal_composed(self, d, c, r, bounds, interpret):
+        cs = make_sketch(d, c, r, seed=7, num_blocks=2)
+        v = jnp.asarray(np.random.RandomState(3).randn(d), jnp.float32)
+        table = jnp.zeros(cs.table_shape, jnp.float32)
+        for a, b in self._cuts(bounds):
+            table = sketch_segment_accum(cs, table, v[a:b], a,
+                                         interpret=interpret)
+        want = sketch_vec(cs, v)
+        np.testing.assert_array_equal(np.asarray(table), np.asarray(want))
+
+    def test_bf16_segments_equal_f32_cast(self):
+        """bf16 grads, fp32 table: per-element bf16→f32 casts are exact,
+        so streaming bf16 segments equals sketching the f32-cast vector."""
+        cs = make_sketch(3000, 256, 3, seed=1, num_blocks=2)
+        v16 = jnp.asarray(np.random.RandomState(5).randn(3000),
+                          jnp.bfloat16)
+        table = jnp.zeros(cs.table_shape, jnp.float32)
+        for a, b in self._cuts((0, 300, 301, 2000, 3000)):
+            table = sketch_segment_accum(cs, table, v16[a:b], a)
+        want = sketch_vec(cs, v16.astype(jnp.float32))
+        np.testing.assert_array_equal(np.asarray(table), np.asarray(want))
+
+    def test_chunks_accum_continues_fold(self):
+        """Full-range accumulate onto a running table (the wd fold):
+        accumulating v onto sketch(u) == streaming u then v per cell."""
+        cs = make_sketch(2000, 256, 3, seed=2, num_blocks=2)
+        rng = np.random.RandomState(9)
+        u = jnp.asarray(rng.randn(2000), jnp.float32)
+        v = jnp.asarray(rng.randn(2000), jnp.float32)
+        base = sketch_vec(cs, u)
+        got = sketch_chunks_accum(cs, base, cs.chunk_layout.chunk(v))
+        want = sketch_segment_accum(cs, base, v, 0)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_empty_and_bounds(self):
+        cs = make_sketch(1000, 128, 2, seed=3, num_blocks=1)
+        t = jnp.zeros(cs.table_shape, jnp.float32)
+        out = sketch_segment_accum(cs, t, jnp.zeros(0), 500)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(t))
+        with pytest.raises(AssertionError):
+            sketch_segment_accum(cs, t, jnp.zeros(10), 995)  # past d
+
+
+# ---- 2. tree level: sketch_grad_tree + the offset map -------------------
+
+def _tree(dtype=jnp.float32, seed=0):
+    r = np.random.RandomState(seed)
+    return {
+        "block": {"w": jnp.asarray(r.randn(13, 31), dtype),
+                  "b": jnp.asarray(r.randn(31), dtype)},
+        "head": [jnp.asarray(r.randn(31, 7), dtype),
+                 jnp.asarray(r.randn(1), dtype)],
+        "scalar": jnp.asarray(r.randn(), dtype),
+    }
+
+
+class TestTreeStreaming:
+    def test_leaf_segments_match_ravel_layout(self):
+        tree = _tree()
+        flat, _ = ravel_pytree(tree)
+        segs = leaf_segments(tree)
+        assert segs[-1].offset + segs[-1].size == int(flat.size)
+        leaves = jax.tree_util.tree_leaves(tree)
+        for leaf, seg in zip(leaves, segs):
+            np.testing.assert_array_equal(
+                np.asarray(flat[seg.offset:seg.offset + seg.size]),
+                np.asarray(leaf, np.float32).reshape(-1),
+                err_msg=f"segment {seg.path} misplaced")
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                             ids=["f32", "bf16"])
+    def test_tree_stream_equals_ravel_sketch(self, dtype):
+        tree = _tree(dtype=dtype, seed=4)
+        flat, _ = ravel_pytree(tree)  # casts to f32 like the worker path
+        d = int(flat.size)
+        cs = make_sketch(d, 128, 3, seed=11, num_blocks=1)
+        table = sketch_grad_tree(cs, jnp.zeros(cs.table_shape, jnp.float32),
+                                 tree, leaf_segments(tree))
+        want = sketch_vec(cs, flat)
+        np.testing.assert_array_equal(np.asarray(table), np.asarray(want))
+
+    def test_per_leaf_scales(self):
+        """Per-leaf scalar rescales (the tp/ep constants) applied before
+        sketching equal scaling the flat vector with the segment mask —
+        exact for power-of-two factors."""
+        tree = _tree(seed=6)
+        flat, _ = ravel_pytree(tree)
+        d = int(flat.size)
+        segs = leaf_segments(tree)
+        scales = tuple(1.0 if i % 2 else 0.5 for i in range(len(segs)))
+        cs = make_sketch(d, 128, 3, seed=12, num_blocks=1)
+        got = sketch_grad_tree(cs, jnp.zeros(cs.table_shape, jnp.float32),
+                               tree, segs, scales=scales)
+        mask = np.zeros(d, np.float32)
+        for seg, sc in zip(segs, scales):
+            mask[seg.offset:seg.offset + seg.size] = sc
+        want = sketch_vec(cs, flat * jnp.asarray(mask))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_chunked_unravel_bit_exact(self):
+        """ops/flat.chunked_unravel == unravel(unchunk(·)) bitwise, with
+        every leaf sliced from its covering chunk rows (no d-sized op)."""
+        tree = _tree(seed=8)
+        flat, unravel = ravel_pytree(tree)
+        d = int(flat.size)
+        cs = make_sketch(d, 128, 3, seed=13, num_blocks=1)
+        layout = cs.chunk_layout
+        c3 = layout.chunk(flat)
+        tpl = jax.eval_shape(unravel,
+                             jax.ShapeDtypeStruct((d,), jnp.float32))
+        got = chunked_unravel(layout, tpl)(c3)
+        want = unravel(layout.unchunk(c3))
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            got, want)
+
+
+# ---- 3./4./5. round level on the 8-device mesh --------------------------
+
+IN, H = 6, 60  # 3-layer MLP: 6 leaves, d=4141, offsets straddle chunks
+
+
+def _mlp_params():
+    r = np.random.RandomState(0)
+    return {"w1": jnp.asarray(r.randn(IN, H) * 0.1, jnp.float32),
+            "b1": jnp.zeros(H),
+            "w2": jnp.asarray(r.randn(H, H) * 0.1, jnp.float32),
+            "b2": jnp.zeros(H),
+            "w3": jnp.asarray(r.randn(H, 1) * 0.1, jnp.float32),
+            "b3": jnp.zeros(1)}
+
+
+def _mlp_loss(params, model_state, batch, rng, train):
+    # pytree-native loss: no param ravel inside (raveling here would
+    # reintroduce the flat d-vector the streaming path deletes)
+    h = jnp.tanh(batch["inputs"] @ params["w1"] + params["b1"])
+    h = jnp.tanh(h @ params["w2"] + params["b2"])
+    pred = (h @ params["w3"] + params["b3"])[..., 0]
+    err = pred - batch["targets"]
+    m = batch["mask"]
+    return jnp.sum(0.5 * err ** 2 * m), (jnp.sum(jnp.abs(err) * m),), \
+        jnp.sum(m), model_state
+
+
+def _batch(seed=0, B=4):
+    r = np.random.RandomState(100 + seed)
+    return {"inputs": jnp.asarray(r.randn(N, B, IN), jnp.float32),
+            "targets": jnp.asarray(r.randn(N, B), jnp.float32),
+            "mask": jnp.ones((N, B), jnp.float32),
+            "client_ids": jnp.arange(N, dtype=jnp.int32),
+            "worker_mask": jnp.ones(N, jnp.float32)}
+
+
+def _build(stream, server_shard=False, fused=False):
+    """A placed sketch round on the 8-device mesh over the multi-leaf MLP
+    (T=33 chunks at c_pad=128, leaf offsets straddling chunk and lane
+    boundaries), with or without --stream_sketch — single microbatch,
+    wd=0: the documented exact-equality window."""
+    mesh = _mesh()
+    rep = NamedSharding(mesh, P())
+    params = _mlp_params()
+    flat, unravel = ravel_pytree(params)
+    d = int(flat.size)
+
+    def ravel(tree):
+        return ravel_pytree(tree)[0]
+
+    wcfg = WorkerConfig(mode="sketch", error_type="virtual", k=5,
+                        num_workers=N)
+    scfg = ServerConfig(mode="sketch", error_type="virtual", k=5,
+                        grad_size=d, virtual_momentum=0.9,
+                        fused_epilogue=fused)
+    cs_geo = make_sketch(d, 16, 3, seed=0, num_blocks=1)
+    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
+                      server_shard=server_shard, stream_sketch=stream)
+    steps = build_round_step(_mlp_loss, _mlp_loss, unravel, ravel, cfg,
+                             sketch=cs_geo, mesh=mesh)
+    ss = init_server_state(scfg, cs_geo)
+    ss = ss._replace(velocity=jax.device_put(ss.velocity, rep),
+                     error=jax.device_put(ss.error, rep))
+    ps = jax.device_put(steps.layout.chunk(flat), rep)
+    cstates = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, rep),
+        init_client_states(16, d, wcfg, init_weights=flat, sketch=cs_geo))
+    return steps, ps, ss, cstates, d
+
+
+def _run_rounds(steps, ps, ss, cstates, rounds=3, lr=0.1):
+    traj = []
+    for rnd in range(rounds):
+        ps, ss, cstates, _, _ = steps.train_step(
+            ps, ss, cstates, {}, _batch(seed=rnd), lr, jax.random.key(rnd))
+        traj.append(np.asarray(steps.layout.unchunk(ps)))
+    return traj, ss, cstates
+
+
+class TestStreamRoundBitIdentity:
+    """Acceptance criterion: fp32 --stream_sketch trajectories are
+    bit-identical to the composed path's across both server planes and
+    both epilogues."""
+
+    @pytest.mark.parametrize("shard", [False, True],
+                             ids=["replicated", "server_shard"])
+    @pytest.mark.parametrize("fused", [False, True],
+                             ids=["composed", "fused_epilogue"])
+    def test_trajectory_bit_identical(self, shard, fused, monkeypatch):
+        if fused:
+            # megakernel through the Pallas interpreter (the CPU suite's
+            # kernel path, bit-identical math — test_fused_epilogue.py)
+            monkeypatch.setenv("COMMEFFICIENT_FUSED_EPILOGUE", "interpret")
+        a, ssa, csa = _run_rounds(*_build(False, shard, fused)[:4])
+        b, ssb, csb = _run_rounds(*_build(True, shard, fused)[:4])
+        for rnd, (x, y) in enumerate(zip(a, b)):
+            np.testing.assert_array_equal(
+                x, y,
+                err_msg=f"shard={shard} fused={fused} round {rnd} ps")
+        for name in ("velocity", "error"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ssa, name)),
+                np.asarray(getattr(ssb, name)), err_msg=name)
+
+    def test_kill_switch_restores_composed(self, monkeypatch):
+        """COMMEFFICIENT_STREAM_SKETCH=0 must force the composed client
+        phase even with the flag on: the d-sized movement ops reappear in
+        the lowered HLO (structural evidence, not just equal numbers)."""
+        monkeypatch.setenv("COMMEFFICIENT_STREAM_SKETCH", "0")
+        steps, ps, ss, cstates, d = _build(True)
+        hits = _big_movement_ops(_client_hlo(steps, ps, cstates), d)
+        assert hits, "kill-switch build should contain d-sized movement"
+
+
+# ---- structural asserts: no d-sized movement, table-sized carry ---------
+
+_SHAPE_RE = re.compile(
+    r"tensor<([0-9]+(?:x[0-9]+)*)x(?:f32|f64|bf16|f16|i32|ui32|i8|i1)>")
+
+
+def _client_hlo(steps, ps, cstates, seed=0):
+    return steps.client_step.lower(
+        ps, cstates, {}, _batch(seed), 0.1, jax.random.key(seed)).as_text()
+
+
+def _big_movement_ops(hlo_text, threshold):
+    """Lines lowering to stablehlo concatenate/pad/reshape whose largest
+    tensor reaches ``threshold`` elements."""
+    hits = []
+    for line in hlo_text.splitlines():
+        m = re.search(r"stablehlo\.(concatenate|pad|reshape)", line)
+        if not m:
+            continue
+        sizes = [int(np.prod([int(x) for x in s.split("x")]))
+                 for s in _SHAPE_RE.findall(line)]
+        if sizes and max(sizes) >= threshold:
+            hits.append((m.group(1), max(sizes)))
+    return hits
+
+
+def _max_scan_carry(fn, *args):
+    """Largest scan-carry aval (elements) anywhere in the jaxpr,
+    descending into pjit/shard_map/scan sub-jaxprs."""
+    best = 0
+
+    def walk(jx):
+        nonlocal best
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                inner = eqn.params["jaxpr"].jaxpr
+                nc = eqn.params["num_carry"]
+                ncons = eqn.params["num_consts"]
+                for v in inner.invars[ncons:ncons + nc]:
+                    sz = int(np.prod(v.aval.shape)) if v.aval.shape else 1
+                    best = max(best, sz)
+            for val in eqn.params.values():
+                for j in (val if isinstance(val, (list, tuple)) else [val]):
+                    if hasattr(j, "eqns"):
+                        walk(j)
+                    elif hasattr(j, "jaxpr") and hasattr(j.jaxpr, "eqns"):
+                        walk(j.jaxpr)
+
+    walk(jax.make_jaxpr(fn)(*args).jaxpr)
+    return best
+
+
+class TestStreamStructure:
+    """Acceptance criterion: with --stream_sketch the jitted client phase
+    contains no d-sized concatenate/pad/reshape and its scan carry is
+    table-sized — asserted against the lowered HLO/jaxpr, with the
+    composed build proving the detectors actually fire."""
+
+    def test_no_d_sized_movement_and_small_carry(self):
+        steps_c, ps, ss, cstates, d = _build(False)
+        args_c = (ps, cstates, {}, _batch(0), 0.1, jax.random.key(0))
+        composed_hits = _big_movement_ops(_client_hlo(steps_c, ps, cstates),
+                                          d)
+        assert composed_hits, \
+            "detector is vacuous: composed build shows no d-sized movement"
+        composed_carry = _max_scan_carry(steps_c.client_step, *args_c)
+        assert composed_carry >= d, \
+            f"composed carry {composed_carry} should be d-sized (d={d})"
+
+        steps_s, ps_s, ss_s, cstates_s, _ = _build(True)
+        stream_hits = _big_movement_ops(
+            _client_hlo(steps_s, ps_s, cstates_s), d)
+        assert not stream_hits, \
+            f"streaming client phase has d-sized movement ops: {stream_hits}"
+        carry = _max_scan_carry(
+            steps_s.client_step, ps_s, cstates_s, {}, _batch(0), 0.1,
+            jax.random.key(0))
+        cs_geo = make_sketch(d, 16, 3, seed=0, num_blocks=1)
+        table_elems = int(np.prod(cs_geo.table_shape))
+        assert carry <= max(table_elems, 8 * N * 4), \
+            f"streaming scan carry {carry} is not table-sized " \
+            f"(table={table_elems}, d={d})"
+        assert carry < d
+
+
+# ---- CLI e2e: the entrypoint path, composed vs streaming ----------------
+
+class TestCLIEndToEnd:
+    def test_cv_train_stream_matches_composed(self, tmp_path, monkeypatch):
+        """--stream_sketch through the real cv_train CLI reproduces the
+        composed run's epoch summary EXACTLY (wd=0 + whole-batch
+        microbatching = the documented bit-identity window; the summary's
+        loss/acc means are pure functions of the round trajectory)."""
+        import cv_train
+
+        monkeypatch.setenv("COMMEFFICIENT_TINY_MODEL", "1")
+        monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "24")
+
+        def run(extra, subdir):
+            argv = [
+                "--dataset_name", "CIFAR10",
+                "--dataset_dir", str(tmp_path / subdir),
+                "--num_epochs", "1",
+                "--num_workers", "2",
+                "--local_batch_size", "4",
+                "--valid_batch_size", "8",
+                "--lr_scale", "0.01",
+                "--pivot_epoch", "0.5",
+                "--seed", "0",
+                "--iid", "--num_clients", "4",
+                "--mode", "sketch", "--error_type", "virtual",
+                "--local_momentum", "0", "--virtual_momentum", "0.9",
+                "--weight_decay", "0",
+                "--k", "500", "--num_cols", "2048", "--num_rows", "3",
+                "--num_blocks", "2",
+            ] + extra
+            return cv_train.main(argv)
+
+        a = run([], "a")
+        b = run(["--stream_sketch"], "a")  # same synthetic data dir
+        for key in ("train_loss", "train_acc", "test_loss", "test_acc"):
+            assert a[key] == b[key], \
+                f"{key}: composed {a[key]!r} != streaming {b[key]!r}"
+
+
+# ---- engine invariant: streaming adds no host syncs ---------------------
+
+class TestStreamNoHostSyncs:
+    def test_dispatch_loop_zero_syncs(self):
+        from commefficient_tpu.profiling import host_sync_monitor
+
+        steps, ps, ss, cstates, _ = _build(True)
+        out = steps.train_step(ps, ss, cstates, {}, _batch(0), 0.1,
+                               jax.random.key(0))
+        jax.block_until_ready(out[0])
+        state = out[:4]
+        with host_sync_monitor() as counter:
+            for rnd in range(1, 3):
+                out = steps.train_step(*state, _batch(rnd), 0.1,
+                                       jax.random.key(rnd))
+                state = out[:4]
+        jax.block_until_ready(state[0])
+        assert counter.count == 0, \
+            f"streaming round dispatched {counter.count} blocking fetches"
