@@ -40,6 +40,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import NamedTuple
 
 BASELINE_ROUNDS_PER_SEC = 5.5
 
@@ -148,7 +149,8 @@ _T0 = time.monotonic()
 def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
           mode: str = "sketch", num_workers: int = NUM_WORKERS,
           server_shard: bool = False, fused_epilogue: bool = False,
-          guards: bool = False, stream_sketch: bool = False):
+          guards: bool = False, stream_sketch: bool = False,
+          telemetry: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -199,7 +201,7 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
         if mode == "sketch" else None
     cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
                       server_shard=server_shard, guards=guards,
-                      stream_sketch=stream_sketch)
+                      stream_sketch=stream_sketch, telemetry=telemetry)
     loss_train, loss_val = make_cv_losses(model)
     # the entrypoints' real execution path: shard_map+psum over a clients
     # mesh — a 1-device mesh on the single bench chip
@@ -534,64 +536,99 @@ def run_measurement(tiny: bool) -> None:
     }), flush=True)
 
 
-# one measure-and-emit path for every CIFAR-family config leg:
-# name -> (mode, workers, baseline r/s, num_classes, non_iid, K,
-#          server_shard, fused_epilogue, guards, stream_sketch, label).
-# K multi-rounds per dispatch via lax.scan: the cheap c1/c2 rounds are
-# smaller than the ~40 ms tunnel rtt, so 20 single-round dispatches would
-# measure transport noise (and raising the dispatch count instead wedges
-# the tunnel — 50+ unsynced steps, BASELINE.md); K rounds inside ONE
-# dispatch keep the queue shallow while the timed region grows K x.
+class CfgLeg(NamedTuple):
+    """One measure-and-emit CIFAR-family config leg. Feature flags are
+    keyword defaults so a new RoundConfig flag is one new field here, not
+    a positional False appended to every leg (a miscounted positional
+    silently flips the wrong feature while the label still reads right).
+
+    ``k_rounds`` multi-rounds per dispatch via lax.scan: the cheap c1/c2
+    rounds are smaller than the ~40 ms tunnel rtt, so 20 single-round
+    dispatches would measure transport noise (and raising the dispatch
+    count instead wedges the tunnel — 50+ unsynced steps, BASELINE.md);
+    K rounds inside ONE dispatch keep the queue shallow while the timed
+    region grows K x."""
+
+    mode: str
+    workers: int
+    baseline: str  # baseline r/s constant name
+    label: str
+    num_classes: int = 10
+    non_iid: bool = False
+    k_rounds: int = 1
+    server_shard: bool = False
+    fused_epilogue: bool = False
+    guards: bool = False
+    stream_sketch: bool = False
+    telemetry: bool = False
+
+
 _CFG_LEGS = {
-    "c1": ("uncompressed", 1, "BASELINE_C1", 10, False, 20, False, False,
-           False, False, "1-worker uncompressed rounds/sec/chip (ResNet9)"),
-    "c2": ("true_topk", 8, "BASELINE_C2", 10, False, 10, False, False,
-           False, False,
-           "8-worker true-topk rounds/sec/chip (ResNet9, k=50k)"),
-    "cifar100": ("sketch", 8, "BASELINE_CIFAR100", 100, True, 1, False,
-                 False, False, False,
-                 "CIFAR100/FEMNIST-style non-IID sketched rounds/sec/chip "
-                 "(ResNet9-100, 500 clients, 8 workers, sketch 5x500k "
-                 "k=50k)"),
+    "c1": CfgLeg("uncompressed", 1, "BASELINE_C1",
+                 "1-worker uncompressed rounds/sec/chip (ResNet9)",
+                 k_rounds=20),
+    "c2": CfgLeg("true_topk", 8, "BASELINE_C2",
+                 "8-worker true-topk rounds/sec/chip (ResNet9, k=50k)",
+                 k_rounds=10),
+    "cifar100": CfgLeg("sketch", 8, "BASELINE_CIFAR100",
+                       "CIFAR100/FEMNIST-style non-IID sketched "
+                       "rounds/sec/chip (ResNet9-100, 500 clients, "
+                       "8 workers, sketch 5x500k k=50k)",
+                       num_classes=100, non_iid=True),
     # the headline sketch leg with the sharded server data plane
     # (--server_shard, docs/sharded_server.md); its baseline anchor is the
     # headline config-3 estimate so BENCH readers can compare the two legs
     # directly. Per-shard server work only drops on a multi-chip mesh, so
     # on the 1-chip bench this leg pins NO-regression with the plane on;
     # on a multi-chip mesh it measures the win.
-    "shard": ("sketch", 8, "BASELINE", 10, False, 1, True, False, False,
-              False,
-              "8-worker sketched rounds/sec/chip with --server_shard "
-              "(ResNet9, sketch 5x500k k=50k, sharded server data plane)"),
+    "shard": CfgLeg("sketch", 8, "BASELINE",
+                    "8-worker sketched rounds/sec/chip with --server_shard "
+                    "(ResNet9, sketch 5x500k k=50k, sharded server data "
+                    "plane)",
+                    server_shard=True),
     # the headline sketch leg with the fused server epilogue
     # (--fused_epilogue, docs/fused_epilogue.md); same config-3 baseline
     # anchor so the fused-vs-composed delta reads straight off the two
     # legs (mfu_attack_r5.md projects ~2.3 ms/round ≈ 32% MFU if the
     # fusion fully lands).
-    "fused": ("sketch", 8, "BASELINE", 10, False, 1, False, True, False,
-              False,
-              "8-worker sketched rounds/sec/chip with --fused_epilogue "
-              "(ResNet9, sketch 5x500k k=50k, one-sweep server epilogue)"),
+    "fused": CfgLeg("sketch", 8, "BASELINE",
+                    "8-worker sketched rounds/sec/chip with "
+                    "--fused_epilogue (ResNet9, sketch 5x500k k=50k, "
+                    "one-sweep server epilogue)",
+                    fused_epilogue=True),
     # the headline sketch leg with on-device health guards (--guards,
     # docs/fault_tolerance.md); same config-3 baseline anchor, so
     # guarded-vs-unguarded steady-state overhead reads straight off this
     # leg vs the headline (the guard is two scalar isfinite reductions +
     # a handful of d-plane selects riding the existing epilogue sweeps —
     # expected low single-digit %).
-    "guards": ("sketch", 8, "BASELINE", 10, False, 1, False, False, True,
-               False,
-               "8-worker sketched rounds/sec/chip with --guards (ResNet9, "
-               "sketch 5x500k k=50k, on-device health guards)"),
+    "guards": CfgLeg("sketch", 8, "BASELINE",
+                     "8-worker sketched rounds/sec/chip with --guards "
+                     "(ResNet9, sketch 5x500k k=50k, on-device health "
+                     "guards)",
+                     guards=True),
     # the headline sketch leg with the streaming client-phase sketch
     # (--stream_sketch, docs/stream_sketch.md); same config-3 baseline
     # anchor so the stream-vs-composed delta reads straight off the two
     # legs. NOTE the leg includes the wd segment-sketch (bench wd=5e-4),
     # so it measures the honest production shape, not the wd=0 best case.
-    "stream": ("sketch", 8, "BASELINE", 10, False, 1, False, False, False,
-               True,
-               "8-worker sketched rounds/sec/chip with --stream_sketch "
-               "(ResNet9, sketch 5x500k k=50k, streaming client-phase "
-               "sketch)"),
+    "stream": CfgLeg("sketch", 8, "BASELINE",
+                     "8-worker sketched rounds/sec/chip with "
+                     "--stream_sketch (ResNet9, sketch 5x500k k=50k, "
+                     "streaming client-phase sketch)",
+                     stream_sketch=True),
+    # the headline sketch leg with the telemetry plane's on-device round
+    # metrics (--telemetry, docs/observability.md); same config-3 baseline
+    # anchor so the telemetry-on overhead reads straight off this leg vs
+    # the headline. The metrics are ~a dozen scalar reductions over planes
+    # the epilogue already reads — the documented overhead gate is <= 2%
+    # rounds/sec (docs/observability.md overhead ledger; number pending a
+    # chip window).
+    "telemetry": CfgLeg("sketch", 8, "BASELINE",
+                        "8-worker sketched rounds/sec/chip with "
+                        "--telemetry (ResNet9, sketch 5x500k k=50k, "
+                        "on-device round metrics)",
+                        telemetry=True),
 }
 
 
@@ -605,17 +642,19 @@ def run_config_measurement(name: str) -> None:
     from jax import lax
 
     _check_pallas_kernel()
-    (mode, W, base_name, num_classes, non_iid, K, server_shard,
-     fused_epilogue, guards, stream_sketch, label) = _CFG_LEGS[name]
+    leg = _CFG_LEGS[name]
+    W, K, label = leg.workers, leg.k_rounds, leg.label
+    num_classes = leg.num_classes
     base = {"BASELINE": BASELINE_ROUNDS_PER_SEC,
             "BASELINE_C1": BASELINE_C1_ROUNDS_PER_SEC,
             "BASELINE_C2": BASELINE_C2_ROUNDS_PER_SEC,
-            "BASELINE_CIFAR100": BASELINE_CIFAR100_ROUNDS_PER_SEC}[base_name]
+            "BASELINE_CIFAR100":
+                BASELINE_CIFAR100_ROUNDS_PER_SEC}[leg.baseline]
     steps, ps, server_state, client_states, batch = build(
-        tiny=False, num_classes=num_classes, non_iid=non_iid, mode=mode,
-        num_workers=W, server_shard=server_shard,
-        fused_epilogue=fused_epilogue, guards=guards,
-        stream_sketch=stream_sketch)
+        tiny=False, num_classes=num_classes, non_iid=leg.non_iid,
+        mode=leg.mode, num_workers=W, server_shard=leg.server_shard,
+        fused_epilogue=leg.fused_epilogue, guards=leg.guards,
+        stream_sketch=leg.stream_sketch, telemetry=leg.telemetry)
     if K > 1:
         inner = steps.train_step
 
@@ -647,7 +686,7 @@ def run_config_measurement(name: str) -> None:
                                   4),
         "platform": jax.default_backend(),
     }
-    if base_name in ("BASELINE", "BASELINE_C1", "BASELINE_C2"):
+    if leg.baseline in ("BASELINE", "BASELINE_C1", "BASELINE_C2"):
         # these anchors are analytic estimates of the reference's A100
         # throughput (derived FLOP/dispatch arithmetic above), never
         # measured; flag it so a BENCH artifact reader can tell these
@@ -734,6 +773,8 @@ _EXTRA_LEGS = {
                "guards_rounds_per_sec"),
     "stream": (["--run-cfg", "stream"], "BENCH_C12_TIMEOUT", 900,
                "stream_rounds_per_sec"),
+    "telemetry": (["--run-cfg", "telemetry"], "BENCH_C12_TIMEOUT", 900,
+                  "telemetry_rounds_per_sec"),
 }
 
 
@@ -795,7 +836,8 @@ def _fresh_or_cached_extras(result: dict, run_fresh: bool = True,
     (VERDICT r3 #1). A cached leg from a DIFFERENT head is re-run by
     default — a stale number silently mixed two code generations into one
     artifact (BENCH_r05 c2/gpt2 legs); it is only used as the fallback
-    when the fresh run fails, clearly marked ``stale_head``.
+    when the fresh run fails, clearly marked ``stale_head`` (and listed in
+    the artifact's top-level ``"stale"`` list — see below).
     ``allow_stale`` (--allow_stale_cache / BENCH_ALLOW_STALE_CACHE=1)
     restores the old behavior for tunnel-down windows where re-running is
     known hopeless. The cache stamp (measured_at @ head) is copied into
@@ -803,6 +845,7 @@ def _fresh_or_cached_extras(result: dict, run_fresh: bool = True,
     to force fresh runs."""
     max_age = float(os.environ.get("BENCH_EXTRAS_MAX_AGE", 12 * 3600))
     extras_out = {}
+    stale_legs = []
     cache = _load_extras()
     head_now = _git_head()
 
@@ -811,12 +854,17 @@ def _fresh_or_cached_extras(result: dict, run_fresh: bool = True,
 
     def _mark_stale(leg, cached):
         # a cached leg measured at a different commit can silently mix two
-        # code generations into one artifact — make that explicit
+        # code generations into one artifact — make that explicit, BOTH
+        # as the per-leg key and in the artifact's top-level "stale" list
+        # (a reader scanning the summary must not mistake a stale leg for
+        # a fresh number; the buried extra key alone proved too easy to
+        # miss — BENCH_r05's gpt2/c2 legs)
         if _is_stale(cached):
             _log(f"extra leg {leg}: cached head {cached.get('head')} != "
                  f"current {head_now} — marking stale_head")
             extras_out[f"{leg}_stale_head"] = (f"{cached.get('head')} != "
                                                f"{head_now}")
+            stale_legs.append(leg)
 
     for leg in _EXTRA_LEGS:
         cached = cache.get(leg)
@@ -853,6 +901,10 @@ def _fresh_or_cached_extras(result: dict, run_fresh: bool = True,
         else:
             extras_out[f"{leg}_error"] = err
     result["extra"] = extras_out
+    # top-level staleness summary: always present (empty = every reported
+    # leg was measured at the current HEAD), so artifact consumers check
+    # ONE key instead of grepping extra for *_stale_head suffixes
+    result["stale"] = sorted(stale_legs)
 
 
 def _run_leg(leg: str):
@@ -1015,11 +1067,12 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--run-cfg":
         sel = sys.argv[2] if len(sys.argv) >= 3 else "<missing>"
-        if sel not in ("c1", "c2", "shard", "fused", "guards", "stream"):
+        if sel not in ("c1", "c2", "shard", "fused", "guards", "stream",
+                       "telemetry"):
             # a missing/typo'd operand must never fall through to the full
             # parent orchestration and claim the chip for a headline bench
             sys.exit(f"--run-cfg: unknown config {sel!r}; use "
-                     f"c1|c2|shard|fused|guards|stream")
+                     f"c1|c2|shard|fused|guards|stream|telemetry")
         run_config_measurement(sel)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--capture":

@@ -299,6 +299,25 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--client_dropout", type=float, default=0.0,
                         help="Per-round probability that a sampled client "
                              "drops out (0 disables).")
+    # Zero-sync telemetry plane (docs/observability.md): on-device round
+    # metrics computed inside the jitted server phase (norms of the
+    # transmit / update / error-feedback carries, resolved top-k
+    # threshold, guard detail) ride the batched metric drain into a
+    # structured per-run JSONL event log with round-lifecycle spans
+    # (dispatch -> device compute -> drain, in-flight occupancy). ON by
+    # default: the overhead budget is <= 2% rounds/sec (the bench
+    # `telemetry` A/B leg measures it) and the fp32 trajectory is
+    # bit-identical either way (tests/test_telemetry.py). Render the log
+    # with scripts/obs_report.py.
+    parser.add_argument("--telemetry", action="store_true", dest="telemetry",
+                        default=True,
+                        help="Per-round on-device metrics + JSONL run "
+                             "event log (docs/observability.md; the "
+                             "default).")
+    parser.add_argument("--no_telemetry", action="store_false",
+                        dest="telemetry",
+                        help="Disable the telemetry plane (bit-identical "
+                             "trajectories either way).")
     # On-device health guards + quarantine (docs/fault_tolerance.md): a
     # scalar finiteness/magnitude verdict per round, riding the batched
     # metric drain (zero extra host syncs). A tripped round's contribution

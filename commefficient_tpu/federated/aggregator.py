@@ -81,7 +81,15 @@ class RoundHandle(NamedTuple):
     ``guard`` (--guards, docs/fault_tolerance.md) is the round's on-device
     health verdict — a device bool attached by ``seal_round`` after the
     server phase and materialized with the batched drain, so guard
-    bookkeeping adds zero per-round host syncs."""
+    bookkeeping adds zero per-round host syncs.
+
+    ``telemetry`` (--telemetry, docs/observability.md) is the round's
+    fixed-schema on-device metrics vector
+    (telemetry.device_round_metrics), attached by ``seal_round`` exactly
+    like the guard verdict and materialized with the same batched drain —
+    the telemetry plane rides the existing sync budget. ``round_no`` is
+    the model's global dispatch index (host int), the one key the engine
+    spans, heartbeats, and the event log all share."""
 
     metrics: Tuple[Any, ...]
     valid: np.ndarray
@@ -89,6 +97,14 @@ class RoundHandle(NamedTuple):
     download: Optional[Any]
     upload: np.ndarray
     guard: Optional[Any] = None
+    telemetry: Optional[Any] = None
+    round_no: int = -1
+    # per-participant staleness in rounds (host int array, download regime
+    # (b) only — the device-resident accounting already holds each
+    # client's last participation round, so the cohort staleness the FL
+    # practicality survey flags is free to surface): rounds since each
+    # participating client last joined a round. None in regime (a).
+    staleness: Optional[np.ndarray] = None
 
 
 @jax.jit
@@ -270,6 +286,16 @@ class FedModel:
         # d-vector; rounds.build_round_step composes silently when the
         # config is outside the legal window (the fused-epilogue pattern).
         self._stream_sketch = bool(getattr(args, "stream_sketch", False))
+        # Zero-sync telemetry plane (--telemetry, docs/observability.md):
+        # the jitted server phase returns one extra fixed-schema device
+        # metrics vector per round; it rides the round handle to the
+        # batched drain (seal_round / finish_round) and lands in the
+        # RunTelemetry event log when one is attached (self.telemetry,
+        # set by the entrypoints via telemetry.attach_run_telemetry).
+        self._telemetry_cfg = bool(getattr(args, "telemetry", False))
+        self.telemetry = None  # RunTelemetry recorder (host-side sink)
+        self._pending_telemetry = None
+        self._last_staleness = None  # cohort staleness of the last dispatch
         cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=self.grad_size,
                           do_test=args.do_test, tp_sliced=tp_sliced,
                           ep_sliced=ep_sliced,
@@ -277,7 +303,8 @@ class FedModel:
                           reduce_dtype=self._reduce_dtype,
                           stream_sketch=self._stream_sketch,
                           guards=self._guards,
-                          guard_max_abs=self._guard_max_abs)
+                          guard_max_abs=self._guard_max_abs,
+                          telemetry=self._telemetry_cfg)
         from commefficient_tpu.federated.losses import make_cv_losses  # noqa: F401
 
         self.steps = build_round_step(
@@ -434,6 +461,14 @@ class FedModel:
     # -- state access ------------------------------------------------------
 
     @property
+    def rounds_dispatched(self) -> int:
+        """Global dispatch count: the last dispatched round's
+        ``RoundHandle.round_no`` is ``rounds_dispatched - 1`` — the one
+        round key the telemetry event log, engine spans, and heartbeats
+        share (docs/observability.md)."""
+        return self._rounds_dispatched
+
+    @property
     def params(self):
         if self.layout is not None:
             return self.unravel(self.layout.unchunk(self.ps_weights))
@@ -544,9 +579,11 @@ class FedModel:
             print(f"inject_fault: poisoned round {round_no} transmit "
                   f"with {poison}")
         self._round_ctx = ctx
+        staleness, self._last_staleness = self._last_staleness, None
         return RoundHandle(metrics=metrics, valid=wmask > 0,
                            participating=participating,
-                           download=download_dev, upload=upload)
+                           download=download_dev, upload=upload,
+                           round_no=round_no, staleness=staleness)
 
     def finish_round(self, handle: RoundHandle):
         """Materialize a dispatched round's results — the ONE blocking host
@@ -563,23 +600,51 @@ class FedModel:
         *ms, count = (materialize(m) for m in handle.metrics)
         download = self._materialize_download(handle.participating,
                                               handle.download)
+        guard_ok = None
         if handle.guard is not None:
-            self._note_guard(bool(materialize(handle.guard)))
+            guard_ok = bool(materialize(handle.guard))
+        if handle.telemetry is not None and self.telemetry is not None:
+            # the round's device metrics vector — part of the SAME batched
+            # drain (one counted materialize), recorded before the guard
+            # ladder below so a fatal escalation still leaves this round's
+            # metrics in the event log
+            from commefficient_tpu.telemetry import METRIC_FIELDS
+
+            vals = materialize(handle.telemetry)
+            loss = (float(np.mean(ms[0][handle.valid]))
+                    if len(ms) and np.any(handle.valid) else None)
+            cohort = {"participants": int(len(handle.participating)),
+                      "slots": int(np.sum(handle.valid))}
+            if handle.staleness is not None and len(handle.staleness):
+                # cohort staleness (rounds since each participant's last
+                # round) — host data captured at dispatch, regime (b)
+                cohort["staleness_mean"] = float(
+                    np.mean(handle.staleness))
+                cohort["staleness_max"] = int(np.max(handle.staleness))
+            self.telemetry.on_metrics(
+                handle.round_no,
+                {k: float(v) for k, v in zip(METRIC_FIELDS, vals)},
+                loss=loss, guard_ok=guard_ok, cohort=cohort)
+        if guard_ok is not None:
+            self._note_guard(guard_ok, round_no=handle.round_no)
         return [m[handle.valid] for m in ms] + [download, handle.upload]
 
     # -- fault tolerance (--guards, docs/fault_tolerance.md) ---------------
 
     def seal_round(self, handle: RoundHandle) -> RoundHandle:
-        """Attach the just-applied server phase's health verdict to its
-        round handle (called by the engine after ``opt.step()``; the
-        verdict stays a device scalar until the batched drain)."""
-        if self._pending_guard is None:
-            return handle
-        sealed = handle._replace(guard=self._pending_guard)
-        self._pending_guard = None
-        return sealed
+        """Attach the just-applied server phase's health verdict and
+        telemetry metrics to their round handle (called by the engine
+        after ``opt.step()``; both stay device arrays until the batched
+        drain)."""
+        if self._pending_guard is not None:
+            handle = handle._replace(guard=self._pending_guard)
+            self._pending_guard = None
+        if self._pending_telemetry is not None:
+            handle = handle._replace(telemetry=self._pending_telemetry)
+            self._pending_telemetry = None
+        return handle
 
-    def _note_guard(self, ok: bool) -> None:
+    def _note_guard(self, ok: bool, round_no: int = -1) -> None:
         """Host-side reaction ladder to a drained guard verdict:
 
         1. isolated trip — the in-step quarantine already discarded the
@@ -604,7 +669,16 @@ class FedModel:
         print(f"HEALTH GUARD tripped (trip {self.guard_trips}, "
               f"{self._consecutive_trips} consecutive): round quarantined — "
               "contribution and error-feedback carry discarded")
+        if self.telemetry is not None:
+            # immediate event (not buffered with the round spans): a fatal
+            # escalation below must still leave the trip in the log
+            self.telemetry.event("guard_trip", round=round_no,
+                                 trip=self.guard_trips,
+                                 consecutive=self._consecutive_trips)
         if self._consecutive_trips >= self._max_guard_trips:
+            if self.telemetry is not None:
+                self.telemetry.event("guard_fatal", round=round_no,
+                                     consecutive=self._consecutive_trips)
             raise RuntimeError(
                 f"health guard tripped {self._consecutive_trips} consecutive "
                 f"rounds (--max_guard_trips {self._max_guard_trips}): the "
@@ -614,6 +688,9 @@ class FedModel:
                 "checkpoint with --resume auto.")
         if self._consecutive_trips >= 2 and self._snapshot is not None:
             self._restore_snapshot()
+            if self.telemetry is not None:
+                self.telemetry.event("rollback", round=round_no,
+                                     consecutive=self._consecutive_trips)
 
     def _take_snapshot(self) -> None:
         """Refresh the device-resident last-good snapshot (ps weights,
@@ -677,11 +754,15 @@ class FedModel:
             self.client_states = self._row_stream.scatter(
                 self.client_states, stream, old, new_proxy)
             self._stream_round = None
+        # trailing step outputs, in server_step's order (guard first, then
+        # telemetry) — device arrays held for seal_round; fetching either
+        # here would be the per-round blocking sync the engine removes
+        idx = 3
         if self._guards:
-            # the round's health verdict — a device scalar held for
-            # seal_round; fetching it here would be the per-round blocking
-            # sync the engine exists to remove
-            self._pending_guard = out[3]
+            self._pending_guard = out[idx]
+            idx += 1
+        if self._telemetry_cfg:
+            self._pending_telemetry = out[idx]
         self.ps_weights = new_ps
         self._round_ctx = None
         return new_ss
@@ -742,6 +823,13 @@ class FedModel:
                                     jnp.int32)
                 download_dev = _changed_since_counts(self._last_changed,
                                                      since)
+            # cohort staleness hook (telemetry, docs/observability.md):
+            # rounds since each participant last joined — read from the
+            # accounting state this branch already consults, BEFORE the
+            # fold below advances it. Pure host arithmetic.
+            self._last_staleness = (
+                self._round_idx
+                - self._client_part_round[participating]).astype(np.int64)
             self._client_part_round[participating] = self._round_idx
         return download_dev, upload
 

@@ -36,10 +36,13 @@ seam). ``bench.py`` reports the measured count per round.
 
 from __future__ import annotations
 
+import time
 from collections import deque
-from typing import Any, Deque, List, NamedTuple, Tuple
+from typing import Any, Deque, List, NamedTuple, Optional, Tuple
 
 import jax
+
+from commefficient_tpu.profiling import Heartbeat
 
 __all__ = ["RoundResult", "PipelinedRoundEngine"]
 
@@ -71,7 +74,8 @@ class PipelinedRoundEngine:
     """
 
     def __init__(self, model, opt, lr_scheduler=None, window: int = 2,
-                 drain_every: int = 8):
+                 drain_every: int = 8, telemetry=None,
+                 heartbeat: Optional[Heartbeat] = None):
         assert window >= 1, "in-flight window must be at least 1"
         assert drain_every >= 1, "drain_every must be at least 1"
         self.model = model
@@ -83,10 +87,29 @@ class PipelinedRoundEngine:
         self._next_index = 0
         self.rounds_submitted = 0
         self.drains = 0
+        # Telemetry plane (docs/observability.md): the engine records the
+        # round-lifecycle spans the host holds for free — dispatch start,
+        # seal, the window wait's completion stamp, drain fetch latency,
+        # in-flight occupancy. Span data buffers in memory and is written
+        # only when the round drains, so the dispatch path stays fetch-free
+        # (the zero-syncs audit covers telemetry-on runs,
+        # tests/test_telemetry.py). Defaults to the model's attached
+        # recorder (telemetry.attach_run_telemetry).
+        self.telemetry = (telemetry if telemetry is not None
+                          else getattr(model, "telemetry", None))
+        # Engine-owned liveness heartbeat (scripts/crash_matrix.py,
+        # docs/fault_tolerance.md): one flushed stderr line per DRAINED
+        # round, carrying the telemetry round index — the model's global
+        # dispatch counter (RoundHandle.round_no), monotonic across epochs
+        # and engine instances, so an external supervisor can target an
+        # absolute round without counting lines. Armed by
+        # COMMEFFICIENT_HEARTBEAT=1 (a no-op otherwise).
+        self.heartbeat = heartbeat if heartbeat is not None else Heartbeat()
 
     def submit(self, batch) -> List[RoundResult]:
         """Dispatch one training round; no blocking host transfer happens
         here unless this is a drain round (every ``drain_every``-th)."""
+        t_start = time.monotonic()
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         handle = self.model.begin_round(batch)
@@ -94,32 +117,58 @@ class PipelinedRoundEngine:
         seal = getattr(self.model, "seal_round", None)
         if seal is not None:
             # attach the server phase's on-device health verdict (--guards,
-            # docs/fault_tolerance.md) to the handle it belongs to; still a
-            # device scalar — it drains with the batched metrics
+            # docs/fault_tolerance.md) and telemetry metrics vector
+            # (--telemetry) to the handle they belong to; still device
+            # arrays — they drain with the batched metrics
             handle = seal(handle)
         self._pending.append((self._next_index, handle))
         self._next_index += 1
         self.rounds_submitted += 1
+        if self.telemetry is not None:
+            self.telemetry.on_dispatch(
+                self._round_no(handle, self._next_index - 1), t_start,
+                occupancy=len(self._pending))
 
         if len(self._pending) > self.window:
             # bound host run-ahead: wait for the computation of the round
             # `window` back — completion only, its values stay on device
-            _, old = self._pending[-1 - self.window]
+            oidx, old = self._pending[-1 - self.window]
             jax.block_until_ready(old.metrics)
+            if self.telemetry is not None:
+                # the wait doubles as the round's device-completion stamp
+                self.telemetry.on_complete(self._round_no(old, oidx))
 
         if len(self._pending) >= self.drain_every:
             return self.drain()
         return []
 
+    @staticmethod
+    def _round_no(handle, fallback: int) -> int:
+        """The handle's global dispatch index (RoundHandle.round_no); falls
+        back to the engine-local index for handle types that predate it."""
+        rn = getattr(handle, "round_no", -1)
+        return rn if rn >= 0 else fallback
+
     def drain(self) -> List[RoundResult]:
         """Materialize every dispatched-but-unfetched round, oldest first —
         the batched host sync. Safe to call with nothing pending."""
         results = []
+        t0 = time.monotonic()
         while self._pending:
             idx, handle = self._pending.popleft()
+            t_fetch = time.monotonic()
             results.append(RoundResult(idx, self.model.finish_round(handle)))
+            rn = self._round_no(handle, idx)
+            self.heartbeat.round(rn)
+            if self.telemetry is not None:
+                self.telemetry.on_drained(rn,
+                                          time.monotonic() - t_fetch)
         if results:
             self.drains += 1
+            if self.telemetry is not None:
+                self.telemetry.event(
+                    "drain", rounds=len(results),
+                    ms=round((time.monotonic() - t0) * 1e3, 3))
         return results
 
     @property

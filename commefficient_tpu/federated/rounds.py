@@ -216,13 +216,28 @@ class RoundConfig:
     guards: bool = False
     # Magnitude ceiling for the guard (0 = finiteness-only).
     guard_max_abs: float = 0.0
+    # Zero-sync telemetry plane (--telemetry, docs/observability.md): the
+    # server phase additionally returns one fixed-schema
+    # (len(telemetry.METRIC_FIELDS),) f32 device vector of round metrics
+    # (transmit/update/carry norms, resolved top-k threshold, guard
+    # detail — telemetry.device_round_metrics). Pure reductions over
+    # planes the epilogue already reads: the state transition is
+    # untouched, so fp32 trajectories are bit-identical with telemetry on
+    # or off (pinned in tests/test_telemetry.py on both server planes),
+    # and the vector rides the round handle to the batched drain exactly
+    # like the guard verdict (zero extra host syncs).
+    telemetry: bool = False
 
 
 class FederatedSteps(NamedTuple):
     """With ``RoundConfig.guards`` on, ``server_step`` returns one extra
     trailing element (the device health-verdict scalar of
-    server.round_health) and ``train_step`` likewise — callers that enable
-    guards unpack the extra scalar; the arity is unchanged otherwise."""
+    server.round_health), and with ``RoundConfig.telemetry`` on, one more
+    (the fixed-schema round-metrics device vector of
+    telemetry.device_round_metrics) — always in that order, guard before
+    telemetry; ``train_step`` appends the same trailing elements. Callers
+    that enable the flags unpack the extras; the arity is unchanged
+    otherwise."""
 
     train_step: Callable   # fused round
     client_step: Callable  # phase 1: gradients + client state rows
@@ -961,11 +976,26 @@ def build_round_step(
                 stale_delta = jnp.where(guard_ok, stale_delta,
                                         jnp.zeros_like(stale_delta))
             cs = cs._replace(weights=cs.weights.at[ids].add(stale_delta))
+        # Zero-sync telemetry (cfg.telemetry, docs/observability.md): one
+        # fixed-schema device vector of round metrics, computed AFTER the
+        # guard select so a quarantined round's metrics show exactly what
+        # tripped (non-finite transmit/update norms) while the carried
+        # state norms show the preserved pre-round values. Reductions
+        # only — the state transition above is untouched.
+        tel = None
+        if cfg.telemetry:
+            from commefficient_tpu.telemetry import device_round_metrics
+
+            tel = device_round_metrics(ctx.gradient, update, new_ps,
+                                       new_server_state, guard_ok=guard_ok)
         if flat_caller:
             new_ps = layout.unchunk(new_ps)
+        ret = (new_ps, new_server_state, cs)
         if cfg.guards:
-            return new_ps, new_server_state, cs, guard_ok
-        return new_ps, new_server_state, cs
+            ret += (guard_ok,)
+        if cfg.telemetry:
+            ret += (tel,)
+        return ret
 
     # ---- fused round (bench / dry-run path) ----------------------------
 
@@ -982,10 +1012,10 @@ def build_round_step(
         new_ps, new_server_state, cs = out[:3]
         if flat_caller:
             new_ps = layout.unchunk(new_ps)
-        if cfg.guards:
-            return (new_ps, new_server_state, cs, new_model_state, metrics,
-                    out[3])
-        return new_ps, new_server_state, cs, new_model_state, metrics
+        # guard verdict and/or telemetry vector ride along as trailing
+        # elements in server_step's order (guard first, then telemetry)
+        return (new_ps, new_server_state, cs, new_model_state,
+                metrics) + tuple(out[3:])
 
     def val_step(ps_weights, model_state, batch):
         def _val(w, ms, b):

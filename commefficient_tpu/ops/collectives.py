@@ -66,6 +66,7 @@ __all__ = [
     "dequantize_int8_blocks",
     "quantized_psum_scatter",
     "quantized_psum",
+    "int8_payload_bytes",
     "DEFAULT_QUANT_BLOCK",
 ]
 
@@ -117,6 +118,19 @@ ident_psumct.defvjp(_ident_psumct_fwd, _ident_psumct_bwd)
 DEFAULT_QUANT_BLOCK = 64 * 128
 
 _INT8_MAX = 127.0
+
+
+def int8_payload_bytes(size: int, block=DEFAULT_QUANT_BLOCK) -> int:
+    """Logical wire bytes of the block-scaled int8 collectives for a
+    ``size``-element operand: 1 B per element plus one f32 scale per
+    ``block`` (the quantize_int8_blocks layout). The telemetry plane's
+    static ledger (telemetry.collective_ledger) prices the int8 legs with
+    this, so the accounting and the collective can never disagree on the
+    scale overhead."""
+    if block is None:
+        block = DEFAULT_QUANT_BLOCK
+    size = int(size)
+    return size + 4 * (-(-size // int(block)))
 
 
 def reduce_scatter_sum(x, axis_name):

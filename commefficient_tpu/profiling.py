@@ -44,11 +44,18 @@ class Heartbeat:
     """Per-round liveness lines for an external supervisor
     (scripts/crash_matrix.py, docs/fault_tolerance.md).
 
-    When armed (``COMMEFFICIENT_HEARTBEAT=1``, or ``enabled=True``), every
-    drained round emits one ``HEARTBEAT round=N`` line to stderr,
-    flushed immediately — so a supervisor that SIGKILLs the process at a
-    randomized round still holds an exact trail of how far training got.
-    Disabled (the default) it is a no-op on the hot path."""
+    Owned by ``PipelinedRoundEngine`` since the telemetry plane landed
+    (docs/observability.md): the engine emits one line per DRAINED round
+    carrying the telemetry round index — the model's global dispatch
+    counter (``RoundHandle.round_no``), monotonic across epochs and engine
+    instances — so a supervisor can target an absolute round by parsing
+    the value instead of counting lines.
+
+    When armed (``COMMEFFICIENT_HEARTBEAT=1``, or ``enabled=True``), each
+    round emits one ``HEARTBEAT round=N`` line to stderr, flushed
+    immediately — a supervisor that SIGKILLs the process at a randomized
+    round still holds an exact trail of how far training got. Disabled
+    (the default) it is a no-op on the hot path."""
 
     def __init__(self, enabled: bool | None = None):
         if enabled is None:

@@ -1,0 +1,406 @@
+"""Zero-sync telemetry plane: on-device round metrics, the structured run
+event log, and round-lifecycle spans (docs/observability.md).
+
+The engine pipelines, shards, fuses, and quarantines rounds (PRs 1-5), but
+until this module the only windows into a *running* federation were offline
+XLA profile captures and whatever bench.py prints — guard verdicts,
+error-feedback carry norms, compression behavior, and per-collective wire
+bytes were invisible at runtime. That is exactly the gap the FL
+practicality survey (arXiv:2405.20431) flags for real deployments with
+stragglers and dropout, and the prerequisite for the per-leg
+{dtype x collective} auto-tuner (ROADMAP item 3 — the tuner needs measured
+bytes per leg, in the spirit of Konecny's uplink/downlink accounting,
+arXiv:1610.05492).
+
+The hard constraint is PR 1's invariant: ZERO blocking device-to-host
+fetches per steady-state round. Telemetry therefore has three strictly
+separated layers:
+
+1. **On-device metrics** (``device_round_metrics``): a fixed-schema vector
+   of f32 scalars computed INSIDE the jitted server phase
+   (``rounds.server_step`` under ``RoundConfig.telemetry``) — norms of the
+   aggregated transmit, the emitted update, and the post-round server
+   carries (velocity / error / qres), the resolved top-k threshold, and
+   the guard verdict detail. All are cheap reductions over planes the
+   epilogue already reads; the result is ONE ``(len(METRIC_FIELDS),)``
+   device array that rides the round handle exactly like
+   ``RoundHandle.guard`` does (attached by ``seal_round``) and
+   materializes with the engine's batched drain. The fp32 trajectory is
+   bit-identical with telemetry on or off, pinned in
+   tests/test_telemetry.py on both server planes.
+
+2. **Host-side spans** (``RunTelemetry``): round-lifecycle timestamps the
+   host already holds for free — dispatch start, seal, the in-flight
+   window's completion wait, drain fetch — plus in-flight-window occupancy
+   at dispatch. Buffered in memory per round; nothing is written until the
+   round drains, so the dispatch path stays allocation-cheap and
+   fetch-free.
+
+3. **The JSONL event log**: one line per drained round (spans + metrics +
+   loss + guard verdict), plus immediate lines for run_start / guard_trip
+   / rollback / guard_fatal / checkpoint / epoch / drain / run_end.
+   ``scripts/obs_report.py`` renders a run summary (timeline, compression
+   ledger, guard/rollback history) and a machine-readable tail from the
+   log alone.
+
+``collective_ledger`` is the static half of the byte accounting: the
+per-round payload of every wire leg (transmit reduce, update all-gather,
+threshold exchange, per-client uplink), computed from the config the same
+way ``ops/collectives.py`` shapes its payloads — logged once in the
+run_start event so obs_report can price a run without re-deriving collective
+internals.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "METRIC_FIELDS",
+    "device_round_metrics",
+    "collective_ledger",
+    "RunTelemetry",
+    "attach_run_telemetry",
+    "read_events",
+]
+
+
+# The fixed on-device metric schema, in stack order. Fixed so the drained
+# vector's meaning never depends on mode/config branches: fields that do
+# not apply to a config (e.g. qres_norm without --reduce_dtype int8) are
+# 0.0, never absent.
+#
+#   transmit_norm / transmit_max_abs — l2 / max|.| of the aggregated round
+#     contribution the server consumed (the sketch table, or the dense
+#     flat sum; under --server_shard the stacked pre-reduce shard sums,
+#     the same view the health guard checks). A NaN/Inf here is the guard
+#     verdict's "what tripped" detail.
+#   update_norm / update_nnz — l2 and nonzero count of the emitted
+#     (lr-scaled) weight update. For sketch/true_topk modes, update_nnz is
+#     the RESOLVED k (radix-descent thresholds are >= k by ties).
+#   topk_threshold — min nonzero |update|: the effective (lr-scaled)
+#     magnitude threshold the round's top-k resolved to; 0 when the update
+#     is all-zero (e.g. a quarantined round).
+#   velocity_norm / error_norm — post-round server carries. error_norm IS
+#     the sketch-estimation residual: the accumulated estimate energy the
+#     threshold did not emit, carried forward by error feedback.
+#   qres_norm — the int8 transmit collective's un-transmitted quantization
+#     remainder (--reduce_dtype int8; 0 otherwise).
+#   ps_norm / ps_max_abs — the post-round weights (ps_max_abs is the
+#     magnitude-guard quantity).
+#   guard_ok — the round-health verdict as 1.0/0.0 (1.0 when --guards is
+#     off: an unguarded round is presumed healthy).
+METRIC_FIELDS = (
+    "transmit_norm",
+    "transmit_max_abs",
+    "update_norm",
+    "update_nnz",
+    "topk_threshold",
+    "velocity_norm",
+    "error_norm",
+    "qres_norm",
+    "ps_norm",
+    "ps_max_abs",
+    "guard_ok",
+)
+
+
+def device_round_metrics(transmit, update, new_ps, state, guard_ok=None):
+    """The jit-side half: one ``(len(METRIC_FIELDS),)`` f32 device vector
+    from arrays the server phase already holds. Pure reductions — nothing
+    here feeds back into the state transition, which is what makes the
+    telemetry-on trajectory bit-identical to telemetry-off
+    (tests/test_telemetry.py pins it on both server planes)."""
+
+    def l2(x):
+        return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+
+    abs_u = jnp.abs(update.astype(jnp.float32))
+    nz = abs_u != 0
+    thr = jnp.min(jnp.where(nz, abs_u, jnp.inf))
+    thr = jnp.where(jnp.isfinite(thr), thr, 0.0)
+    vals = (
+        l2(transmit),
+        jnp.max(jnp.abs(transmit.astype(jnp.float32))),
+        l2(update),
+        jnp.sum(nz).astype(jnp.float32),
+        thr,
+        l2(state.velocity),
+        l2(state.error),
+        l2(state.qres) if state.qres is not None else jnp.float32(0.0),
+        l2(new_ps),
+        jnp.max(jnp.abs(new_ps.astype(jnp.float32))),
+        (guard_ok.astype(jnp.float32) if guard_ok is not None
+         else jnp.float32(1.0)),
+    )
+    out = jnp.stack([jnp.asarray(v, jnp.float32).reshape(()) for v in vals])
+    assert out.shape == (len(METRIC_FIELDS),)
+    return out
+
+
+def collective_ledger(mode: str, grad_size: int, *,
+                      sketch=None, n_shard: int = 0,
+                      reduce_dtype: str = "float32",
+                      k: int = 0) -> Dict[str, Dict[str, Any]]:
+    """Static per-round wire-byte ledger, one entry per collective leg.
+
+    Bytes are LOGICAL payload per chip per round (element count x element
+    size, plus the int8 collective's per-block f32 scales via
+    ``ops.collectives.int8_payload_bytes``) — ring/all-to-all topology
+    factors are deliberately excluded so the numbers compare across mesh
+    sizes. The runtime-dependent half of the accounting (per-client
+    download bytes, which depend on staleness) stays in the aggregator's
+    device-resident accounting and is reported per round by the training
+    loops; this ledger prices the fixed legs, Konecny-style
+    (arXiv:1610.05492: uplink and downlink accounted separately).
+    """
+    from commefficient_tpu.ops.collectives import int8_payload_bytes
+
+    d = int(grad_size)
+    ledger: Dict[str, Dict[str, Any]] = {}
+
+    def leg(name, collective, elems, dtype, bytes_):
+        ledger[name] = {"collective": collective, "elements": int(elems),
+                        "dtype": dtype, "bytes_per_round": int(bytes_)}
+
+    # per-client uplink: what one participating client logically transmits
+    # (mirrors aggregator._account_bytes_deferred's upload accounting)
+    if mode == "sketch":
+        table_elems = sketch.r * sketch.c_pad if sketch is not None else 0
+        leg("client_uplink", "transmit", table_elems, "float32",
+            4 * table_elems)
+        if reduce_dtype == "int8":
+            leg("transmit_reduce", "quantized_psum (int8+scales)",
+                table_elems, "int8",
+                int8_payload_bytes(
+                    table_elems,
+                    block=sketch.c_pad if sketch is not None else None))
+        else:
+            leg("transmit_reduce", "psum", table_elems, "float32",
+                4 * table_elems)
+    else:
+        per_client = k if mode == "local_topk" else d
+        leg("client_uplink", "transmit", per_client, "float32",
+            4 * per_client)
+        d_pad = -(-d // n_shard) * n_shard if n_shard else d
+        if n_shard and reduce_dtype == "int8":
+            leg("transmit_reduce", "quantized_psum_scatter (int8+scales)",
+                d_pad, "int8", int8_payload_bytes(d_pad))
+        elif n_shard:
+            leg("transmit_reduce", "psum_scatter", d_pad, "float32",
+                4 * d_pad)
+        else:
+            leg("transmit_reduce", "psum", d, "float32", 4 * d)
+
+    if n_shard:
+        # downlink half of the sharded plane: the exact-f32 update
+        # all-gather (Konecny's other direction — ROADMAP 3's compression
+        # target, hence its own ledger row)
+        if mode == "sketch" and sketch is not None:
+            # the sharded sketch server gathers update CHUNKS: ceil(T/n)
+            # chunks per shard x n shards of (S, 128) each
+            up_elems = (-(-sketch.T // n_shard) * n_shard
+                        * sketch.sublanes * 128)
+        else:
+            up_elems = -(-d // n_shard) * n_shard
+        leg("update_all_gather", "all_gather", up_elems, "float32",
+            4 * up_elems)
+        if mode in ("sketch", "true_topk"):
+            # the radix descent's psum'd count exchange: 16 s32 candidates
+            # per pass, ~8 passes (ops/topk.py) — negligible, listed so the
+            # ledger is complete
+            leg("threshold_exchange", "psum (count exchange)", 16 * 8,
+                "int32", 4 * 16 * 8)
+    return ledger
+
+
+def _json_safe(x):
+    """Non-finite floats as the strings ``'nan'``/``'inf'``/``'-inf'``
+    (``float()`` round-trips them), recursively. A poisoned round's NaN
+    norms are real data the log must carry, but ``json.dumps`` would emit
+    them as bare ``NaN`` tokens — not RFC-8259 JSON, rejected by jq and
+    every strict consumer the JSONL format exists for."""
+    if isinstance(x, float) and not math.isfinite(x):
+        return repr(x)
+    if isinstance(x, dict):
+        return {k: _json_safe(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_json_safe(v) for v in x]
+    return x
+
+
+class RunTelemetry:
+    """The host-side recorder: buffers per-round spans in memory and writes
+    one JSONL line per drained round (plus immediate lines for lifecycle
+    events). Nothing here touches a device array — the one metric fetch per
+    round happens inside ``FedModel.finish_round`` through the counted
+    ``profiling.materialize`` seam, at drain time, which is why the
+    engine's zero-blocking-fetch invariant survives with telemetry on
+    (pinned in tests/test_telemetry.py with ``host_sync_monitor``).
+
+    Every line is flushed as written so a SIGKILL'd run leaves a usable
+    log — obs_report on a crashed run is a design goal, not a corner case.
+    """
+
+    def __init__(self, path: str, run_info: Optional[dict] = None):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a")
+        self._spans: Dict[int, Dict[str, Any]] = {}
+        self.rounds = 0
+        self.events = 0
+        self._closed = False
+        self.event("run_start", schema=list(METRIC_FIELDS),
+                    **(run_info or {}))
+
+    # -- immediate events --------------------------------------------------
+
+    def event(self, ev: str, **fields) -> None:
+        if self._closed:
+            return
+        rec = {"ev": ev, "t": time.time()}
+        rec.update(fields)
+        self._f.write(json.dumps(_json_safe(rec), allow_nan=False) + "\n")
+        self._f.flush()
+        self.events += 1
+
+    # -- round-lifecycle spans (buffered; written at drain) ----------------
+
+    def on_dispatch(self, round_no: int, t_start: float,
+                    occupancy: int) -> None:
+        """Called by the engine after seal: ``t_start`` is the monotonic
+        stamp taken before ``begin_round`` (so the span covers LR step +
+        client dispatch + server dispatch + seal), ``occupancy`` the
+        in-flight window depth including this round."""
+        now = time.monotonic()
+        self._spans[round_no] = {
+            "t_wall": time.time(),
+            "t0": t_start,
+            "dispatch_ms": (now - t_start) * 1e3,
+            "t_sealed": now,
+            "occupancy": occupancy,
+        }
+
+    def on_complete(self, round_no: int) -> None:
+        """The engine's window wait just returned for this round: its
+        device computation is complete (a completion wait, not a fetch)."""
+        span = self._spans.get(round_no)
+        if span is not None and "compute_ms" not in span:
+            span["compute_ms"] = (time.monotonic() - span["t_sealed"]) * 1e3
+
+    def on_metrics(self, round_no: int, metrics: Dict[str, float],
+                   loss: Optional[float] = None,
+                   guard_ok: Optional[bool] = None,
+                   cohort: Optional[Dict[str, Any]] = None) -> None:
+        """Called by ``FedModel.finish_round`` with the drained (host)
+        metric values; ``cohort`` carries the host-side participation/
+        staleness summary (participants, slots, staleness_mean/max when
+        the accounting regime tracks per-client participation)."""
+        span = self._spans.setdefault(round_no, {})
+        span["metrics"] = metrics
+        if loss is not None:
+            span["loss"] = loss
+        if guard_ok is not None:
+            span["guard_ok"] = guard_ok
+        if cohort:
+            span["cohort"] = cohort
+
+    def on_drained(self, round_no: int, fetch_s: float) -> None:
+        """The round's batched drain finished: derive the span fields and
+        write the one ``round`` line."""
+        span = self._spans.pop(round_no, {})
+        now = time.monotonic()
+        rec: Dict[str, Any] = {"ev": "round", "round": round_no,
+                               "t": time.time()}
+        if "t_wall" in span:
+            rec["t_dispatch"] = span["t_wall"]
+            rec["dispatch_ms"] = round(span["dispatch_ms"], 3)
+            rec["dispatch_to_drain_ms"] = round((now - span["t0"]) * 1e3, 3)
+            rec["occupancy"] = span["occupancy"]
+        if "compute_ms" in span:
+            rec["compute_ms"] = round(span["compute_ms"], 3)
+        rec["drain_fetch_ms"] = round(fetch_s * 1e3, 3)
+        for key in ("loss", "guard_ok", "cohort", "metrics"):
+            if key in span:
+                rec[key] = span[key]
+        self._f.write(json.dumps(_json_safe(rec), allow_nan=False) + "\n")
+        self._f.flush()
+        self.rounds += 1
+        self.events += 1
+
+    def close(self, **totals) -> None:
+        if self._closed:
+            return
+        # dispatched-but-never-drained rounds (e.g. the in-flight window at
+        # a fatal guard escalation): flush their partial spans as their own
+        # event kind so crash forensics sees them without obs_report
+        # counting them as drained rounds
+        for round_no in sorted(self._spans):
+            span = self._spans[round_no]
+            rec = {"round": round_no}
+            for key in ("dispatch_ms", "occupancy", "compute_ms", "loss",
+                        "guard_ok", "cohort", "metrics"):
+                if key in span:
+                    rec[key] = span[key]
+            self.event("round_partial", **rec)
+        self._spans.clear()
+        self.event("run_end", rounds=self.rounds, **totals)
+        self._closed = True
+        self._f.close()
+
+
+def attach_run_telemetry(args, fed_model, log_dir: str,
+                         entrypoint: str) -> Optional[RunTelemetry]:
+    """Entrypoint hook (cv_train/gpt2_train): build the per-run recorder,
+    log the static collective ledger in run_start, and hand the recorder to
+    the model (``FedModel.finish_round`` records drained metrics through
+    it; the engine picks it up via ``model.telemetry`` for spans). Returns
+    None when ``--no_telemetry``."""
+    if not getattr(args, "telemetry", False):
+        return None
+    path = os.path.join(log_dir, "telemetry.jsonl")
+    ledger = collective_ledger(
+        args.mode, fed_model.grad_size, sketch=fed_model.sketch,
+        n_shard=fed_model._n_shard,
+        reduce_dtype=getattr(args, "reduce_dtype", "float32") or "float32",
+        k=args.k)
+    rt = RunTelemetry(path, run_info={
+        "entrypoint": entrypoint,
+        "mode": args.mode,
+        "grad_size": fed_model.grad_size,
+        "num_workers": args.num_workers,
+        "num_clients": fed_model.num_clients,
+        "server_shard": bool(getattr(args, "server_shard", False)),
+        "reduce_dtype": getattr(args, "reduce_dtype", "float32"),
+        "guards": bool(getattr(args, "guards", False)),
+        "seed": args.seed,
+        "backend": jax.default_backend(),
+        "ledger": ledger,
+    })
+    fed_model.telemetry = rt
+    print(f"telemetry: run event log -> {path} "
+          "(docs/observability.md; --no_telemetry disables)")
+    return rt
+
+
+def read_events(path: str) -> Iterator[dict]:
+    """Yield the JSONL events of a run log, skipping a torn trailing line
+    (a SIGKILL mid-write must not make the whole log unreadable)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                return

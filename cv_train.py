@@ -45,7 +45,8 @@ from commefficient_tpu.federated.checkpoint import (
     save_round_state,
 )
 from commefficient_tpu.federated.losses import make_cv_losses
-from commefficient_tpu.profiling import Heartbeat, StepProfiler
+from commefficient_tpu.profiling import StepProfiler
+from commefficient_tpu.telemetry import attach_run_telemetry
 from commefficient_tpu.ops.flat import ravel_pytree
 from commefficient_tpu.utils import (
     PiecewiseLinear,
@@ -125,12 +126,14 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
         # therefore fires at drain time, up to drain_every-1 rounds after
         # the NaN round — same abort, batched detection
         # (docs/round_engine.md).
+        # the engine owns the liveness heartbeat (global telemetry round
+        # index, scripts/crash_matrix.py) and the telemetry spans (the
+        # recorder attached to the model by main)
         engine = PipelinedRoundEngine(
             model, opt, lr_scheduler,
             window=getattr(args, "round_window", 2),
             drain_every=getattr(args, "metrics_drain_every", 8))
         nan_loss = False
-        heartbeat = Heartbeat()
         save_every = int(getattr(args, "checkpoint_every_rounds", 0) or 0)
 
         def consume(results):
@@ -146,7 +149,6 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
                 client_upload += upload
                 losses.extend(loss.tolist())
                 accs.extend(acc.tolist())
-                heartbeat.round(i0 + res.index + 1, epoch=epoch)
 
         try:
             for i, batch in enumerate(loader):
@@ -170,6 +172,15 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
                                 "upload": client_upload,
                                 "losses": np.asarray(losses, np.float64),
                                 "accs": np.asarray(accs, np.float64)})
+                    if getattr(model, "telemetry", None) is not None:
+                        # `round` is the GLOBAL round_no the round/guard
+                        # events share (the window just drained, so the
+                        # last dispatched round is the last covered);
+                        # the epoch-local save position rides separately
+                        model.telemetry.event(
+                            "checkpoint", epoch=epoch,
+                            round=model.rounds_dispatched - 1,
+                            round_in_epoch=i0 + i + 1)
                 if args.do_test:
                     break
             consume(engine.drain())
@@ -235,6 +246,11 @@ def train(model, opt, lr_scheduler, train_loader, test_loader, args, writer,
         summary = union({"epoch": epoch + 1, "lr": lr}, epoch_stats)
         for logger in loggers:
             logger.append(summary)
+        if getattr(model, "telemetry", None) is not None:
+            model.telemetry.event(
+                "epoch", epoch=epoch + 1, lr=float(lr),
+                **{k.split(" ")[0]: float(v)
+                   for k, v in epoch_stats.items()})
         maybe_save_run_state(args, epoch, model, opt, lr_scheduler,
                              (total_download, total_upload))
         if writer is not None:
@@ -399,14 +415,25 @@ def main(argv=None):
             writer = SummaryWriter(log_dir=log_dir)
         except ImportError:
             print("tensorboard unavailable; console logging only")
+    # zero-sync telemetry plane (--telemetry, on by default): per-round
+    # device metrics + the structured run event log under the run dir
+    # (docs/observability.md; render with scripts/obs_report.py)
+    rt = attach_run_telemetry(args, fed_model, log_dir, "cv_train")
     start_epoch, totals, resume_mid = resume_run(args, fed_model, opt,
                                                  lr_scheduler)
+    if rt is not None and (start_epoch or resume_mid is not None):
+        rt.event("resume", start_epoch=start_epoch,
+                 mid_epoch=resume_mid is not None)
     print(f"Finished initializing in {timer():.2f} seconds")
 
-    summary = train(fed_model, opt, lr_scheduler, train_loader, test_loader,
-                    args, writer, loggers=(TableLogger(),), timer=timer,
-                    start_epoch=start_epoch, totals=totals,
-                    resume_mid=resume_mid)
+    try:
+        summary = train(fed_model, opt, lr_scheduler, train_loader,
+                        test_loader, args, writer, loggers=(TableLogger(),),
+                        timer=timer, start_epoch=start_epoch, totals=totals,
+                        resume_mid=resume_mid)
+    finally:
+        if rt is not None:
+            rt.close()
     fed_model.finalize()
     if args.do_checkpoint:
         os.makedirs(args.checkpoint_path, exist_ok=True)

@@ -42,7 +42,7 @@ from commefficient_tpu.federated.checkpoint import (
     resume_run,
     save_round_state,
 )
-from commefficient_tpu.profiling import Heartbeat
+from commefficient_tpu.telemetry import attach_run_telemetry
 from commefficient_tpu.federated.losses import make_gpt2_losses
 from commefficient_tpu.models.gpt2 import (
     GPT2DoubleHeads,
@@ -112,7 +112,6 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
         i0, ex = restore_mid_epoch(resume_mid, loader, client_download,
                                    client_upload)
         losses.extend(np.asarray(ex.get("losses", [])).tolist())
-        heartbeat = Heartbeat()
         save_every = int(getattr(args, "checkpoint_every_rounds", 0) or 0)
         # Pipelined round engine (federated/engine.py): rounds are
         # dispatched sync-free and metrics arrive in batches of
@@ -138,7 +137,6 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
                 client_upload += upload
                 loss = float(np.mean(loss))
                 losses.append(loss)
-                heartbeat.round(i0 + res.index + 1, epoch=epoch)
                 row_batch_idx, row_lr = meta_by_round.pop(res.index)
                 batch_stats = {
                     "train_time": interval / len(results),
@@ -176,6 +174,14 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
                         extras={"download": client_download,
                                 "upload": client_upload,
                                 "losses": np.asarray(losses, np.float64)})
+                    if getattr(model, "telemetry", None) is not None:
+                        # `round` is the GLOBAL round_no the round/guard
+                        # events share (the window just drained); the
+                        # epoch-local save position rides separately
+                        model.telemetry.event(
+                            "checkpoint", epoch=epoch or 0,
+                            round=model.rounds_dispatched - 1,
+                            round_in_epoch=i0 + batch_idx + 1)
             consume(engine.drain())
         finally:
             prof.close()
@@ -415,12 +421,24 @@ def train(argv=None):
         stats = test_gpt2(fed_model, val_loader, args, logger=TableLogger(),
                           timer=timer)
     else:
+        # zero-sync telemetry plane (--telemetry, on by default): per-round
+        # device metrics + the structured run event log under log_dir
+        # (docs/observability.md; render with scripts/obs_report.py)
+        rt = attach_run_telemetry(args, fed_model, log_dir, "gpt2_train")
         start_epoch, totals, resume_mid = resume_run(args, fed_model, opt,
                                                      scheduler)
-        stats = train_gpt2(fed_model, opt, scheduler, train_loader,
-                           val_loader, args, log_dir, logger=TableLogger(),
-                           timer=timer, start_epoch=start_epoch,
-                           totals=totals, resume_mid=resume_mid)
+        if rt is not None and (start_epoch or resume_mid is not None):
+            rt.event("resume", start_epoch=start_epoch,
+                     mid_epoch=resume_mid is not None)
+        try:
+            stats = train_gpt2(fed_model, opt, scheduler, train_loader,
+                               val_loader, args, log_dir,
+                               logger=TableLogger(), timer=timer,
+                               start_epoch=start_epoch, totals=totals,
+                               resume_mid=resume_mid)
+        finally:
+            if rt is not None:
+                rt.close()
     fed_model.finalize()
     return stats
 

@@ -14,12 +14,16 @@
 #     ravel+sketch path, replicated/--server_shard × composed/
 #     --fused_epilogue, plus the no-d-sized-movement and table-sized-carry
 #     structural asserts (tests/test_stream_sketch.py,
-#     docs/stream_sketch.md).
+#     docs/stream_sketch.md);
+#   - the telemetry plane's non-perturbation (fp32 bit-identity with
+#     --telemetry on/off on BOTH planes) and its strict zero-host-sync
+#     audit with guards+telemetry through the engine
+#     (tests/test_telemetry.py, docs/observability.md).
 # Any extra args are passed through to pytest (e.g. -k bit_identical).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_sharded_server.py tests/test_fused_epilogue.py \
-    tests/test_stream_sketch.py \
+    tests/test_stream_sketch.py tests/test_telemetry.py \
     -q -p no:cacheprovider "$@"

@@ -5,7 +5,8 @@ through tests/test_fault_tolerance.py::TestCrashMatrix:
 
 1. run cv_train as a subprocess on the synthetic CIFAR split with
    ``--checkpoint_every_rounds`` and ``COMMEFFICIENT_HEARTBEAT=1``
-   (profiling.Heartbeat prints one flushed stderr line per drained round);
+   (the round engine's profiling.Heartbeat prints one flushed stderr line
+   per drained round, carrying the global telemetry round index);
 2. SIGKILL it the moment a randomized heartbeat round is reached — the
    hardest preemption there is: no cleanup, no atexit, possibly mid-save
    (the atomic tmp-rename in save_run_state is what keeps that survivable);
@@ -30,6 +31,7 @@ from __future__ import annotations
 import argparse
 import os
 import random
+import re
 import signal
 import subprocess
 import sys
@@ -117,11 +119,13 @@ def run_to_completion(argv, timeout=900) -> None:
 
 def run_and_kill(argv, kill_after_round: int, timeout=900) -> int:
     """Start the training child and SIGKILL it the moment its
-    ``kill_after_round``-th heartbeat line lands (heartbeat round indices
-    restart per epoch, so the supervisor counts LINES — one per drained
-    training round across the whole run). Returns the count at the kill;
-    the child may race a round further before the signal lands — that is
-    the point, preemption is not polite."""
+    ``kill_after_round``-th round's heartbeat lands. The heartbeat is
+    emitted by the round engine and carries the telemetry round index —
+    the model's GLOBAL dispatch counter (0-based, monotonic across epochs,
+    docs/observability.md) — so the supervisor parses the value directly
+    instead of the old per-epoch line counting. Returns the 1-based count
+    at the kill; the child may race a round further before the signal
+    lands — that is the point, preemption is not polite."""
     proc = subprocess.Popen(argv, env=child_env(), cwd=_REPO,
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.PIPE, text=True)
@@ -132,8 +136,9 @@ def run_and_kill(argv, kill_after_round: int, timeout=900) -> int:
         for line in proc.stderr:
             if time.monotonic() > deadline:
                 break
-            if line.startswith("HEARTBEAT round="):
-                seen += 1
+            m = re.match(r"HEARTBEAT round=(\d+)", line)
+            if m:
+                seen = int(m.group(1)) + 1
                 if seen >= kill_after_round:
                     proc.send_signal(signal.SIGKILL)
                     killed = True

@@ -1,0 +1,269 @@
+"""Render a run summary from a telemetry JSONL event log.
+
+The read side of the zero-sync telemetry plane (docs/observability.md):
+given the ``telemetry.jsonl`` a training run wrote (cv_train/gpt2_train
+with ``--telemetry``, the default), print
+
+- the run header (config, backend, rounds, wall span, rounds/sec);
+- the round-lifecycle timeline (dispatch / device-compute / drain-fetch /
+  dispatch-to-drain latencies with p50/p90, in-flight-window occupancy);
+- the compression ledger: the static per-collective wire bytes from the
+  run_start event priced over the drained rounds, next to the runtime
+  compression signals (resolved k, top-k threshold, error-carry residual);
+- the guard / rollback history: every guard_trip, rollback, and
+  guard_fatal event, plus the rounds whose drained metrics carried a
+  tripped verdict — reconstructing the fault story from the log alone
+  (the acceptance drill: a fault-injected run's quarantine history must
+  be reproducible here without touching the process that ran it);
+- checkpoints, resumes, and epoch rows, in timeline order.
+
+The LAST line of output is always one machine-readable JSON object
+(``summary_dict``) so bench/CI can consume the numbers without parsing
+prose — same contract as bench.py's one-JSON-line stdout.
+
+Usage:
+    python scripts/obs_report.py RUN_DIR_OR_JSONL [--json]
+
+``--json`` suppresses the human report and prints only the JSON tail.
+A SIGKILL'd run's log is readable too (lines are flushed as written and a
+torn trailing line is skipped by the reader).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from commefficient_tpu.telemetry import read_events  # noqa: E402
+
+
+def _pct(xs: List[float], p: float):
+    if not xs:
+        return None
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, int(p * len(ys)))]
+
+
+def _mean(xs: List[float]):
+    return (sum(xs) / len(xs)) if xs else None
+
+
+def _fin(x):
+    """JSON-safe float: non-finite values (a poisoned round's NaN norms
+    are real data) become their string names so the tail line stays strict
+    JSON for jq-style consumers."""
+    if x is None or isinstance(x, str):
+        return x
+    if isinstance(x, float) and not math.isfinite(x):
+        return repr(x)
+    return x
+
+
+def load_events(path: str) -> List[dict]:
+    """Accept either the jsonl file or a run dir containing one."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.jsonl")
+    return list(read_events(path))
+
+
+def summarize(events: List[dict]) -> Dict[str, Any]:
+    """The machine-readable digest: everything the human report prints,
+    as one dict (tests compare this against the live run's counters)."""
+    run_info = next((e for e in events if e["ev"] == "run_start"), {})
+    rounds = [e for e in events if e["ev"] == "round"]
+    trips = [e for e in events if e["ev"] == "guard_trip"]
+    rollbacks = [e for e in events if e["ev"] == "rollback"]
+    fatals = [e for e in events if e["ev"] == "guard_fatal"]
+    drains = [e for e in events if e["ev"] == "drain"]
+    run_end = next((e for e in events if e["ev"] == "run_end"), None)
+
+    tripped_rounds = sorted(
+        {e["round"] for e in trips}
+        | {e["round"] for e in rounds if e.get("guard_ok") is False})
+
+    def span_list(key):
+        return [e[key] for e in rounds if key in e]
+
+    wall = None
+    rps = None
+    stamps = [e.get("t_dispatch", e["t"]) for e in rounds]
+    if len(stamps) >= 2:
+        wall = max(e["t"] for e in rounds) - min(stamps)
+        rps = (len(rounds) / wall) if wall > 0 else None
+
+    ledger = run_info.get("ledger", {})
+    ledger_totals = {
+        leg: {"bytes_per_round": row["bytes_per_round"],
+              "collective": row["collective"],
+              "total_bytes": row["bytes_per_round"] * len(rounds)}
+        for leg, row in ledger.items()}
+
+    def metric_mean(name):
+        # non-finite metric values arrive as the strings 'nan'/'inf'
+        # (telemetry._json_safe keeps the log strict JSON); they are
+        # excluded from means the same way bare non-finite floats were
+        vals = [e["metrics"][name] for e in rounds
+                if "metrics" in e and name in e["metrics"]
+                and isinstance(e["metrics"][name], (int, float))
+                and math.isfinite(e["metrics"][name])]
+        return (sum(vals) / len(vals)) if vals else None
+
+    return {
+        "log_rounds": len(rounds),
+        "partial_rounds": len([e for e in events
+                               if e["ev"] == "round_partial"]),
+        "run_complete": run_end is not None,
+        "mode": run_info.get("mode"),
+        "grad_size": run_info.get("grad_size"),
+        "guards": run_info.get("guards"),
+        "backend": run_info.get("backend"),
+        "wall_s": _fin(round(wall, 3) if wall is not None else None),
+        "rounds_per_sec": _fin(round(rps, 3) if rps else None),
+        "dispatch_ms_p50": _fin(_pct(span_list("dispatch_ms"), 0.5)),
+        "dispatch_ms_p90": _fin(_pct(span_list("dispatch_ms"), 0.9)),
+        "compute_ms_p50": _fin(_pct(span_list("compute_ms"), 0.5)),
+        "drain_fetch_ms_p50": _fin(_pct(span_list("drain_fetch_ms"), 0.5)),
+        "dispatch_to_drain_ms_p50": _fin(
+            _pct(span_list("dispatch_to_drain_ms"), 0.5)),
+        "occupancy_mean": _fin(
+            round(sum(span_list("occupancy")) / len(span_list("occupancy")),
+                  2) if span_list("occupancy") else None),
+        "drains": len(drains),
+        "guard_trips": len(trips),
+        "tripped_rounds": tripped_rounds,
+        "rollbacks": len(rollbacks),
+        "rollback_rounds": [e["round"] for e in rollbacks],
+        "fatal": len(fatals) > 0,
+        "checkpoints": len([e for e in events if e["ev"] == "checkpoint"]),
+        "resumes": len([e for e in events if e["ev"] == "resume"]),
+        "epochs": len([e for e in events if e["ev"] == "epoch"]),
+        "mean_participants": _fin(_mean(
+            [e["cohort"]["participants"] for e in rounds
+             if "cohort" in e])),
+        "mean_staleness": _fin(_mean(
+            [e["cohort"]["staleness_mean"] for e in rounds
+             if "staleness_mean" in e.get("cohort", {})])),
+        "max_staleness": _fin(max(
+            (e["cohort"]["staleness_max"] for e in rounds
+             if "staleness_max" in e.get("cohort", {})), default=None)),
+        "mean_update_nnz": _fin(metric_mean("update_nnz")),
+        "mean_topk_threshold": _fin(metric_mean("topk_threshold")),
+        "mean_error_norm": _fin(metric_mean("error_norm")),
+        "mean_loss": _fin(_mean([e["loss"] for e in rounds
+                                 if isinstance(e.get("loss"), float)
+                                 and math.isfinite(e["loss"])])),
+        "ledger": ledger_totals,
+    }
+
+
+def render(events: List[dict], out=sys.stdout) -> Dict[str, Any]:
+    s = summarize(events)
+    rounds = [e for e in events if e["ev"] == "round"]
+    run_info = next((e for e in events if e["ev"] == "run_start"), {})
+    p = lambda *a: print(*a, file=out)  # noqa: E731
+
+    p("# Run summary")
+    p(f"mode={s['mode']} grad_size={s['grad_size']} "
+      f"guards={s['guards']} backend={s['backend']} "
+      f"entrypoint={run_info.get('entrypoint')}")
+    fate = ("completed" if s["run_complete"]
+            else "DID NOT complete — crashed, killed, or still running")
+    partial = (f", {s['partial_rounds']} dispatched-but-never-drained"
+               if s["partial_rounds"] else "")
+    p(f"rounds drained: {s['log_rounds']}{partial}  (run {fate})")
+    if s["rounds_per_sec"]:
+        p(f"wall span {s['wall_s']} s  ~{s['rounds_per_sec']} rounds/s "
+          "(host-side, includes drain stalls)")
+
+    p("\n## Round lifecycle (ms)")
+    p("| span | p50 | p90 |")
+    p("|---|---|---|")
+    for key, label in (("dispatch_ms", "dispatch (LR+client+server+seal)"),
+                       ("compute_ms", "device compute (window wait)"),
+                       ("drain_fetch_ms", "drain fetch"),
+                       ("dispatch_to_drain_ms", "dispatch -> drain")):
+        vals = [e[key] for e in rounds if key in e]
+        p(f"| {label} | {_pct(vals, 0.5)} | {_pct(vals, 0.9)} |")
+    p(f"in-flight window occupancy at dispatch: mean {s['occupancy_mean']}"
+      f", drains: {s['drains']}")
+    if s["mean_participants"] is not None:
+        stale = (f", staleness mean {s['mean_staleness']:.1f} / max "
+                 f"{s['max_staleness']} rounds"
+                 if s["mean_staleness"] is not None else "")
+        p(f"cohort: mean {s['mean_participants']:.1f} participants/round"
+          f"{stale}")
+
+    if s["ledger"]:
+        p("\n## Compression ledger (static legs x drained rounds)")
+        p("| leg | collective | bytes/round | total bytes |")
+        p("|---|---|---|---|")
+        for leg, row in s["ledger"].items():
+            p(f"| {leg} | {row['collective']} | "
+              f"{row['bytes_per_round']:,} | {row['total_bytes']:,} |")
+    if s["mean_update_nnz"] is not None:
+        p(f"runtime compression: mean resolved k "
+          f"{s['mean_update_nnz']:.1f}, mean |threshold| "
+          f"{s['mean_topk_threshold']:.3g}, mean error-carry norm "
+          f"{s['mean_error_norm']:.3g}")
+
+    p("\n## Guard / rollback history")
+    if not s["guards"]:
+        p("guards were OFF for this run")
+    trips = [e for e in events if e["ev"] == "guard_trip"]
+    if trips or s["tripped_rounds"]:
+        for e in trips:
+            p(f"- guard TRIP at round {e['round']} "
+              f"(trip {e.get('trip')}, consecutive {e.get('consecutive')})")
+        for e in (x for x in events if x["ev"] == "rollback"):
+            p(f"- ROLLBACK to last-good snapshot at round {e['round']} "
+              f"({e.get('consecutive')} consecutive trips)")
+        for e in (x for x in events if x["ev"] == "guard_fatal"):
+            p(f"- FATAL guard escalation at round {e['round']}")
+        p(f"tripped rounds (from trip events + drained verdicts): "
+          f"{s['tripped_rounds']}")
+    else:
+        p("no guard trips recorded")
+
+    other = [e for e in events if e["ev"] in ("checkpoint", "resume",
+                                              "epoch")]
+    if other:
+        p("\n## Lifecycle events")
+        for e in other:
+            extra = {k: v for k, v in e.items() if k not in ("ev", "t")}
+            p(f"- {e['ev']}: {extra}")
+    return s
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="telemetry.jsonl (or a run dir holding one)")
+    ap.add_argument("--json", action="store_true",
+                    help="print only the machine-readable JSON summary")
+    args = ap.parse_args(argv)
+    try:
+        events = load_events(args.path)
+    except OSError as e:
+        print(e, file=sys.stderr)
+        return 2
+    if not events:
+        print("no events in log", file=sys.stderr)
+        return 2
+    if args.json:
+        s = summarize(events)
+    else:
+        s = render(events)
+    # machine-readable tail: ALWAYS the last stdout line
+    print(json.dumps(s, allow_nan=False))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,10 @@ shape of win: the data-movement category of the GPT-2 per-op profile
 script makes that claim — and any future regression of it — one command to
 check: it parses the "## By category" table and the wall/busy header out
 of two capture files written by scripts/tpu_profile.py and prints the
-per-category delta table.
+per-category delta table, plus one unified delta table for the per-round
+counter registry ("## Per-round counters", scripts/tpu_profile.py
+COUNTERS — legacy prose-counter captures parse too), so every
+optimization's headline counter diffs through the same code path.
 
 Usage:
     python scripts/profile_diff.py BEFORE.md AFTER.md
@@ -86,6 +89,11 @@ class Capture(NamedTuple):
     busy_ms: Optional[float]  # ms/round device busy
     # category -> (spans, ms_per_round)
     categories: Dict[str, "tuple[int, float]"]
+    # counter slug -> (ops_per_round, ms_per_round) — the "## Per-round
+    # counters" registry table (scripts/tpu_profile.py COUNTERS). None
+    # (not a shared {} class default) for captures predating it; read
+    # through `cap.counters or {}`
+    counters: Optional[Dict[str, "tuple[float, float]"]] = None
 
 
 _WALL_RE = re.compile(r"Wall clock:\s*\*\*([\d.]+)\s*ms/round\*\*")
@@ -93,6 +101,18 @@ _BUSY_RE = re.compile(r"busy time\s*([\d.]+)\s*ms/round")
 # | category | spans | total ms | ms/round | % busy |
 _ROW_RE = re.compile(
     r"^\|\s*([^|]+?)\s*\|\s*(\d+)\s*\|\s*[\d.]+\s*\|\s*([\d.]+)\s*\|")
+# | counter | category | ops/round | ms/round | gate | doc |
+_COUNTER_RE = re.compile(
+    r"^\|\s*(\w+)\s*\|\s*[^|]+\|\s*([\d.]+)\s*\|\s*([\d.]+)\s*\|")
+# the pre-registry prose spelling ("Server epilogue d-plane sweeps:
+# **12.0 ops/round** (0.41 ms/round)"), so a new capture still diffs
+# against committed baselines written before the counters table existed
+_LEGACY_COUNTER_RE = re.compile(
+    r"^(.+?):\s*\*\*([\d.]+)\s*ops/round\*\*\s*\(([\d.]+)\s*ms/round\)")
+_LEGACY_SLUGS = {
+    "Server epilogue d-plane sweeps": "epilogue_sweeps",
+    "Client flatten/movement (d-sized)": "client_movement",
+}
 
 
 def parse_capture(path: str) -> Capture:
@@ -102,12 +122,24 @@ def parse_capture(path: str) -> Capture:
     busy = _BUSY_RE.search(text)
 
     cats: Dict[str, tuple] = {}
-    in_table = False
+    counters: Dict[str, tuple] = {}
+    section = None
     for line in text.splitlines():
         if line.startswith("## "):
-            in_table = line.strip() == "## By category"
+            section = line.strip()
             continue
-        if not in_table:
+        m = _LEGACY_COUNTER_RE.match(line)
+        if m and m.group(1).strip() in _LEGACY_SLUGS:
+            counters.setdefault(_LEGACY_SLUGS[m.group(1).strip()],
+                                (float(m.group(2)), float(m.group(3))))
+            continue
+        if section == "## Per-round counters":
+            m = _COUNTER_RE.match(line)
+            if m and m.group(1) != "counter":
+                counters[m.group(1)] = (float(m.group(2)),
+                                        float(m.group(3)))
+            continue
+        if section != "## By category":
             continue
         m = _ROW_RE.match(line)
         if not m:
@@ -122,7 +154,8 @@ def parse_capture(path: str) -> Capture:
     return Capture(path=path,
                    wall_ms=float(wall.group(1)) if wall else None,
                    busy_ms=float(busy.group(1)) if busy else None,
-                   categories=cats)
+                   categories=cats,
+                   counters=counters)
 
 
 def _fmt_delta(before: Optional[float], after: Optional[float]) -> str:
@@ -168,6 +201,22 @@ def diff(a: Capture, b: Capture, fail_above: Dict[str, float]) -> int:
           f"{a.wall_ms if a.wall_ms is not None else '?'} | "
           f"{b.wall_ms if b.wall_ms is not None else '?'} | "
           f"{_fmt_delta(a.wall_ms, b.wall_ms)} |")
+
+    # the per-round counter registry (scripts/tpu_profile.py COUNTERS):
+    # ONE table for every counter, whichever capture carries it — no
+    # preset-specific print paths. Counters are informational here; the
+    # pass/fail gates stay on the category budgets above.
+    a_counters, b_counters = a.counters or {}, b.counters or {}
+    counter_names = sorted(set(a_counters) | set(b_counters))
+    if counter_names:
+        print("\n| counter (ops/round) | before | after | delta |")
+        print("|---|---|---|---|")
+        for name in counter_names:
+            ca = a_counters.get(name, (None, None))[0]
+            cb = b_counters.get(name, (None, None))[0]
+            print(f"| {name} | {ca if ca is not None else '?'} | "
+                  f"{cb if cb is not None else '?'} | "
+                  f"{_fmt_delta(ca, cb)} |")
 
     # a budget that GOVERNS no nonzero-baseline category checks nothing
     # (e.g. the baseline predates a category rename, or a longer pattern

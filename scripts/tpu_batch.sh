@@ -67,8 +67,8 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # new stream/stream_sketch/profile_stream legs; one pass decides both
 # defaults (docs/stream_sketch.md, docs/fused_epilogue.md).
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
-stream_sketch fused_epilogue learning profile profile_fused profile_stream \
-profile_gpt2 host_offload imagenet ops"}
+telemetry stream_sketch fused_epilogue learning profile profile_fused \
+profile_stream profile_gpt2 host_offload imagenet ops"}
 i=0
 for step in $STEPS; do
   i=$((i + 1))
@@ -96,9 +96,12 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|telemetry)
       # one resumable capture per heavy compile: a window that lands even
-      # one leg banks it in .bench_extras.json for every later artifact
+      # one leg banks it in .bench_extras.json for every later artifact.
+      # `telemetry` is the telemetry-overhead A/B leg: headline geometry
+      # with the on-device round metrics on — gate <= 2% rounds/sec vs
+      # the headline (docs/observability.md overhead ledger)
       log "step $i: bench.py --capture $step (timeout 40m)"
       timeout 2400 python bench.py --capture "$step" \
         >"$OUT/capture_$step.json" 2>"$OUT/capture_$step.log"

@@ -60,6 +60,24 @@ _TITLES = {
 }
 
 
+# The per-round counter registry: every optimization that claims to
+# remove a class of per-round device work pins that claim on ONE counter —
+# the span count of its category bucket divided by the traced rounds.
+# One schema and one "## Per-round counters" markdown table (parsed
+# generically by scripts/profile_diff.py) replace the hand-rolled
+# paragraph each optimization used to append: a new counter is a new row
+# here, not new prose in write_report and new parsing downstream.
+# rows: (category key, slug, gating profile_diff preset, doc)
+COUNTERS = (
+    ("server epilogue (d-plane sweeps)", "epilogue_sweeps",
+     "fused-epilogue", "docs/fused_epilogue.md"),
+    ("client flatten/movement (d-sized)", "client_movement",
+     "stream-sketch", "docs/stream_sketch.md"),
+    ("reduce (transmit collectives)", "transmit_collectives",
+     "sharded-server", "docs/sharded_server.md"),
+)
+
+
 def _category(op_name: str) -> str:
     """Bucket an XLA op span name into a coarse category. Fusion names carry
     the fused root op after the kind tag (e.g. 'loop_fusion' wrapping adds);
@@ -215,29 +233,18 @@ def write_report(plane, line, agg, wall_ms_per_round, backend, d, tiny,
         for cat, (cnt, ps) in cat_rows:
             f.write(f"| {cat} | {cnt} | {ps / 1e9:.2f} | "
                     f"{ps / 1e9 / ROUNDS:.3f} | {100 * ps / total_ps:.1f}% |\n")
-        # the fused-epilogue target metric (docs/fused_epilogue.md): how
-        # many distinct d-plane epilogue ops the server step issues per
-        # round — the sweep count the megakernel exists to collapse.
-        # Span-count based, so it is robust to tenancy noise in a way the
-        # ms numbers are not.
-        ep_cnt, ep_ps = cats.get("server epilogue (d-plane sweeps)", (0, 0))
-        f.write(f"\nServer epilogue d-plane sweeps: "
-                f"**{ep_cnt / ROUNDS:.1f} ops/round** "
-                f"({ep_ps / 1e9 / ROUNDS:.3f} ms/round) — the sweep "
-                f"counter the fused epilogue targets "
-                f"(docs/fused_epilogue.md; gate via scripts/profile_diff.py "
-                f"--preset fused-epilogue).\n")
-        # the streaming-sketch target metric (docs/stream_sketch.md): the
-        # d-sized 1-D concatenate/pad/reshape/convert movement count the
-        # leaf-streamed client phase exists to delete. Span-count based
-        # like the epilogue counter, so it is tenancy-robust.
-        fm_cnt, fm_ps = cats.get("client flatten/movement (d-sized)", (0, 0))
-        f.write(f"\nClient flatten/movement (d-sized): "
-                f"**{fm_cnt / ROUNDS:.1f} ops/round** "
-                f"({fm_ps / 1e9 / ROUNDS:.3f} ms/round) — the movement "
-                f"counter --stream_sketch targets (docs/stream_sketch.md; "
-                f"gate via scripts/profile_diff.py --preset "
-                f"stream-sketch).\n")
+        # The per-round counters (COUNTERS registry above): span-count
+        # based, so they are robust to tenancy noise in a way the ms
+        # numbers are not. One table for all of them; gate a before/after
+        # pair with scripts/profile_diff.py --preset <gate>.
+        f.write("\n## Per-round counters\n\n")
+        f.write("| counter | category | ops/round | ms/round | gate "
+                "(profile_diff --preset) | doc |\n")
+        f.write("|---|---|---|---|---|---|\n")
+        for cat_key, slug, preset, doc in COUNTERS:
+            cnt, ps = cats.get(cat_key, (0, 0))
+            f.write(f"| {slug} | {cat_key} | {cnt / ROUNDS:.1f} | "
+                    f"{ps / 1e9 / ROUNDS:.3f} | {preset} | {doc} |\n")
         f.write("\n## Top 40 ops\n\n")
         f.write("| op | count | total ms | ms/round | % busy |\n")
         f.write("|---|---|---|---|---|\n")

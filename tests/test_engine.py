@@ -100,17 +100,33 @@ def fresh_compiles():
     diagnosis and test_fault_tolerance's fresh_compiles), so the aliasing
     assertion below is only meaningful on a fresh compile. Reproduced at
     unmodified HEAD: the test passes cold and fails on the second process
-    to compile the geometry."""
+    to compile the geometry.
+
+    The flag flip alone is NOT enough: jax 0.4.37 memoizes the
+    cache-enablement check once per process (compilation_cache._cache_checked
+    inside is_cache_used), so if ANY earlier test initialized the cache,
+    disabling the flag here silently does nothing and this test still
+    reads the metadata-less entry (reproduced at unmodified HEAD:
+    `pytest tests/test_accounting.py tests/test_engine.py` fails once the
+    cache dir holds the geometry — any file-order where another test runs
+    first). reset_cache() restores the pristine state so the flag is
+    actually consulted; reset again on exit so later tests re-initialize
+    with the cache re-enabled."""
     try:
+        from jax._src import compilation_cache as _cc
+
         old = jax.config.jax_enable_compilation_cache
-    except AttributeError:  # much newer jax: cache flag moved; skip gating
+    # much newer jax: the flag or the private module moved; skip gating
+    except (ImportError, AttributeError):
         yield
         return
+    _cc.reset_cache()
     jax.config.update("jax_enable_compilation_cache", False)
     try:
         yield
     finally:
         jax.config.update("jax_enable_compilation_cache", old)
+        _cc.reset_cache()
 
 
 class TestBufferDonation:

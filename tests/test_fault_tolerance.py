@@ -466,19 +466,30 @@ def fresh_compiles():
     compiling that geometry (reproduced 4-for-4 at unmodified HEAD until
     the cache dir was deleted; docs/fault_tolerance.md). These tests use
     the exact tiny geometries the kill harness compiles, so they bypass
-    the shared cache entirely."""
+    the shared cache entirely.
+
+    The flag flip alone does nothing once ANY earlier test initialized
+    the cache — jax 0.4.37 memoizes the enablement check per process
+    (compilation_cache._cache_checked; root-caused in test_engine's
+    fresh_compiles) — so reset the cache to pristine state around the
+    flip, and again on exit so later tests re-initialize with it on."""
     import jax
 
     try:
+        from jax._src import compilation_cache as _cc
+
         old = jax.config.jax_enable_compilation_cache
-    except AttributeError:  # much newer jax: cache flag moved; skip gating
+    # much newer jax: the flag or the private module moved; skip gating
+    except (ImportError, AttributeError):
         yield
         return
+    _cc.reset_cache()
     jax.config.update("jax_enable_compilation_cache", False)
     try:
         yield
     finally:
         jax.config.update("jax_enable_compilation_cache", old)
+        _cc.reset_cache()
 
 
 @pytest.mark.heavy
