@@ -150,7 +150,7 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
           mode: str = "sketch", num_workers: int = NUM_WORKERS,
           server_shard: bool = False, fused_epilogue: bool = False,
           guards: bool = False, stream_sketch: bool = False,
-          telemetry: bool = False):
+          telemetry: bool = False, collective_plan: str = ""):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -199,9 +199,18 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
                         fused_epilogue=fused_epilogue)
     sketch = make_sketch(d, c=c, r=r, seed=42, num_blocks=blocks) \
         if mode == "sketch" else None
+    # per-leg compressed collectives (--collective_plan,
+    # docs/compressed_collectives.md): a plan spec string, parsed here
+    # exactly as the entrypoints do; quantized legs require server_shard
+    plan = None
+    if collective_plan:
+        from commefficient_tpu.ops.collectives import parse_collective_plan
+
+        plan = parse_collective_plan(collective_plan)
     cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
                       server_shard=server_shard, guards=guards,
-                      stream_sketch=stream_sketch, telemetry=telemetry)
+                      stream_sketch=stream_sketch, telemetry=telemetry,
+                      collective_plan=plan)
     loss_train, loss_val = make_cv_losses(model)
     # the entrypoints' real execution path: shard_map+psum over a clients
     # mesh — a 1-device mesh on the single bench chip
@@ -221,7 +230,8 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
     num_clients = 500 if non_iid else 10
     server_state = init_server_state(
         scfg, sketch,
-        shard_n=mesh.shape["clients"] if server_shard else 0)
+        shard_n=mesh.shape["clients"] if server_shard else 0,
+        plan=plan)
     if server_shard:
         # commit the sharded-plane residency up front — the ONE rule
         # FedModel uses (server.place_server_state), so round 1 hits the
@@ -561,6 +571,7 @@ class CfgLeg(NamedTuple):
     guards: bool = False
     stream_sketch: bool = False
     telemetry: bool = False
+    collective_plan: str = ""
 
 
 _CFG_LEGS = {
@@ -629,6 +640,20 @@ _CFG_LEGS = {
                         "--telemetry (ResNet9, sketch 5x500k k=50k, "
                         "on-device round metrics)",
                         telemetry=True),
+    # the `shard` leg with the FULL-compressed collective plan
+    # (--collective_plan int8: table exchange AND downlink all-gather
+    # quantized, docs/compressed_collectives.md) — vs the fp32 `shard`
+    # leg this A/B reads the quantize/dequantize + EF-carry step-time
+    # cost of compressing every wire leg (~4x fewer ledger bytes; the
+    # EQuARX result, arXiv:2506.17615, predicts negligible). On the
+    # 1-chip bench mesh it pins NO-regression; a multi-chip mesh adds
+    # the actual ICI-byte win.
+    "downlink": CfgLeg("sketch", 8, "BASELINE",
+                       "8-worker sketched rounds/sec/chip with "
+                       "--server_shard --collective_plan int8 (ResNet9, "
+                       "sketch 5x500k k=50k, full-compressed wire legs "
+                       "incl. quantized downlink + dres carry)",
+                       server_shard=True, collective_plan="int8"),
 }
 
 
@@ -654,7 +679,8 @@ def run_config_measurement(name: str) -> None:
         tiny=False, num_classes=num_classes, non_iid=leg.non_iid,
         mode=leg.mode, num_workers=W, server_shard=leg.server_shard,
         fused_epilogue=leg.fused_epilogue, guards=leg.guards,
-        stream_sketch=leg.stream_sketch, telemetry=leg.telemetry)
+        stream_sketch=leg.stream_sketch, telemetry=leg.telemetry,
+        collective_plan=leg.collective_plan)
     if K > 1:
         inner = steps.train_step
 
@@ -775,6 +801,8 @@ _EXTRA_LEGS = {
                "stream_rounds_per_sec"),
     "telemetry": (["--run-cfg", "telemetry"], "BENCH_C12_TIMEOUT", 900,
                   "telemetry_rounds_per_sec"),
+    "downlink": (["--run-cfg", "downlink"], "BENCH_C12_TIMEOUT", 900,
+                 "downlink_rounds_per_sec"),
 }
 
 
@@ -1068,11 +1096,11 @@ if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--run-cfg":
         sel = sys.argv[2] if len(sys.argv) >= 3 else "<missing>"
         if sel not in ("c1", "c2", "shard", "fused", "guards", "stream",
-                       "telemetry"):
+                       "telemetry", "downlink"):
             # a missing/typo'd operand must never fall through to the full
             # parent orchestration and claim the chip for a headline bench
             sys.exit(f"--run-cfg: unknown config {sel!r}; use "
-                     f"c1|c2|shard|fused|guards|stream|telemetry")
+                     f"c1|c2|shard|fused|guards|stream|telemetry|downlink")
         run_config_measurement(sel)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--capture":

@@ -172,11 +172,37 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                              "shard update -> all-gather).")
     parser.add_argument("--reduce_dtype", choices=["float32", "int8"],
                         default="float32",
-                        help="Transmit-collective element type. int8 = "
-                             "block-scaled stochastic-rounding quantized "
-                             "reduce (~4x fewer ICI bytes) with its "
-                             "residual carried in server error feedback; "
-                             "requires --server_shard.")
+                        help="LEGACY alias of --collective_plan: int8 sets "
+                             "EVERY wire leg to the block-scaled "
+                             "stochastic-rounding quantized collectives "
+                             "(the full-compressed round, ~4x fewer ICI "
+                             "bytes) with residuals carried in server "
+                             "error feedback; requires --server_shard.")
+    # Per-leg collective plan (docs/compressed_collectives.md): choose the
+    # wire dtype of each collective leg independently — uplink (dense
+    # transmit reduce), table (sketch-table exchange), downlink (update
+    # all-gather) — from {fp32, int8, fp8_e4m3, int4}. Quantized legs run
+    # the block-scaled stochastic-rounding error-feedback collectives
+    # (ops/collectives.py) with the un-transmitted remainder carried in
+    # ServerState.qres (uplink/table) / ServerState.dres (downlink) and
+    # folded into the next round — compensated, not lossy. 'auto' runs a
+    # one-time on-chip probe at startup that times each {leg x dtype}
+    # candidate and picks the cheapest within an error budget.
+    parser.add_argument("--collective_plan", type=str, default="",
+                        help="Per-leg wire dtypes: 'leg=dtype,...' over "
+                             "legs {uplink,table,downlink} and dtypes "
+                             "{fp32,int8,fp8_e4m3,int4} (unnamed legs stay "
+                             "fp32), one bare dtype for every leg, or "
+                             "'auto' (one-time on-chip probe picks the "
+                             "cheapest dtype per leg within "
+                             "--plan_error_budget). Empty = derive from "
+                             "--reduce_dtype. Quantized legs require "
+                             "--server_shard.")
+    parser.add_argument("--plan_error_budget", type=float, default=0.05,
+                        help="Relative L2 round-trip error budget per leg "
+                             "for --collective_plan auto (a candidate "
+                             "dtype is admissible iff its calibration "
+                             "error is within this).")
     # Fused server epilogue (docs/fused_epilogue.md): one Pallas megakernel
     # replaces the composed threshold-mask + re-sketch d-plane sweeps of
     # sketch mode's server step (both the replicated and --server_shard
@@ -424,6 +450,30 @@ def validate_args(args):
         assert args.server_shard, (
             "--reduce_dtype int8 quantizes the transmit reduce of the "
             "sharded server plane; it requires --server_shard")
+    plan_spec = (getattr(args, "collective_plan", "") or "").strip()
+    if plan_spec:
+        assert args.reduce_dtype == "float32", (
+            "--collective_plan and --reduce_dtype int8 both name wire "
+            "dtypes; use --collective_plan alone (the int8 alias equals "
+            "--collective_plan int8)")
+        if plan_spec == "auto":
+            assert args.server_shard, (
+                "--collective_plan auto probes the quantized collectives "
+                "of the sharded server plane; it requires --server_shard")
+        else:
+            from commefficient_tpu.ops.collectives import (
+                parse_collective_plan,
+            )
+
+            # fail at parse time, not rounds into a run
+            plan = parse_collective_plan(plan_spec)
+            if plan.quantized:
+                assert args.server_shard, (
+                    "quantized --collective_plan legs require "
+                    "--server_shard (the block-scaled collectives live on "
+                    "the sharded server plane)")
+    assert args.plan_error_budget > 0, (
+        "--plan_error_budget must be > 0")
     if args.server_shard:
         assert not args.do_topk_down, (
             "--server_shard is incompatible with --topk_down (stale-"

@@ -271,6 +271,19 @@ class FedModel:
         # Sharded server data plane (--server_shard, docs/sharded_server.md)
         self._server_shard = bool(getattr(args, "server_shard", False))
         self._reduce_dtype = getattr(args, "reduce_dtype", None) or "float32"
+        # Sharded-server state residency: the number of worker-axis shards
+        # (0 = replicated plane); the residency rule itself lives in
+        # server.place_server_state (dense velocity/error slices and the
+        # qres/dres carries dim-0-sharded — see the ServerState docstring).
+        self._n_shard = (self.mesh.shape["clients"]
+                         if self._server_shard and self.mesh is not None
+                         else 0)
+        # Per-leg collective plan (--collective_plan,
+        # docs/compressed_collectives.md): wire dtype per leg (uplink /
+        # table / downlink), resolved HERE — before the round step builds —
+        # from the explicit spec, the one-time on-chip auto-tune probe
+        # ('auto'), or the legacy --reduce_dtype alias.
+        self.collective_plan, self.plan_report = self._resolve_plan(args)
         # On-device health guards + quarantine (--guards,
         # docs/fault_tolerance.md): the jitted server phase gates each
         # round's state transition on server.round_health and returns the
@@ -301,6 +314,7 @@ class FedModel:
                           ep_sliced=ep_sliced,
                           server_shard=self._server_shard,
                           reduce_dtype=self._reduce_dtype,
+                          collective_plan=self.collective_plan,
                           stream_sketch=self._stream_sketch,
                           guards=self._guards,
                           guard_max_abs=self._guard_max_abs,
@@ -331,13 +345,6 @@ class FedModel:
 
             self._replicated = NamedSharding(self.mesh, PartitionSpec())
         self.ps_weights = self._place_replicated(self.ps_weights)
-        # Sharded-server state residency: the number of worker-axis shards
-        # (0 = replicated plane); the residency rule itself lives in
-        # server.place_server_state (dense velocity/error slices and the
-        # int8 qres carry dim-0-sharded — see the ServerState docstring).
-        self._n_shard = (self.mesh.shape["clients"]
-                         if self._server_shard and self.mesh is not None
-                         else 0)
         # per-client state is row-sharded over the clients mesh axis; rows are
         # padded to a multiple of the mesh size so the sharding is even
         # (padded rows are never indexed — client ids < num_clients). When
@@ -508,6 +515,81 @@ class FedModel:
         return place_server_state(state, self.mesh,
                                   self.server_config.mode,
                                   bool(self._n_shard))
+
+    def _plan_leg_geoms(self):
+        """{leg: (elements, quant block)} for the wire legs THIS config
+        actually exercises, with the exact block sizes the collectives use
+        at runtime (docs/compressed_collectives.md) — the auto-tune probe
+        must measure the error statistic of the real geometry, not a
+        generic one. Sketch mode has no dense uplink (its transmit IS the
+        table); dense modes have no table leg."""
+        from commefficient_tpu.ops.collectives import DEFAULT_QUANT_BLOCK
+
+        n = max(self._n_shard, 1)
+        geoms = {}
+        if self.server_config.mode == "sketch":
+            sk = self.sketch
+            # table exchange: one scale per (c_pad,) table row
+            geoms["table"] = (sk.r * sk.c_pad, sk.c_pad)
+            # downlink gather: one scale per resident (S, 128) chunk
+            geoms["downlink"] = (-(-sk.T // n) * n * sk.sublanes * 128,
+                                 sk.sublanes * 128)
+        else:
+            d_pad = -(-self.grad_size // n) * n
+            geoms["uplink"] = (d_pad, DEFAULT_QUANT_BLOCK)
+            geoms["downlink"] = (d_pad, DEFAULT_QUANT_BLOCK)
+        return geoms
+
+    def _resolve_plan(self, args):
+        """Resolve the per-leg collective plan ONCE, before the round step
+        builds (docs/compressed_collectives.md): an explicit
+        ``--collective_plan`` spec wins (``auto`` runs the one-time
+        on-chip probe over this config's real leg geometries); otherwise
+        the legacy ``--reduce_dtype`` alias (int8 = every leg int8 — the
+        full-compressed round). Returns ``(plan, autotune report|None)``;
+        both land in the telemetry run_start event so the resolved plan is
+        auditable from the run log alone."""
+        from commefficient_tpu.ops import collectives as C
+
+        spec = (getattr(args, "collective_plan", "") or "").strip()
+        report = None
+        if not spec:
+            plan = C.plan_from_reduce_dtype(self._reduce_dtype)
+        elif spec == "auto":
+            assert self._n_shard, \
+                "--collective_plan auto requires --server_shard (the " \
+                "quantized collectives live on the sharded server plane)"
+            budget = float(getattr(args, "plan_error_budget", 0.05) or 0.05)
+            plan, report = C.autotune_collective_plan(
+                self._plan_leg_geoms(), error_budget=budget,
+                seed=int(getattr(args, "seed", 0)))
+            print(f"collective_plan auto -> {plan.spec()} "
+                  f"(error budget {budget:g}; probe report in the "
+                  "telemetry run_start event)")
+        else:
+            plan = C.parse_collective_plan(spec)
+            # an explicitly named leg this mode never exercises (sketch
+            # mode has no dense uplink — its transmit IS the table; dense
+            # modes have no table exchange) would silently run exact fp32
+            # while the logged plan claims compression — say so up front.
+            # The bare-dtype / alias spellings set every leg on purpose,
+            # so only leg=dtype specs warn.
+            if "=" in spec:
+                unused = ("uplink" if self.server_config.mode == "sketch"
+                          else "table")
+                if getattr(plan, unused) != "float32":
+                    import warnings
+
+                    warnings.warn(
+                        f"--collective_plan names {unused}="
+                        f"{getattr(plan, unused)}, but mode="
+                        f"{self.server_config.mode} has no {unused} leg — "
+                        "that entry will not compress anything")
+        if plan.quantized:
+            assert self._n_shard, \
+                "quantized collective legs (--collective_plan / " \
+                "--reduce_dtype int8) require --server_shard"
+        return plan, report
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -875,7 +957,7 @@ class FedOptimizer:
             init_server_state(
                 fed_model.server_config, fed_model.sketch,
                 shard_n=fed_model._n_shard,
-                quantized=fed_model._reduce_dtype == "int8"))
+                plan=fed_model.collective_plan))
         self._base_lr_vec = None
         if len(self.param_groups) > 1 or self.param_groups[0][0] is not None:
             vec = np.zeros(fed_model.grad_size, np.float32)

@@ -187,11 +187,17 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
     arrays["server/velocity"] = canon_server(optimizer.server_state.velocity)
     arrays["server/error"] = canon_server(optimizer.server_state.error)
     if optimizer.server_state.qres is not None:
-        # the int8 transmit collective's per-chip EF carry
+        # the quantized transmit collective's per-chip EF carry
         # (server.ServerState.qres) — shape (n_shard, *transmit_shape), a
         # shard-count-dependent layout; the restore zero-inits it when the
         # geometry changed (a safe restart for an error-feedback carry)
         arrays["server/qres"] = np.asarray(optimizer.server_state.qres)
+    if optimizer.server_state.dres is not None:
+        # the quantized DOWNLINK gather's per-chip EF carry
+        # (server.ServerState.dres, docs/compressed_collectives.md) —
+        # the gathered update-tile layout, shard-count-dependent like
+        # qres; same zero-reinit warn path on a geometry/plan mismatch
+        arrays["server/dres"] = np.asarray(optimizer.server_state.dres)
     arrays["rng"] = np.asarray(jax.random.key_data(fm._rng))
     np_name, np_keys, np_pos, np_has_gauss, np_cached = np.random.get_state()
     arrays["np_rng/keys"] = np_keys
@@ -492,24 +498,31 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler,
             a = jnp.pad(a, (0, int(cur_v.shape[0]) - fm.grad_size))
         return a
 
-    cur_q = optimizer.server_state.qres
-    qres = None
-    if cur_q is not None:
-        if "server/qres" in flat \
-                and flat["server/qres"].shape == tuple(cur_q.shape):
-            qres = jnp.asarray(flat["server/qres"])
-        else:
-            # missing (pre-int8 checkpoint) or a different shard geometry:
-            # an EF carry restarts safely from zero — warn, don't fail
-            import warnings
+    def restore_carry(name, cur, what):
+        """The EF carries (qres uplink / dres downlink) share one restore
+        contract: exact restore when the checkpoint has a matching-shape
+        array; otherwise — missing (a checkpoint from a less-compressed
+        plan, e.g. fp32 restoring into a quantized run) or a different
+        shard geometry — an error-feedback carry restarts safely from
+        zero, so warn, don't fail (pinned in test_fault_tolerance /
+        test_compressed_collectives)."""
+        if cur is None:
+            return None
+        key = "server/" + name
+        if key in flat and flat[key].shape == tuple(cur.shape):
+            return jnp.asarray(flat[key])
+        import warnings
 
-            warnings.warn("checkpoint has no matching server/qres carry; "
-                          "re-initializing the quantized-reduce residual "
-                          "to zero")
-            qres = jnp.zeros_like(cur_q)
+        warnings.warn(f"checkpoint has no matching {key} carry; "
+                      f"re-initializing the {what} residual to zero")
+        return jnp.zeros_like(cur)
+
     state = ServerState(velocity=server_resident(flat["server/velocity"]),
                         error=server_resident(flat["server/error"]),
-                        qres=qres)
+                        qres=restore_carry("qres", optimizer.server_state.qres,
+                                           "quantized-reduce"),
+                        dres=restore_carry("dres", optimizer.server_state.dres,
+                                           "quantized-downlink"))
     placer = getattr(fm, "place_server_state", None)
     optimizer.server_state = (placer(state) if placer is not None
                               else jax.tree_util.tree_map(place, state))

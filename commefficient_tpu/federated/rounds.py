@@ -187,8 +187,21 @@ class RoundConfig:
     # Transmit-collective element type (--reduce_dtype): "int8" swaps the
     # fp32 reduce for the block-scaled stochastic-rounding collective
     # (ops/collectives.py) with its residual carried in ServerState.qres.
-    # Opt-in; requires server_shard.
+    # Opt-in; requires server_shard. LEGACY alias — since the per-leg
+    # collective plan landed it means "every leg int8"; prefer
+    # collective_plan below.
     reduce_dtype: str = "float32"
+    # Per-leg collective plan (--collective_plan,
+    # docs/compressed_collectives.md): an ops.collectives.CollectivePlan
+    # choosing the wire dtype of each leg — uplink (dense transmit
+    # reduce), table (sketch-table exchange), downlink (update
+    # all-gather) — from {float32, int8, fp8_e4m3, int4}. None derives
+    # the plan from reduce_dtype. Quantized legs require server_shard;
+    # their error-feedback residuals ride ServerState.qres (uplink/table)
+    # and ServerState.dres (downlink). The fp32 plan is bit-identical to
+    # the pre-plan code paths (pinned in
+    # tests/test_compressed_collectives.py).
+    collective_plan: Optional[Any] = None
     # Streaming client-phase sketch (--stream_sketch,
     # docs/stream_sketch.md): the fused client phase's microbatch scan
     # carries the (r, c_pad) count-sketch TABLE instead of the d-sized
@@ -264,8 +277,22 @@ def build_round_step(
     # up front, mirroring the chunked_resident ones below.
     server_shard = bool(cfg.server_shard)
     assert cfg.reduce_dtype in ("float32", "int8"), cfg.reduce_dtype
-    if cfg.reduce_dtype == "int8":
-        assert server_shard, "--reduce_dtype int8 requires --server_shard"
+    # resolve the per-leg collective plan (docs/compressed_collectives.md):
+    # an explicit plan wins; otherwise the legacy --reduce_dtype alias
+    # (int8 = every leg int8, float32 = the exact fp32 plan)
+    from commefficient_tpu.ops.collectives import (
+        CollectivePlan,
+        plan_from_reduce_dtype,
+    )
+
+    plan = cfg.collective_plan
+    if plan is None:
+        plan = plan_from_reduce_dtype(cfg.reduce_dtype)
+    assert isinstance(plan, CollectivePlan), plan
+    if plan.quantized:
+        assert server_shard, \
+            "quantized collective legs (--collective_plan / " \
+            "--reduce_dtype int8) require --server_shard"
     if server_shard:
         assert mesh is not None and axis in mesh.axis_names, \
             "--server_shard needs a mesh with the worker axis"
@@ -733,7 +760,8 @@ def build_round_step(
             # sharded server plane: DON'T reduce here — return this
             # shard's sum stacked under a leading axis (out_spec P(axis):
             # no data moves), so the server phase owns the reduce (and,
-            # under --reduce_dtype int8, the quantization + qres carry)
+            # under a quantized collective plan, the quantization + the
+            # qres/dres error-feedback carries)
             total = local_sum[None]
         elif mesh is not None:
             total = jax.lax.psum(local_sum, axis)
@@ -850,13 +878,12 @@ def build_round_step(
         _state_spec = ServerState(
             velocity=P() if scfg.mode == "sketch" else _vec,
             error=P() if scfg.mode == "sketch" else _vec,
-            qres=_vec)
+            qres=_vec, dres=_vec)
 
         def _sharded_inner(g, st, lr_, rng_, count_):
             return sharded_server_update(
                 g[0], st, scfg, lr_, count_, axis=axis, n_shard=n_shard,
-                sketch=sketch, layout=layout, rng=rng_,
-                reduce_dtype=cfg.reduce_dtype)
+                sketch=sketch, layout=layout, rng=rng_, plan=plan)
 
         def _sharded_server(grad_stacked, server_state, lr_, rng_, count_):
             return shard_map(

@@ -107,43 +107,80 @@ class ServerState(NamedTuple):
     dense-mode velocity/error become ``(d_pad,)`` (grad_size padded to a
     multiple of the shard count), row-sharded over the worker axis — each
     chip stores and updates only its ``d_pad/n`` slice. Sketch-mode tables
-    stay replicated (they are the already-small transmit). ``qres`` exists
-    only under ``--reduce_dtype int8``: each chip's un-transmitted
-    quantization remainder from the block-scaled int8 transmit collective
-    (ops/collectives.py), shape ``(n, *transmit_shape)`` sharded over dim
-    0 — the error-feedback carry that is added back into the chip's next
-    contribution before quantization, so the quantized reduce is
-    compensated, not lossy."""
+    stay replicated (they are the already-small transmit).
+
+    Compressed-collective carries (docs/compressed_collectives.md; both
+    are error-feedback residuals, zero-initialized and safe to restart
+    from zero):
+
+    - ``qres`` exists when the UPLINK leg (dense transmit reduce or
+      sketch-table exchange) of the collective plan is quantized: each
+      chip's un-transmitted quantization remainder from the block-scaled
+      transmit collective (ops/collectives.py), shape
+      ``(n, *transmit_shape)`` sharded over dim 0 — added back into the
+      chip's next contribution before quantization, so the quantized
+      reduce is compensated, not lossy.
+    - ``dres`` exists when the DOWNLINK leg (the update all-gather) is
+      quantized: each chip's un-transmitted remainder of its own update
+      tile, in the gathered layout sharded over dim 0 — sketch mode
+      ``(n·⌈T/n⌉, S, 128)`` chunk rows, dense ``(d_pad,)`` — folded into
+      the chip's next-round emitted update tile before quantization, so
+      the downlink error telescopes exactly as ``qres`` telescopes the
+      uplink."""
 
     velocity: jax.Array
     error: jax.Array
     qres: Optional[jax.Array] = None
+    dres: Optional[jax.Array] = None
 
 
 def init_server_state(cfg: ServerConfig, sketch: Optional[CountSketch] = None,
                       shard_n: int = 0,
-                      quantized: bool = False) -> ServerState:
+                      quantized: bool = False,
+                      plan=None) -> ServerState:
     """``shard_n`` > 0 selects the sharded-server residency (see
-    ServerState): dense state padded to a shard_n multiple, plus the
-    ``qres`` carry when ``quantized``."""
+    ServerState). ``plan`` (a ``CollectivePlan``,
+    docs/compressed_collectives.md) decides which error-feedback carries
+    exist: ``qres`` when the mode's uplink leg (dense transmit / sketch
+    table) is quantized, ``dres`` when the downlink all-gather is.
+    ``quantized`` is the legacy ``--reduce_dtype int8`` spelling — the
+    all-int8 plan (every leg quantized)."""
+    from commefficient_tpu.ops.collectives import plan_from_reduce_dtype
+
+    if plan is None:
+        plan = plan_from_reduce_dtype("int8" if quantized else "float32")
     if cfg.mode == "sketch":
         assert sketch is not None
         shape = sketch.table_shape
     else:
         d = cfg.grad_size
         shape = (-(-d // shard_n) * shard_n,) if shard_n else (d,)
+    uplink_leg = plan.table if cfg.mode == "sketch" else plan.uplink
     qres = None
-    if quantized:
-        assert shard_n > 0, "--reduce_dtype int8 requires --server_shard"
+    if uplink_leg != "float32":
+        assert shard_n > 0, \
+            "quantized collective legs require --server_shard"
         qres = jnp.zeros((shard_n,) + shape if cfg.mode == "sketch"
                          else (shard_n, shape[0]), jnp.float32)
-    # Two separate zeros computations, NOT one shared array: the round step
+    dres = None
+    if plan.downlink != "float32":
+        assert shard_n > 0, \
+            "quantized collective legs require --server_shard"
+        if cfg.mode == "sketch":
+            # the gathered update layout: each chip owns ceil(T/n) chunk
+            # rows of (S, 128), padded to the shard multiple
+            Tn = -(-sketch.T // shard_n)
+            dres = jnp.zeros((Tn * shard_n, sketch.sublanes, 128),
+                             jnp.float32)
+        else:
+            dres = jnp.zeros(shape, jnp.float32)  # (d_pad,), dim-0 sharded
+    # Separate zeros computations, NOT one shared array: the round step
     # donates server_state (rounds.build_round_step), and donating a pytree
     # whose two leaves share one buffer is an execute-time error
     # ("attempt to donate the same buffer twice").
     return ServerState(velocity=jnp.zeros(shape, jnp.float32),
                        error=jnp.zeros(shape, jnp.float32),
-                       qres=qres)
+                       qres=qres, dres=dres)
 
 
 def place_server_state(state: ServerState, mesh, mode: str,
@@ -151,7 +188,7 @@ def place_server_state(state: ServerState, mesh, mode: str,
     """THE sharded-server residency rule, in one place (callers: FedModel,
     bench.py, the multichip dry-run): sketch tables replicated (they are
     the already-small transmit), dense velocity/error dim-0-sharded over
-    the worker axis, the qres carry always dim-0-sharded. Committing
+    the worker axis, the qres/dres carries always dim-0-sharded. Committing
     fresh state to these shardings up front keeps round 1 on the jit
     cache and donation safe (see aggregator._place_replicated). ``put``
     overrides plain ``jax.device_put`` for multi-process global arrays
@@ -173,7 +210,8 @@ def place_server_state(state: ServerState, mesh, mode: str,
     return state._replace(
         velocity=put(state.velocity, state_sh),
         error=put(state.error, state_sh),
-        qres=None if state.qres is None else put(state.qres, sh0))
+        qres=None if state.qres is None else put(state.qres, sh0),
+        dres=None if state.dres is None else put(state.dres, sh0))
 
 
 def round_health(transmit, new_ps, max_abs: float = 0.0):
@@ -284,6 +322,7 @@ def sharded_server_update(
     layout: Optional[ChunkLayout] = None,
     rng: Optional[jax.Array] = None,
     reduce_dtype: str = "float32",
+    plan=None,
 ) -> Tuple[jax.Array, ServerState, Optional[jax.Array]]:
     """The sharded server data plane's per-shard step — MUST run inside a
     ``shard_map`` over mesh axis ``axis`` (rounds.build_round_step wraps
@@ -309,37 +348,63 @@ def sharded_server_update(
       movement), then scaled by ``lr`` replicated — so fp32 trajectories
       are bit-identical to ``server_update``'s (pinned in
       tests/test_sharded_server.py).
-    - ``reduce_dtype == "int8"`` swaps the reduce for the block-scaled
-      stochastic-rounding collective (ops/collectives.py); the carry
-      ``state.qres`` (this chip's row) is folded into the contribution
-      before quantization and the new remainder is returned in the new
-      state — error feedback for the transmit itself.
+    - the per-leg ``plan`` (``CollectivePlan``,
+      docs/compressed_collectives.md) swaps individual wire legs for the
+      block-scaled stochastic-rounding collectives (ops/collectives.py):
+      a quantized uplink/table leg folds the carry ``state.qres`` (this
+      chip's row) into the contribution before quantization; a quantized
+      DOWNLINK leg quantizes each chip's update tile before the
+      all-gather, with the un-transmitted remainder carried per chip in
+      ``state.dres`` and folded into the next round's emitted tile —
+      error feedback for both wire directions. ``reduce_dtype`` is the
+      legacy alias (int8 = every leg int8) used when ``plan`` is None.
+      The exact-update byproducts (re-sketch cells, top-k masking, DP
+      noise) are computed from the EXACT update — what the quantized
+      gather did not deliver this round is exactly what ``dres`` delivers
+      later, so the server's own EF accounting stays in update units.
 
     Returns ``(lr-scaled full update, new local state, re-sketched update
     table or None)`` — the table is sketch mode's cell-masking byproduct
     (psum of the shards' partial re-sketches), reused by the round's
     client-state masking so it is not recomputed.
     """
-    assert reduce_dtype in ("float32", "int8"), reduce_dtype
     from commefficient_tpu.ops.collectives import (
         all_gather_tiled,
+        plan_from_reduce_dtype,
+        quantized_all_gather,
         quantized_psum,
         quantized_psum_scatter,
         reduce_scatter_sum,
     )
 
+    if plan is None:
+        plan = plan_from_reduce_dtype(reduce_dtype)
+    uplink_leg = plan.table if cfg.mode == "sketch" else plan.uplink
+
     qres_local = state.qres  # (1, *transmit_shape) local row, or None
-    if reduce_dtype == "int8":
+    dres_local = state.dres  # this chip's update-tile residual, or None
+    if uplink_leg != "float32":
         assert qres_local is not None, \
-            "int8 reduce needs the qres carry (init_server_state quantized=)"
+            "quantized uplink/table leg needs the qres carry " \
+            "(init_server_state plan=)"
+    if plan.downlink != "float32":
+        assert dres_local is not None, \
+            "quantized downlink leg needs the dres carry " \
+            "(init_server_state plan=)"
+    # one SR stream per quantized leg; when only one leg is quantized the
+    # raw key is used directly, so a plan that quantizes exactly the legs
+    # --reduce_dtype int8 used to reproduces the PR-2 draws
+    rng_up = rng_down = rng
+    if uplink_leg != "float32" and plan.downlink != "float32":
+        rng_up, rng_down = jax.random.split(rng)
 
     if cfg.mode == "sketch":
         assert sketch is not None and layout is not None
-        if reduce_dtype == "int8":
+        if uplink_leg != "float32":
             # block = one table row (c_pad = S·128 lanes) per scale
             table, new_qres = quantized_psum(
-                transmit_local, axis, rng, residual=qres_local[0],
-                block=sketch.c_pad)
+                transmit_local, axis, rng_up, residual=qres_local[0],
+                block=sketch.c_pad, dtype=uplink_leg)
             new_qres = new_qres[None]
         else:
             table = jax.lax.psum(transmit_local, axis)
@@ -377,17 +442,29 @@ def sharded_server_update(
         if cfg.error_type == "local":
             # torch aliasing parity (see _sketched)
             error = velocity
-        update = all_gather_tiled(upd_local, axis)[: sketch.T]
-        return (update * lr, ServerState(velocity, error, new_qres),
+        if plan.downlink != "float32":
+            # downlink leg: quantize this shard's update chunks (one scale
+            # per (S, 128) resident chunk) before the gather; the
+            # remainder telescopes through dres like qres on the uplink
+            full, new_dres = quantized_all_gather(
+                upd_local, axis, rng_down, residual=dres_local,
+                block=sketch.sublanes * 128, dtype=plan.downlink)
+            update = full[: sketch.T]
+        else:
+            update = all_gather_tiled(upd_local, axis)[: sketch.T]
+            new_dres = dres_local
+        return (update * lr,
+                ServerState(velocity, error, new_qres, new_dres),
                 resketched)
 
     # ---- dense modes: flat (d,) transmit, state as local slices --------
     d = cfg.grad_size
     d_pad = -(-d // n_shard) * n_shard
     x = jnp.pad(transmit_local, (0, d_pad - d))
-    if reduce_dtype == "int8":
-        tile, new_qres = quantized_psum_scatter(x, axis, rng,
-                                                residual=qres_local[0])
+    if uplink_leg != "float32":
+        tile, new_qres = quantized_psum_scatter(x, axis, rng_up,
+                                                residual=qres_local[0],
+                                                dtype=uplink_leg)
         new_qres = new_qres[None]
     else:
         tile = reduce_scatter_sum(x, axis)
@@ -410,15 +487,30 @@ def sharded_server_update(
             # one replicated (d_pad,)-stream draw, locally sliced, so every
             # shard agrees on the full noise vector (the stream differs
             # from the replicated path's (d,)-shaped draw — documented in
-            # docs/sharded_server.md)
-            noise = jax.random.normal(rng, (d_pad,), upd_local.dtype)
+            # docs/sharded_server.md). Under a quantized plan the raw key
+            # (or its split children) already feeds the collectives' SR
+            # draws — fold to a distinct stream so the DP noise stays
+            # statistically independent of the quantization dither; the
+            # fp32 plan keeps the pre-plan draw bit for bit.
+            noise_rng = rng
+            if uplink_leg != "float32" or plan.downlink != "float32":
+                noise_rng = jax.random.fold_in(rng, 2)
+            noise = jax.random.normal(noise_rng, (d_pad,), upd_local.dtype)
             per = d_pad // n_shard
             upd_local = upd_local + cfg.noise_multiplier * \
                 jax.lax.dynamic_slice_in_dim(
                     noise, jax.lax.axis_index(axis) * per, per)
 
-    update = all_gather_tiled(upd_local, axis)[:d]
-    return update * lr, ServerState(velocity, error, new_qres), None
+    if plan.downlink != "float32":
+        full, new_dres = quantized_all_gather(
+            upd_local, axis, rng_down, residual=dres_local,
+            dtype=plan.downlink)
+        update = full[:d]
+    else:
+        update = all_gather_tiled(upd_local, axis)[:d]
+        new_dres = dres_local
+    return (update * lr, ServerState(velocity, error, new_qres, new_dres),
+            None)
 
 
 def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch,

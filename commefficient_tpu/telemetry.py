@@ -91,12 +91,20 @@ __all__ = [
 #   velocity_norm / error_norm — post-round server carries. error_norm IS
 #     the sketch-estimation residual: the accumulated estimate energy the
 #     threshold did not emit, carried forward by error feedback.
-#   qres_norm — the int8 transmit collective's un-transmitted quantization
-#     remainder (--reduce_dtype int8; 0 otherwise).
+#   qres_norm — the quantized UPLINK collective's un-transmitted
+#     quantization remainder (a quantized uplink/table plan leg, incl. the
+#     legacy --reduce_dtype int8 alias; 0 otherwise).
 #   ps_norm / ps_max_abs — the post-round weights (ps_max_abs is the
 #     magnitude-guard quantity).
 #   guard_ok — the round-health verdict as 1.0/0.0 (1.0 when --guards is
 #     off: an unguarded round is presumed healthy).
+#   dres_norm — the quantized DOWNLINK gather's un-transmitted remainder
+#     (ServerState.dres, docs/compressed_collectives.md; 0 otherwise):
+#     per-round visibility of compressed-downlink drift with zero new
+#     host syncs. SCHEMA v2: appended as the LAST slot so v1 logs (11
+#     fields) and v2 logs (12) disagree only in the tail — readers
+#     (obs_report.py, aggregator.finish_round's zip) key fields by the
+#     run_start schema list, so both versions parse.
 METRIC_FIELDS = (
     "transmit_norm",
     "transmit_max_abs",
@@ -109,6 +117,7 @@ METRIC_FIELDS = (
     "ps_norm",
     "ps_max_abs",
     "guard_ok",
+    "dres_norm",
 )
 
 
@@ -139,6 +148,7 @@ def device_round_metrics(transmit, update, new_ps, state, guard_ok=None):
         jnp.max(jnp.abs(new_ps.astype(jnp.float32))),
         (guard_ok.astype(jnp.float32) if guard_ok is not None
          else jnp.float32(1.0)),
+        l2(state.dres) if state.dres is not None else jnp.float32(0.0),
     )
     out = jnp.stack([jnp.asarray(v, jnp.float32).reshape(()) for v in vals])
     assert out.shape == (len(METRIC_FIELDS),)
@@ -148,76 +158,97 @@ def device_round_metrics(transmit, update, new_ps, state, guard_ok=None):
 def collective_ledger(mode: str, grad_size: int, *,
                       sketch=None, n_shard: int = 0,
                       reduce_dtype: str = "float32",
-                      k: int = 0) -> Dict[str, Dict[str, Any]]:
+                      k: int = 0, plan=None) -> Dict[str, Dict[str, Any]]:
     """Static per-round wire-byte ledger, one entry per collective leg.
 
-    Bytes are LOGICAL payload per chip per round (element count x element
-    size, plus the int8 collective's per-block f32 scales via
-    ``ops.collectives.int8_payload_bytes``) — ring/all-to-all topology
-    factors are deliberately excluded so the numbers compare across mesh
-    sizes. The runtime-dependent half of the accounting (per-client
-    download bytes, which depend on staleness) stays in the aggregator's
-    device-resident accounting and is reported per round by the training
-    loops; this ledger prices the fixed legs, Konecny-style
-    (arXiv:1610.05492: uplink and downlink accounted separately).
-    """
-    from commefficient_tpu.ops.collectives import int8_payload_bytes
+    Bytes are LOGICAL payload per chip per round, priced by THE one
+    formula the collectives themselves implement
+    (``ops.collectives.payload_bytes``: element payload at the leg's wire
+    dtype + per-block f32 scales, nibble packing for int4) — so the
+    accounting and the collectives can never disagree on any dtype's wire
+    cost. Ring/all-to-all topology factors are deliberately excluded so
+    the numbers compare across mesh sizes. The runtime-dependent half of
+    the accounting (per-client download bytes, which depend on staleness)
+    stays in the aggregator's device-resident accounting and is reported
+    per round by the training loops; this ledger prices the fixed legs,
+    Konecny-style (arXiv:1610.05492: uplink and downlink accounted
+    separately).
 
+    ``plan`` (an ``ops.collectives.CollectivePlan``) prices each leg at
+    its planned wire dtype — the exact blocks the collectives use at
+    runtime (table: one scale per (c_pad,) row; downlink sketch: one per
+    (S, 128) chunk; dense: DEFAULT_QUANT_BLOCK). ``reduce_dtype`` is the
+    legacy alias used when ``plan`` is None.
+    """
+    from commefficient_tpu.ops.collectives import (
+        DEFAULT_QUANT_BLOCK,
+        payload_bytes,
+        plan_from_reduce_dtype,
+    )
+
+    if plan is None:
+        plan = plan_from_reduce_dtype(reduce_dtype)
     d = int(grad_size)
     ledger: Dict[str, Dict[str, Any]] = {}
 
-    def leg(name, collective, elems, dtype, bytes_):
+    def leg(name, collective, elems, dtype, block=DEFAULT_QUANT_BLOCK):
+        if dtype != "float32":
+            collective = f"{collective} ({dtype}+scales)"
         ledger[name] = {"collective": collective, "elements": int(elems),
-                        "dtype": dtype, "bytes_per_round": int(bytes_)}
+                        "dtype": dtype,
+                        "bytes_per_round": int(payload_bytes(int(elems),
+                                                             dtype, block))}
 
     # per-client uplink: what one participating client logically transmits
     # (mirrors aggregator._account_bytes_deferred's upload accounting)
     if mode == "sketch":
         table_elems = sketch.r * sketch.c_pad if sketch is not None else 0
-        leg("client_uplink", "transmit", table_elems, "float32",
-            4 * table_elems)
-        if reduce_dtype == "int8":
-            leg("transmit_reduce", "quantized_psum (int8+scales)",
-                table_elems, "int8",
-                int8_payload_bytes(
-                    table_elems,
-                    block=sketch.c_pad if sketch is not None else None))
+        c_pad = sketch.c_pad if sketch is not None else None
+        leg("client_uplink", "transmit", table_elems, "float32")
+        if plan.table != "float32":
+            leg("transmit_reduce", "quantized_psum", table_elems,
+                plan.table, block=c_pad)
         else:
-            leg("transmit_reduce", "psum", table_elems, "float32",
-                4 * table_elems)
+            leg("transmit_reduce", "psum", table_elems, "float32")
     else:
         per_client = k if mode == "local_topk" else d
-        leg("client_uplink", "transmit", per_client, "float32",
-            4 * per_client)
+        leg("client_uplink", "transmit", per_client, "float32")
         d_pad = -(-d // n_shard) * n_shard if n_shard else d
-        if n_shard and reduce_dtype == "int8":
-            leg("transmit_reduce", "quantized_psum_scatter (int8+scales)",
-                d_pad, "int8", int8_payload_bytes(d_pad))
+        if n_shard and plan.uplink != "float32":
+            leg("transmit_reduce", "quantized_psum_scatter", d_pad,
+                plan.uplink)
         elif n_shard:
-            leg("transmit_reduce", "psum_scatter", d_pad, "float32",
-                4 * d_pad)
+            leg("transmit_reduce", "psum_scatter", d_pad, "float32")
         else:
-            leg("transmit_reduce", "psum", d, "float32", 4 * d)
+            leg("transmit_reduce", "psum", d, "float32")
 
     if n_shard:
-        # downlink half of the sharded plane: the exact-f32 update
-        # all-gather (Konecny's other direction — ROADMAP 3's compression
-        # target, hence its own ledger row)
+        # downlink half of the sharded plane: the update all-gather
+        # (Konecny's other direction — quantized per the plan's downlink
+        # leg, with the remainder carried in ServerState.dres;
+        # docs/compressed_collectives.md)
         if mode == "sketch" and sketch is not None:
             # the sharded sketch server gathers update CHUNKS: ceil(T/n)
             # chunks per shard x n shards of (S, 128) each
             up_elems = (-(-sketch.T // n_shard) * n_shard
                         * sketch.sublanes * 128)
+            down_block = sketch.sublanes * 128
         else:
             up_elems = -(-d // n_shard) * n_shard
-        leg("update_all_gather", "all_gather", up_elems, "float32",
-            4 * up_elems)
+            down_block = DEFAULT_QUANT_BLOCK
+        if plan.downlink != "float32":
+            leg("update_all_gather", "quantized_all_gather", up_elems,
+                plan.downlink, block=down_block)
+        else:
+            leg("update_all_gather", "all_gather", up_elems, "float32")
         if mode in ("sketch", "true_topk"):
             # the radix descent's psum'd count exchange: 16 s32 candidates
-            # per pass, ~8 passes (ops/topk.py) — negligible, listed so the
-            # ledger is complete
-            leg("threshold_exchange", "psum (count exchange)", 16 * 8,
-                "int32", 4 * 16 * 8)
+            # per pass, ~8 passes (ops/topk.py) — negligible (and not a
+            # payload_bytes wire dtype), listed so the ledger is complete
+            ledger["threshold_exchange"] = {
+                "collective": "psum (count exchange)",
+                "elements": 16 * 8, "dtype": "int32",
+                "bytes_per_round": 4 * 16 * 8}
     return ledger
 
 
@@ -368,12 +399,18 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
     if not getattr(args, "telemetry", False):
         return None
     path = os.path.join(log_dir, "telemetry.jsonl")
+    # the RESOLVED per-leg plan (explicit spec, the auto-tune probe's
+    # pick, or the legacy --reduce_dtype alias — aggregator._resolve_plan)
+    # prices the ledger and is recorded verbatim, so obs_report shows the
+    # real per-leg wire bytes and an 'auto' run's chosen plan is auditable
+    # from the log alone (docs/compressed_collectives.md)
+    plan = getattr(fed_model, "collective_plan", None)
     ledger = collective_ledger(
         args.mode, fed_model.grad_size, sketch=fed_model.sketch,
         n_shard=fed_model._n_shard,
         reduce_dtype=getattr(args, "reduce_dtype", "float32") or "float32",
-        k=args.k)
-    rt = RunTelemetry(path, run_info={
+        k=args.k, plan=plan)
+    run_info = {
         "entrypoint": entrypoint,
         "mode": args.mode,
         "grad_size": fed_model.grad_size,
@@ -385,7 +422,13 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
         "seed": args.seed,
         "backend": jax.default_backend(),
         "ledger": ledger,
-    })
+    }
+    if plan is not None:
+        run_info["collective_plan"] = plan.spec()
+    if getattr(fed_model, "plan_report", None):
+        # the auto-tune probe's per-{leg x dtype} rel_err/probe_ms/bytes
+        run_info["collective_plan_probe"] = fed_model.plan_report
+    rt = RunTelemetry(path, run_info=run_info)
     fed_model.telemetry = rt
     print(f"telemetry: run event log -> {path} "
           "(docs/observability.md; --no_telemetry disables)")

@@ -18,7 +18,15 @@
 #   - the telemetry plane's non-perturbation (fp32 bit-identity with
 #     --telemetry on/off on BOTH planes) and its strict zero-host-sync
 #     audit with guards+telemetry through the engine
-#     (tests/test_telemetry.py, docs/observability.md).
+#     (tests/test_telemetry.py, docs/observability.md);
+#   - the per-leg compressed-collective plan (--collective_plan,
+#     docs/compressed_collectives.md): the fp32 plan bit-identical to the
+#     legacy --reduce_dtype path across both planes x both epilogues, the
+#     quantized downlink's dres conservation/telescoping contracts
+#     (mirroring the qres suite), int4/fp8 pack-unpack round-trips,
+#     payload_bytes == ledger == actual payload agreement, quarantine
+#     leaving dres untouched, and the fp32-plan -> compressed-plan
+#     checkpoint warn path (tests/test_compressed_collectives.py).
 # Any extra args are passed through to pytest (e.g. -k bit_identical).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -26,4 +34,5 @@ exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_sharded_server.py tests/test_fused_epilogue.py \
     tests/test_stream_sketch.py tests/test_telemetry.py \
+    tests/test_compressed_collectives.py \
     -q -p no:cacheprovider "$@"

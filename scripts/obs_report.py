@@ -103,6 +103,7 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
     ledger_totals = {
         leg: {"bytes_per_round": row["bytes_per_round"],
               "collective": row["collective"],
+              "dtype": row.get("dtype"),
               "total_bytes": row["bytes_per_round"] * len(rounds)}
         for leg, row in ledger.items()}
 
@@ -157,6 +158,17 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         "mean_update_nnz": _fin(metric_mean("update_nnz")),
         "mean_topk_threshold": _fin(metric_mean("topk_threshold")),
         "mean_error_norm": _fin(metric_mean("error_norm")),
+        # EF carries of the quantized collective legs
+        # (docs/compressed_collectives.md). Schema-version tolerant by
+        # construction: round events carry metrics as a name-keyed dict,
+        # so a v1 log (11-field schema, no dres_norm slot) simply yields
+        # None here instead of failing to parse.
+        "collective_plan": run_info.get("collective_plan"),
+        "mean_qres_norm": _fin(metric_mean("qres_norm")),
+        "mean_dres_norm": _fin(metric_mean("dres_norm")),
+        "wire_bytes_per_round": sum(
+            row["bytes_per_round"] for leg, row in ledger.items()
+            if leg != "client_uplink") or None,
         "mean_loss": _fin(_mean([e["loss"] for e in rounds
                                  if isinstance(e.get("loss"), float)
                                  and math.isfinite(e["loss"])])),
@@ -203,16 +215,29 @@ def render(events: List[dict], out=sys.stdout) -> Dict[str, Any]:
 
     if s["ledger"]:
         p("\n## Compression ledger (static legs x drained rounds)")
-        p("| leg | collective | bytes/round | total bytes |")
-        p("|---|---|---|---|")
+        if s["collective_plan"]:
+            p(f"collective plan: {s['collective_plan']} "
+              "(docs/compressed_collectives.md)")
+        p("| leg | collective | dtype | bytes/round | total bytes |")
+        p("|---|---|---|---|---|")
         for leg, row in s["ledger"].items():
-            p(f"| {leg} | {row['collective']} | "
+            p(f"| {leg} | {row['collective']} | {row.get('dtype') or '?'} | "
               f"{row['bytes_per_round']:,} | {row['total_bytes']:,} |")
+        if s["wire_bytes_per_round"]:
+            p(f"mesh wire legs total: {s['wire_bytes_per_round']:,} "
+              "bytes/round (client_uplink excluded — per-client, not a "
+              "mesh collective)")
     if s["mean_update_nnz"] is not None:
         p(f"runtime compression: mean resolved k "
           f"{s['mean_update_nnz']:.1f}, mean |threshold| "
           f"{s['mean_topk_threshold']:.3g}, mean error-carry norm "
           f"{s['mean_error_norm']:.3g}")
+    if s["mean_qres_norm"] or s["mean_dres_norm"]:
+        dres = (f"{s['mean_dres_norm']:.3g}"
+                if isinstance(s["mean_dres_norm"], (int, float))
+                else "n/a (pre-dres schema log)")
+        p(f"quantized-collective EF carries: mean qres (uplink) "
+          f"{s['mean_qres_norm'] or 0:.3g}, mean dres (downlink) {dres}")
 
     p("\n## Guard / rollback history")
     if not s["guards"]:

@@ -67,8 +67,9 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # new stream/stream_sketch/profile_stream legs; one pass decides both
 # defaults (docs/stream_sketch.md, docs/fused_epilogue.md).
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
-telemetry stream_sketch fused_epilogue learning profile profile_fused \
-profile_stream profile_gpt2 host_offload imagenet ops"}
+telemetry downlink compressed_collectives stream_sketch fused_epilogue \
+learning profile profile_fused profile_stream profile_gpt2 host_offload \
+imagenet ops"}
 i=0
 for step in $STEPS; do
   i=$((i + 1))
@@ -96,7 +97,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|telemetry)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|telemetry|downlink)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact.
       # `telemetry` is the telemetry-overhead A/B leg: headline geometry
@@ -152,6 +153,23 @@ for step in $STEPS; do
         mark_done profile_fused
       fi
       log "step $i rc=$rc (docs/measurements/tpu_profile_fused.md on success)"
+      ;;
+    compressed_collectives)
+      # fp32-plan vs full-int8-plan sharded round A/B + per-dtype
+      # quantize round-trip probes + achieved ledger bytes/round
+      # (docs/compressed_collectives.md). Run in the same chip window as
+      # the still-pending stream/fused/telemetry A/Bs so one window's
+      # numbers decide all the gates together.
+      log "step $i: tpu_measure.py compressed_collectives A/B (timeout 30m)"
+      timeout 1800 python scripts/tpu_measure.py compressed_collectives \
+        >"$OUT/tpu_measure_collectives.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_collectives.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "int8-plan round" "$OUT/tpu_measure_collectives.log"
+      then
+        mark_done compressed_collectives
+      fi
       ;;
     stream_sketch)
       # composed-vs-streaming client phase A/B at the headline CIFAR

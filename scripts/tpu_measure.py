@@ -329,6 +329,65 @@ def stream_sketch_leg():
           f"the movement win", flush=True)
 
 
+def compressed_collectives_leg():
+    """Compressed-collectives A/B (docs/compressed_collectives.md): the
+    sharded headline round at the fp32 plan vs the full-int8 plan
+    (--collective_plan int8 — table exchange AND downlink gather
+    quantized, dres/qres EF carries live). Prints each plan's ACHIEVED
+    wire bytes/round straight from telemetry.collective_ledger (the same
+    payload_bytes formula the collectives implement — tests pin they
+    cannot disagree) plus the step-time delta, and one quantize->
+    dequantize micro-probe per wire dtype at the real downlink chunk
+    block so the auto-tuner's probe numbers have an on-chip anchor."""
+    from commefficient_tpu.ops import collectives as C
+    from commefficient_tpu.telemetry import collective_ledger
+
+    steps_f, ps_f, ss_f, cs_f, batch = B.build(tiny=False,
+                                               server_shard=True)
+    steps_q, ps_q, ss_q, cs_q, _ = B.build(tiny=False, server_shard=True,
+                                           collective_plan="int8")
+    geo = sk.make_sketch(6_568_640, c=500_000, r=5, seed=42, num_blocks=20)
+    n_shard = jax.device_count()
+    for tag, plan in (("fp32", C.FP32_PLAN),
+                      ("int8", C.plan_from_reduce_dtype("int8"))):
+        led = collective_ledger("sketch", geo.d, sketch=geo,
+                                n_shard=n_shard, plan=plan)
+        wire = sum(row["bytes_per_round"] for name, row in led.items()
+                   if name != "client_uplink")
+        rows = ", ".join(f"{name}={row['bytes_per_round']:,}B"
+                         for name, row in led.items()
+                         if name != "client_uplink")
+        print(f"plan {tag}: ledger wire bytes/round {wire:,} ({rows})",
+              flush=True)
+    dt_f, rtt, _ = time_rounds(steps_f, (ps_f, ss_f, cs_f, {}), batch)
+    print(f"compressed-collectives A/B fp32-plan round: {dt_f * 1e3:.2f} ms "
+          f"({1 / dt_f:.1f} r/s), rtt {rtt * 1e3:.0f} ms", flush=True)
+    dt_q, _, _ = time_rounds(steps_q, (ps_q, ss_q, cs_q, {}), batch)
+    print(f"compressed-collectives A/B int8-plan round: {dt_q * 1e3:.2f} ms "
+          f"({1 / dt_q:.1f} r/s) | delta {(dt_q - dt_f) * 1e3:+.2f} ms = "
+          f"the quantize/EF-carry cost (ICI-byte win needs a multi-chip "
+          f"mesh)", flush=True)
+    # per-dtype quantize->dequantize micro-probe at the downlink chunk
+    # block (the auto-tune candidate geometry)
+    block = geo.sublanes * 128
+    x = jnp.asarray(np.random.RandomState(0)
+                    .randn(4096, block).astype(np.float32))
+    key = jax.random.key(0)
+    for dt in C.QUANT_DTYPES:
+        f = jax.jit(lambda v, k, dt=dt: C.dequantize_blocks(
+            *C.quantize_blocks(v, k, dt), dt, block))
+        y = f(x, key)
+        drain(y)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            drain(f(x, key))
+            best = min(best, time.perf_counter() - t0)
+        rel = float(jnp.linalg.norm(x - y) / jnp.linalg.norm(x))
+        print(f"quantize-roundtrip {dt}: {best * 1e3:.2f} ms for "
+              f"{x.size:,} elems (rel err {rel:.4f})", flush=True)
+
+
 def gpt2_leg(bf16):
     steps, ps, ss, cs, batch, tokens = B.build_gpt2(bf16=bf16)
     # train_step donates ps/client_states: after this call the local
@@ -420,7 +479,7 @@ def imagenet_leg(bf16, microbatch):
 def main():
     """Leg names via argv select a subset (default: all)."""
     known = {"matmul", "cifar", "ops", "gpt2", "imagenet", "topk_ab",
-             "fused_epilogue", "stream_sketch"}
+             "fused_epilogue", "stream_sketch", "compressed_collectives"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -453,6 +512,8 @@ def main():
         leg("fused_epilogue-124M", fused_epilogue_leg, 124_444_417)
     if sel("stream_sketch"):
         leg("stream_sketch", stream_sketch_leg)
+    if sel("compressed_collectives"):
+        leg("compressed_collectives", compressed_collectives_leg)
 
 
 if __name__ == "__main__":

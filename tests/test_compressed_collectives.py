@@ -1,0 +1,790 @@
+"""Compressed collectives everywhere (--collective_plan,
+docs/compressed_collectives.md).
+
+Contracts pinned on the forced-8-device CPU mesh, mirroring the PR-2 qres
+suite (tests/test_sharded_server.py) leg by leg:
+
+1. the dtype-parameterized quantizer family (int8 / fp8_e4m3 / int4)
+   shares the block-scaled stochastic-rounding contract: bounded
+   round-trip error, exact all-zero blocks, int4 nibble pack-unpack
+   round-trips (incl. odd/non-divisible blocks), and the int8 path is
+   bit-identical to the PR-2 ``quantize_int8_blocks`` spelling;
+2. ``payload_bytes`` is THE one wire-cost formula: the telemetry ledger's
+   rows equal the actual quantized payload + scale bytes, so the
+   accounting and the collectives can never disagree on any dtype — and
+   the full-int8 plan cuts the GPT-2/CIFAR10 sketch configs' total ledger
+   wire bytes ~4x (3.99x; the per-block f32 scales are the documented gap
+   to the ideal 4), with int4 legs pushing well past 4x;
+3. ``quantized_all_gather`` is conservative per chip (gathered tile + new
+   residual ≡ exact tile + old residual — the ``dres`` telescoping
+   contract), identical on every chip, and EF-telescopes across rounds;
+4. the per-leg plan end-to-end: the fp32 plan is BIT-identical to the
+   legacy ``--reduce_dtype float32`` path across replicated/--server_shard
+   x composed/--fused_epilogue; a quantized-downlink round satisfies the
+   EF conservation identity (emitted update + new dres ≡ exact update +
+   old dres) and stays within the documented tolerance of fp32; a
+   quarantined round leaves ``dres`` (like ``qres``) at its pre-round
+   value; fp32-plan checkpoints restore into compressed-plan runs through
+   the existing warn path.
+"""
+
+import warnings
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from commefficient_tpu.compat import shard_map
+from commefficient_tpu.federated.rounds import (
+    RoundConfig,
+    build_round_step,
+    init_client_states,
+)
+from commefficient_tpu.federated.server import (
+    ServerConfig,
+    init_server_state,
+)
+from commefficient_tpu.federated.worker import WorkerConfig
+from commefficient_tpu.ops import collectives as C
+from commefficient_tpu.ops.flat import ravel_pytree
+from commefficient_tpu.ops.sketch import make_sketch
+from tests.test_rounds import _batch, _linear_loss, D
+
+N = 8  # worker-axis shards == forced CPU devices
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("clients",))
+
+
+# --------------------------------------------------------------------------
+# 1. the dtype-parameterized quantizer family
+# --------------------------------------------------------------------------
+
+# documented worst-case relative L2 round-trip errors on standard-normal
+# blocks (docs/compressed_collectives.md): SR is unbiased, so these are
+# noise floors, not drifts
+REL_ERR_CEILING = {"int8": 0.02, "fp8_e4m3": 0.06, "int4": 0.25}
+
+
+def _local_gap(x, scale, dtype):
+    """The distance between the two representable values SR rounds |x|
+    between, dequantized: one scale step on the integer grids; for fp8 the
+    e4m3 ULP at |x| — mantissa 3 bits → at most |x|/8 (plus the subnormal
+    grid near zero)."""
+    if dtype == "fp8_e4m3":
+        return np.maximum(np.abs(x) * 0.125, scale * 2.0 ** -9)
+    return np.broadcast_to(scale, x.shape)
+
+
+class TestQuantizeBlocks:
+    @pytest.mark.parametrize("dtype", C.QUANT_DTYPES)
+    def test_roundtrip_error_bounded(self, dtype):
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(8, 512).astype(np.float32))
+        q, s = C.quantize_blocks(x, jax.random.key(1), dtype)
+        y = C.dequantize_blocks(q, s, dtype, 512)
+        rel = float(jnp.linalg.norm(x - y) / jnp.linalg.norm(x))
+        assert rel < REL_ERR_CEILING[dtype], (dtype, rel)
+        # SR picks between the two NEIGHBORING representable values, so
+        # every element's error is at most one local gap: one scale step
+        # for the integer grids, the (relative-precision) e4m3 ULP for fp8
+        err = np.abs(np.asarray(x - y))
+        gap = _local_gap(np.asarray(x), np.asarray(s)[..., None], dtype)
+        assert np.all(err <= gap + 1e-12), dtype
+
+    @pytest.mark.parametrize("dtype", C.QUANT_DTYPES)
+    def test_all_zero_block_exact(self, dtype):
+        x = jnp.zeros((3, 256), jnp.float32)
+        q, s = C.quantize_blocks(x, jax.random.key(0), dtype)
+        np.testing.assert_array_equal(np.asarray(s), 0.0)
+        y = C.dequantize_blocks(q, s, dtype, 256)
+        np.testing.assert_array_equal(np.asarray(y), 0.0)
+
+    def test_int8_matches_pr2_spelling(self):
+        """quantize_int8_blocks is the documented PR-2 entry point; the
+        dtype-parameterized family must reproduce it bit for bit (same SR
+        draws, same clip) so --reduce_dtype int8 trajectories survive the
+        refactor unchanged."""
+        x = jnp.asarray(
+            np.random.RandomState(3).randn(4, 384).astype(np.float32))
+        key = jax.random.key(7)
+        q1, s1 = C.quantize_int8_blocks(x, key)
+        q2, s2 = C.quantize_blocks(x, key, "int8")
+        np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+        assert q2.dtype == jnp.int8
+
+    def test_int4_pack_unpack_roundtrip(self):
+        """Nibble packing is lossless over the int4 value range, including
+        an ODD block (one zero-nibble of padding) — the non-divisible edge
+        case of the wire layout."""
+        for block in (6, 7, 128, 129):
+            vals = np.random.RandomState(block).randint(
+                -7, 8, size=(3, block)).astype(np.float32)
+            packed = C._pack_int4(jnp.asarray(vals))
+            assert packed.shape == (3, -(-block // 2))
+            assert packed.dtype == jnp.uint8
+            out = C._unpack_int4(packed, block)
+            np.testing.assert_array_equal(np.asarray(out), vals)
+
+    def test_int4_payload_is_nibble_packed(self):
+        x = jnp.asarray(
+            np.random.RandomState(1).randn(5, 256).astype(np.float32))
+        q, s = C.quantize_blocks(x, jax.random.key(0), "int4")
+        assert q.shape == (5, 128) and q.dtype == jnp.uint8
+
+    def test_fp8_rounds_to_neighbors(self):
+        """fp8 SR must land on one of the two e4m3 values bracketing x/s
+        (unbiasedness needs exactly-neighbor rounding, like integer SR)."""
+        x = jnp.asarray(
+            np.random.RandomState(2).randn(4, 256).astype(np.float32))
+        q, s = C.quantize_blocks(x, jax.random.key(5), "fp8_e4m3")
+        assert q.dtype == jnp.float8_e4m3fn
+        y = np.asarray(q.astype(jnp.float32)) * np.asarray(s)[..., None]
+        xn = np.asarray(x)
+        # each |error| is at most the local e4m3 ULP: the next
+        # representable above |v| is < |v| * (1 + 2^-3) * 2 in e4m3
+        scale = np.asarray(s)[..., None]
+        ulp = np.maximum(np.abs(xn) * 0.125, scale * 2.0 ** -9)
+        assert np.all(np.abs(y - xn) <= ulp + 1e-12)
+
+    @pytest.mark.parametrize("dtype", C.QUANT_DTYPES)
+    def test_stochastic_rounding_unbiased(self, dtype):
+        """E[dequantize(quantize(x))] = x: the mean over independent SR
+        draws converges on the exact values (the property that lets the
+        EF carry telescope instead of drift)."""
+        x = jnp.asarray(
+            np.random.RandomState(4).randn(1, 128).astype(np.float32))
+        keys = jax.random.split(jax.random.key(0), 300)
+
+        def rt(k):
+            q, s = C.quantize_blocks(x, k, dtype)
+            return C.dequantize_blocks(q, s, dtype, 128)
+
+        mean = np.asarray(jnp.mean(jax.vmap(rt)(keys), axis=0))
+        _, s = C.quantize_blocks(x, keys[0], dtype)
+        # per-element: the SR mean converges within a fraction of that
+        # element's OWN neighbor gap (one draw's deviation is < gap; 300
+        # draws put the mean's ~3-sigma envelope well under 0.2 gap)
+        gap = _local_gap(np.asarray(x), np.asarray(s)[..., None], dtype)
+        assert np.all(np.abs(mean - np.asarray(x)) < 0.2 * gap + 1e-6), \
+            dtype
+
+
+# --------------------------------------------------------------------------
+# 2. payload_bytes as THE wire-cost formula + the compression acceptance
+# --------------------------------------------------------------------------
+
+
+def _ledger_geom(d, c=500_000, r=5):
+    """The collective_ledger only reads (r, c_pad, T, sublanes) — a
+    namespace with the real make_sketch arithmetic prices GPT-2-sized
+    geometries without allocating anything."""
+    c_pad = -(-c // 128) * 128
+    return SimpleNamespace(r=r, c_pad=c_pad, T=max(1, -(-d // c_pad)),
+                           sublanes=c_pad // 128, d=d)
+
+
+def _wire_total(ledger):
+    """Mesh wire bytes/round: every collective leg except the per-client
+    logical uplink (not a mesh collective; identical in every plan)."""
+    return sum(row["bytes_per_round"] for name, row in ledger.items()
+               if name != "client_uplink")
+
+
+class TestPayloadBytes:
+    def test_formula_per_dtype(self):
+        blk = C.DEFAULT_QUANT_BLOCK
+        assert C.payload_bytes(1000, "float32") == 4000
+        # int8: 1 B/elem + one f32 scale per (started) block
+        assert C.payload_bytes(2 * blk, "int8") == 2 * blk + 8
+        assert C.payload_bytes(2 * blk + 1, "int8") == 2 * blk + 1 + 12
+        # fp8: same layout as int8 (1 B/elem + scales)
+        assert C.payload_bytes(blk, "fp8_e4m3") == blk + 4
+        # int4: half a byte per element (rounded up) + scales
+        assert C.payload_bytes(blk, "int4") == blk // 2 + 4
+        assert C.payload_bytes(blk + 3, "int4") == (blk + 3 + 1) // 2 + 8
+        # int4 packs PER BLOCK (an odd block pads one nibble per block,
+        # matching _pack_int4's actual payload): 3 full 5-elem blocks of
+        # ceil(5/2)=3 B each + 3 scales — NOT ceil(15/2)=8 element bytes
+        assert C.payload_bytes(15, "int4", block=5) == 3 * 3 + 4 * 3
+        x = jnp.asarray(np.random.RandomState(3).randn(3, 5)
+                        .astype(np.float32))
+        q, s = C.quantize_blocks(x, jax.random.key(3), "int4")
+        assert C.payload_bytes(15, "int4", block=5) \
+            == q.nbytes + s.astype(jnp.float32).nbytes
+        # legacy alias
+        assert C.int8_payload_bytes(12345) == C.payload_bytes(12345, "int8")
+
+    def test_ledger_equals_actual_quantized_payload(self):
+        """The ledger row and the array the collective actually moves must
+        agree byte for byte (block-divisible geometry, as the sketch legs
+        are by construction): payload nbytes + scale nbytes == the
+        payload_bytes the ledger charges."""
+        from commefficient_tpu.telemetry import collective_ledger
+
+        geo = make_sketch(5000, 512, 3, seed=7, num_blocks=2)
+        for dtype in C.QUANT_DTYPES:
+            plan = C.CollectivePlan(table=dtype, downlink=dtype)
+            led = collective_ledger("sketch", geo.d, sketch=geo, n_shard=N,
+                                    plan=plan)
+            # table leg: block = one (c_pad,) row
+            telems = geo.r * geo.c_pad
+            x = jnp.asarray(np.random.RandomState(0).randn(
+                telems // geo.c_pad, geo.c_pad).astype(np.float32))
+            q, s = C.quantize_blocks(x, jax.random.key(0), dtype)
+            assert led["transmit_reduce"]["bytes_per_round"] \
+                == q.nbytes + s.astype(jnp.float32).nbytes, dtype
+            # downlink leg: block = one (S, 128) chunk
+            delems = led["update_all_gather"]["elements"]
+            blk = geo.sublanes * 128
+            x2 = jnp.asarray(np.random.RandomState(1).randn(
+                delems // blk, blk).astype(np.float32))
+            q2, s2 = C.quantize_blocks(x2, jax.random.key(1), dtype)
+            assert led["update_all_gather"]["bytes_per_round"] \
+                == q2.nbytes + s2.astype(jnp.float32).nbytes, dtype
+
+    @pytest.mark.parametrize("d,label", [(6_568_640, "cifar10-resnet9"),
+                                         (124_444_417, "gpt2-124M")])
+    def test_full_int8_plan_compression_ratio(self, d, label):
+        """THE acceptance ratio (ISSUE 8): the full-compressed plan
+        (uplink=int8,downlink=int8,table=int8) vs fp32 on the GPT-2 and
+        CIFAR10 sketch configs. The ideal is exactly 4x; the per-block
+        f32 scales and the (identical, 512 B) threshold exchange leave it
+        at 3.999x on these geometries — pinned >= 3.99 here, with the
+        int4 downlink showing the past-4x headroom
+        (docs/compressed_collectives.md has the arithmetic)."""
+        from commefficient_tpu.telemetry import collective_ledger
+
+        geo = _ledger_geom(d)
+        fp32 = _wire_total(collective_ledger(
+            "sketch", d, sketch=geo, n_shard=N, plan=C.FP32_PLAN))
+        int8 = _wire_total(collective_ledger(
+            "sketch", d, sketch=geo, n_shard=N,
+            plan=C.plan_from_reduce_dtype("int8")))
+        ratio = fp32 / int8
+        assert ratio >= 3.99, (label, ratio)
+        mixed = _wire_total(collective_ledger(
+            "sketch", d, sketch=geo, n_shard=N,
+            plan=C.CollectivePlan(uplink="int8", table="int8",
+                                  downlink="int4")))
+        assert fp32 / mixed >= 4.0, (label, fp32 / mixed)
+
+    def test_dense_plan_ledger(self):
+        """Dense (true_topk) geometry: uplink reduce-scatter and downlink
+        gather priced at their plan dtypes, DEFAULT_QUANT_BLOCK scales."""
+        from commefficient_tpu.telemetry import collective_ledger
+
+        d = 1_000_000
+        plan = C.CollectivePlan(uplink="int8", downlink="fp8_e4m3")
+        led = collective_ledger("true_topk", d, n_shard=N, plan=plan, k=10)
+        d_pad = -(-d // N) * N
+        assert led["transmit_reduce"]["bytes_per_round"] \
+            == C.payload_bytes(d_pad, "int8")
+        assert led["transmit_reduce"]["dtype"] == "int8"
+        assert led["update_all_gather"]["bytes_per_round"] \
+            == C.payload_bytes(d_pad, "fp8_e4m3")
+        assert led["update_all_gather"]["collective"] \
+            == "quantized_all_gather (fp8_e4m3+scales)"
+
+
+# --------------------------------------------------------------------------
+# 3. quantized_all_gather: conservation + telescoping on the mesh
+# --------------------------------------------------------------------------
+
+
+class TestQuantizedAllGather:
+    @pytest.mark.parametrize("dtype", C.QUANT_DTYPES)
+    def test_conservation_per_chip(self, dtype):
+        """Gathered tile_i + new residual_i ≡ exact tile_i (+ old
+        residual_i = 0): the downlink quantizer's loss is exactly what the
+        dres carry holds — nothing silently lost, per chip."""
+        mesh = _mesh()
+        x = np.random.RandomState(0).randn(N, 4, 128).astype(np.float32)
+
+        def f(xl, key):
+            full, res = C.quantized_all_gather(xl[0], "clients", key,
+                                               block=128, dtype=dtype)
+            return full[None], res[None]
+
+        full, res = shard_map(
+            f, mesh=mesh, in_specs=(P("clients"), P()),
+            out_specs=(P("clients"), P("clients")), check_vma=False,
+        )(jnp.asarray(x), jax.random.key(3))
+        full, res = np.asarray(full), np.asarray(res)
+        # every chip gathered the same full array
+        for i in range(1, N):
+            np.testing.assert_array_equal(full[i], full[0],
+                                          err_msg=f"chip {i} diverged")
+        # conservation: chip i's gathered tile + its residual == exact
+        gathered = full[0].reshape(N, 4, 128)
+        np.testing.assert_allclose(gathered + res, x, atol=5e-5)
+        assert np.abs(res).max() > 0  # actually lossy
+
+    def test_ef_carry_telescopes(self):
+        """Round 2 folds round 1's residual into the tile before
+        quantizing: the two rounds' gathered tiles sum to 2x exact minus
+        ONE round's residual, not two (the qres telescoping contract,
+        downlink leg)."""
+        mesh = _mesh()
+        x = np.random.RandomState(1).randn(N, 4, 128).astype(np.float32)
+
+        def f(xl, key):
+            k1, k2 = jax.random.split(key)
+            t1, r1 = C.quantized_all_gather(xl[0], "clients", k1, block=128)
+            t2, r2 = C.quantized_all_gather(xl[0], "clients", k2,
+                                            residual=r1, block=128)
+            return t1[None], t2[None], r2[None]
+
+        t1, t2, r2 = shard_map(
+            f, mesh=mesh, in_specs=(P("clients"), P()),
+            out_specs=(P("clients"),) * 3, check_vma=False,
+        )(jnp.asarray(x), jax.random.key(11))
+        got = np.asarray(t1)[0].reshape(N, 4, 128) \
+            + np.asarray(t2)[0].reshape(N, 4, 128)
+        np.testing.assert_allclose(got + np.asarray(r2),
+                                   2 * x, atol=5e-5)
+
+    def test_non_divisible_block(self):
+        """Tile size not a multiple of the quant block: the pad must be
+        carved back off both the gathered result and the residual."""
+        mesh = _mesh()
+        x = np.random.RandomState(2).randn(N, 5, 100).astype(np.float32)
+
+        def f(xl, key):
+            full, res = C.quantized_all_gather(xl[0], "clients", key,
+                                               block=128, dtype="int4")
+            return full[None], res[None]
+
+        full, res = shard_map(
+            f, mesh=mesh, in_specs=(P("clients"), P()),
+            out_specs=(P("clients"), P("clients")), check_vma=False,
+        )(jnp.asarray(x), jax.random.key(5))
+        assert np.asarray(full)[0].shape == (N * 5, 100)
+        np.testing.assert_allclose(
+            np.asarray(full)[0].reshape(N, 5, 100) + np.asarray(res),
+            x, atol=5e-4)
+
+
+# --------------------------------------------------------------------------
+# 4. plan grammar + auto-tune probe
+# --------------------------------------------------------------------------
+
+
+class TestPlanGrammar:
+    def test_parse_spellings(self):
+        assert C.parse_collective_plan("") == C.FP32_PLAN
+        assert C.parse_collective_plan("int8") == C.CollectivePlan(
+            uplink="int8", table="int8", downlink="int8")
+        p = C.parse_collective_plan("uplink=int8,downlink=fp8,table=fp32")
+        assert (p.uplink, p.table, p.downlink) \
+            == ("int8", "float32", "fp8_e4m3")
+        # unnamed legs stay float32
+        assert C.parse_collective_plan("downlink=int4") \
+            == C.CollectivePlan(downlink="int4")
+
+    def test_parse_rejects(self):
+        for bad in ("uplink=int7", "bogus=int8", "uplink=int8,uplink=int4",
+                    "auto"):
+            with pytest.raises(AssertionError):
+                C.parse_collective_plan(bad)
+
+    def test_legacy_alias(self):
+        assert C.plan_from_reduce_dtype("float32") == C.FP32_PLAN
+        assert not C.plan_from_reduce_dtype("float32").quantized
+        full = C.plan_from_reduce_dtype("int8")
+        assert full.quantized
+        assert full.spec() == "uplink=int8,table=int8,downlink=int8"
+
+    def test_autotune_picks_cheapest_within_budget(self):
+        geoms = {"downlink": (64 * 1024, 1024)}
+        # tight budget: int4's ~17% error is out, int8's ~1% is in —
+        # int8 wins over fp8 at equal bytes by lower error
+        plan, report = C.autotune_collective_plan(geoms, error_budget=0.05,
+                                                  seed=0)
+        assert plan.downlink == "int8"
+        assert plan.uplink == "float32" and plan.table == "float32"
+        # loose budget: int4 is admissible and half the bytes
+        plan2, _ = C.autotune_collective_plan(geoms, error_budget=0.5,
+                                              seed=0)
+        assert plan2.downlink == "int4"
+        # impossible budget: every quantizer is out, fp32 stays
+        plan3, _ = C.autotune_collective_plan(geoms, error_budget=1e-9,
+                                              seed=0)
+        assert plan3.downlink == "float32"
+        # the probe report is the auditable artifact (telemetry run_start)
+        rows = report["downlink"]
+        for dt in ("float32",) + tuple(C.QUANT_DTYPES):
+            assert "bytes_per_round" in rows[dt], dt
+        assert rows["int8"]["rel_err"] < 0.05 < rows["int4"]["rel_err"]
+
+
+# --------------------------------------------------------------------------
+# 5. the plan end-to-end through the round step
+# --------------------------------------------------------------------------
+
+
+def _build(mode, error_type, server_shard, plan=None, reduce_dtype="float32",
+           virtual_momentum=0.0, k=2, fused_epilogue=False, guards=False,
+           **kw):
+    """test_sharded_server's placed-round builder, with the per-leg plan
+    (and its dres carry) threaded through exactly as FedModel does."""
+    mesh = _mesh()
+    rep = NamedSharding(mesh, P())
+    sh0 = NamedSharding(mesh, P("clients"))
+    params = {"w": jnp.zeros(D)}
+    flat, unravel = ravel_pytree(params)
+
+    def ravel(tree):
+        return ravel_pytree(tree)[0]
+
+    wcfg = WorkerConfig(mode=mode, error_type=error_type, k=k,
+                        num_workers=N, **kw)
+    scfg = ServerConfig(mode=mode, error_type=error_type, k=k, grad_size=D,
+                        virtual_momentum=virtual_momentum,
+                        local_momentum=kw.get("local_momentum", 0.0),
+                        fused_epilogue=fused_epilogue)
+    sketch = make_sketch(D, 16, 3, seed=0, num_blocks=1) \
+        if mode == "sketch" else None
+    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=D,
+                      server_shard=server_shard, reduce_dtype=reduce_dtype,
+                      collective_plan=plan, guards=guards)
+    steps = build_round_step(_linear_loss, _linear_loss, unravel, ravel,
+                             cfg, sketch=sketch, mesh=mesh)
+    ss = init_server_state(scfg, sketch,
+                           shard_n=N if server_shard else 0,
+                           quantized=reduce_dtype == "int8", plan=plan)
+    dense_sharded = server_shard and mode != "sketch"
+    ss = ss._replace(
+        velocity=jax.device_put(ss.velocity, sh0 if dense_sharded else rep),
+        error=jax.device_put(ss.error, sh0 if dense_sharded else rep),
+        qres=None if ss.qres is None else jax.device_put(ss.qres, sh0),
+        dres=None if ss.dres is None else jax.device_put(ss.dres, sh0))
+    ps = jax.device_put(
+        steps.layout.chunk(flat) if steps.layout is not None else flat, rep)
+    cs = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, rep),
+        init_client_states(16, D, wcfg, init_weights=flat, sketch=sketch))
+    return steps, ps, ss, cs
+
+
+def _run_rounds(steps, ps, ss, cs, rounds, lr=0.1):
+    traj = []
+    for rnd in range(rounds):
+        ps, ss, cs, *_ = steps.train_step(ps, ss, cs, {}, _batch(seed=rnd),
+                                          lr, jax.random.key(rnd))
+        flat = steps.layout.unchunk(ps) if steps.layout is not None else ps
+        traj.append(np.asarray(flat))
+    return traj, ss, cs
+
+
+PLAN_MODES = [
+    ("sketch", "virtual", dict(virtual_momentum=0.9)),
+    ("true_topk", "virtual", dict(virtual_momentum=0.9,
+                                  local_momentum=0.9)),
+    ("uncompressed", "none", dict(virtual_momentum=0.5)),
+]
+
+
+class TestPlanRound:
+    @pytest.mark.parametrize("server_shard", [False, True],
+                             ids=["replicated", "server_shard"])
+    @pytest.mark.parametrize("fused", [False, True],
+                             ids=["composed", "fused_epilogue"])
+    def test_fp32_plan_bit_identical_to_legacy(self, server_shard, fused):
+        """The explicit fp32 plan must run the EXACT pre-plan code paths:
+        trajectories bit-identical to --reduce_dtype float32 across
+        replicated/--server_shard x composed/--fused_epilogue (the
+        acceptance pin; a float32 'leg' is not a quantizer with scale 1,
+        it is the original collective)."""
+        import os
+
+        env = os.environ.get("COMMEFFICIENT_FUSED_EPILOGUE")
+        if fused:
+            os.environ["COMMEFFICIENT_FUSED_EPILOGUE"] = "interpret"
+        try:
+            a, ssa, _ = _run_rounds(
+                *_build("sketch", "virtual", server_shard,
+                        virtual_momentum=0.9, fused_epilogue=fused),
+                rounds=3)
+            b, ssb, _ = _run_rounds(
+                *_build("sketch", "virtual", server_shard,
+                        plan=C.FP32_PLAN, virtual_momentum=0.9,
+                        fused_epilogue=fused),
+                rounds=3)
+        finally:
+            if env is None:
+                os.environ.pop("COMMEFFICIENT_FUSED_EPILOGUE", None)
+            else:
+                os.environ["COMMEFFICIENT_FUSED_EPILOGUE"] = env
+        for rnd, (x, y) in enumerate(zip(a, b)):
+            np.testing.assert_array_equal(
+                x, y, err_msg=f"round {rnd} diverged under the fp32 plan")
+        assert ssb.qres is None and ssb.dres is None
+
+    @pytest.mark.parametrize("mode,et,kw", PLAN_MODES,
+                             ids=[m for m, _, _ in PLAN_MODES])
+    def test_downlink_ef_conservation_identity(self, mode, et, kw):
+        """THE downlink acceptance identity, at round granularity: with
+        only the downlink quantized (uplink/table fp32 → the exact update
+        is the fp32 run's), emitted update + new dres ≡ exact update +
+        old dres (= 0 at round 1). Measured straight off the two runs'
+        weight deltas: (ps_quantized − ps_fp32) / lr == dres."""
+        lr = 0.1
+        plan = C.CollectivePlan(downlink="int8")
+        steps_f, ps_f, ss_f, cs_f = _build(mode, et, True, **kw)
+        steps_q, ps_q, ss_q, cs_q = _build(mode, et, True, plan=plan, **kw)
+        assert ss_q.qres is None and ss_q.dres is not None
+        batch, key = _batch(seed=0), jax.random.key(0)
+        ps_f1, *_ = steps_f.train_step(ps_f, ss_f, cs_f, {}, batch, lr, key)
+        ps_q1, ss_q1, *_ = steps_q.train_step(ps_q, ss_q, cs_q, {}, batch,
+                                              lr, key)
+        if steps_f.layout is not None:
+            ps_f1 = steps_f.layout.unchunk(ps_f1)
+            ps_q1 = steps_q.layout.unchunk(ps_q1)
+        dres = np.asarray(ss_q1.dres)
+        # the gathered-layout residual, flattened back to the update's
+        # coordinates (chunk rows for sketch, (d_pad,) slices for dense)
+        dres_flat = dres.reshape(-1)[: ps_f1.size]
+        got = (np.asarray(ps_q1) - np.asarray(ps_f1)).reshape(-1) / lr
+        np.testing.assert_allclose(got, dres_flat, atol=5e-6,
+                                   err_msg=f"{mode}: emitted + dres != "
+                                           "exact update")
+        assert np.abs(dres).max() > 0
+
+    def test_downlink_trajectory_within_tolerance(self):
+        """Short sketched trajectories with the quantized downlink stay
+        within the documented 2% of fp32 (the qres tolerance contract,
+        downlink leg), and the carry feeds forward."""
+        f32, _, _ = _run_rounds(
+            *_build("sketch", "virtual", True, virtual_momentum=0.9),
+            rounds=4)
+        dn, ss_dn, _ = _run_rounds(
+            *_build("sketch", "virtual", True,
+                    plan=C.CollectivePlan(downlink="int8"),
+                    virtual_momentum=0.9), rounds=4)
+        for rnd, (a, b) in enumerate(zip(f32, dn)):
+            denom = max(np.abs(a).max(), 1e-12)
+            assert np.abs(b - a).max() / denom < 0.02, \
+                f"round {rnd}: downlink-int8 drifted past 2%"
+        assert float(np.abs(np.asarray(ss_dn.dres)).max()) > 0
+
+    def test_full_plan_trajectory_within_tolerance(self):
+        """Every leg quantized (--collective_plan int8 == the new
+        --reduce_dtype int8 alias): both carries live, tolerance holds."""
+        f32, _, _ = _run_rounds(
+            *_build("sketch", "virtual", True, virtual_momentum=0.9),
+            rounds=4)
+        q, ssq, _ = _run_rounds(
+            *_build("sketch", "virtual", True,
+                    plan=C.plan_from_reduce_dtype("int8"),
+                    virtual_momentum=0.9), rounds=4)
+        for rnd, (a, b) in enumerate(zip(f32, q)):
+            denom = max(np.abs(a).max(), 1e-12)
+            assert np.abs(b - a).max() / denom < 0.03, \
+                f"round {rnd}: full-int8 plan drifted past 3%"
+        assert ssq.qres is not None and ssq.dres is not None
+        assert float(np.abs(np.asarray(ssq.qres)).max()) > 0
+        assert float(np.abs(np.asarray(ssq.dres)).max()) > 0
+
+    def test_quantized_legs_require_server_shard(self):
+        with pytest.raises(AssertionError):
+            _build("sketch", "virtual", False,
+                   plan=C.CollectivePlan(downlink="int8"),
+                   virtual_momentum=0.9)
+
+    def test_quarantine_leaves_dres_untouched(self):
+        """A guard-tripped round is a state no-op for the downlink carry
+        exactly as for qres: dres keeps its pre-round value bit for bit
+        (the poisoned round's quantization error must NOT telescope)."""
+        steps, ps, ss, cs = _build(
+            "sketch", "virtual", True,
+            plan=C.plan_from_reduce_dtype("int8"),
+            virtual_momentum=0.9, guards=True)
+        # round 1 (clean): populates nonzero qres/dres
+        out = steps.train_step(ps, ss, cs, {}, _batch(seed=0), 0.1,
+                               jax.random.key(0))
+        ps1, ss1, cs1, guard_ok = out[0], out[1], out[2], out[5]
+        assert bool(guard_ok)
+        # host snapshots BEFORE round 2 — train_step donates ps/server/
+        # client state, so the round-1 buffers die at the next call
+        ps1_np = np.asarray(
+            steps.layout.unchunk(ps1) if steps.layout is not None else ps1
+        ).copy()
+        qres1 = np.asarray(ss1.qres).copy()
+        dres1 = np.asarray(ss1.dres).copy()
+        assert np.abs(dres1).max() > 0
+        # round 2: poisoned transmit via a NaN batch input
+        bad = dict(_batch(seed=1))
+        bad["inputs"] = bad["inputs"].at[0, 0, 0].set(jnp.nan)
+        out2 = steps.train_step(ps1, ss1, cs1, {}, bad, 0.1,
+                                jax.random.key(1))
+        ps2, ss2, guard2 = out2[0], out2[1], out2[5]
+        assert not bool(guard2), "the NaN round must trip the guard"
+        np.testing.assert_array_equal(np.asarray(ss2.qres), qres1,
+                                      err_msg="quarantine must not touch "
+                                              "qres")
+        np.testing.assert_array_equal(np.asarray(ss2.dres), dres1,
+                                      err_msg="quarantine must not touch "
+                                              "dres")
+        ps2_np = np.asarray(
+            steps.layout.unchunk(ps2) if steps.layout is not None else ps2)
+        np.testing.assert_array_equal(ps2_np, ps1_np)
+
+
+# --------------------------------------------------------------------------
+# 6. FedModel surface: plan resolution + checkpoint warn path
+# --------------------------------------------------------------------------
+
+
+class TestPlanFedModel:
+    def _fed_model(self, **over):
+        import flax.linen as nn
+
+        from commefficient_tpu.federated.aggregator import (
+            FedModel,
+            FedOptimizer,
+            LambdaLR,
+        )
+        from tests.test_sharded_server import _fed_args
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                return nn.Dense(4, use_bias=False)(x)
+
+        def loss(params, model_state, batch, rng, train):
+            pred = Tiny().apply({"params": params}, batch["inputs"])
+            err = pred - batch["targets"]
+            mask = batch["mask"]
+            return jnp.sum(jnp.square(err).mean(-1) * mask), (), \
+                jnp.sum(mask), model_state
+
+        args = _fed_args(**over)
+        fm = FedModel(Tiny(), loss, args, input_shape=(3,))
+        opt = FedOptimizer(fm, args)
+        sched = LambdaLR(opt, lambda step: 0.5)
+        return fm, opt, sched
+
+    def _fed_batch(self):
+        rng = np.random.RandomState(1)
+        return {
+            "inputs": jnp.asarray(rng.randn(N, 2, 3), jnp.float32),
+            "targets": jnp.asarray(rng.randn(N, 2, 4), jnp.float32),
+            "mask": jnp.ones((N, 2), jnp.float32),
+            "client_ids": jnp.arange(N, dtype=jnp.int32),
+            "worker_mask": jnp.ones(N, jnp.float32),
+        }
+
+    def test_plan_resolution_and_carries(self):
+        """--collective_plan resolves in FedModel before the step builds;
+        the optimizer's fresh state carries exactly the residuals the
+        plan needs (dres only, for a downlink-only plan)."""
+        fm, opt, _ = self._fed_model(
+            collective_plan="downlink=int8,table=fp32")
+        assert fm.collective_plan.downlink == "int8"
+        assert fm.collective_plan.table == "float32"
+        assert opt.server_state.qres is None
+        assert opt.server_state.dres is not None
+        fm(self._fed_batch())
+        opt.step()
+        assert float(np.abs(np.asarray(opt.server_state.dres)).max()) > 0
+
+    def test_legacy_alias_sets_every_leg(self):
+        fm, opt, _ = self._fed_model(reduce_dtype="int8")
+        assert fm.collective_plan.spec() \
+            == "uplink=int8,table=int8,downlink=int8"
+        assert opt.server_state.qres is not None
+        assert opt.server_state.dres is not None
+
+    def test_fp32_checkpoint_restores_into_compressed_plan(self, tmp_path):
+        """An fp32-plan checkpoint restores into a compressed-plan run
+        through the existing warn path: both carries zero-restart with a
+        warning, everything else restores exactly (the qres contract,
+        extended to dres)."""
+        from commefficient_tpu.federated.checkpoint import (
+            load_run_state,
+            save_run_state,
+        )
+
+        fm, opt, sched = self._fed_model()
+        for _ in range(2):
+            fm(self._fed_batch())
+            opt.step()
+        path = save_run_state(str(tmp_path / "rs"), fm, opt, sched,
+                              next_epoch=1)
+        fm2, opt2, sched2 = self._fed_model(collective_plan="int8")
+        assert opt2.server_state.dres is not None
+        with pytest.warns(UserWarning,
+                          match="re-initializing the quantized-downlink "
+                                "residual to zero"):
+            load_run_state(path, fm2, opt2, sched2)
+        np.testing.assert_array_equal(
+            np.asarray(opt2.server_state.dres),
+            np.zeros_like(np.asarray(opt2.server_state.dres)))
+        np.testing.assert_array_equal(
+            np.asarray(opt2.server_state.velocity),
+            np.asarray(opt.server_state.velocity))
+        # and the restored run trains on
+        fm2(self._fed_batch())
+        opt2.step()
+        assert np.all(np.isfinite(np.asarray(
+            fm2.layout.unchunk(fm2.ps_weights) if fm2.layout is not None
+            else fm2.ps_weights)))
+
+    def test_compressed_checkpoint_roundtrip(self, tmp_path):
+        """A compressed-plan run's own checkpoint restores BOTH carries
+        exactly and the next round reproduces bit for bit."""
+        from commefficient_tpu.federated.checkpoint import (
+            load_run_state,
+            save_run_state,
+        )
+
+        fm, opt, sched = self._fed_model(collective_plan="int8")
+        for _ in range(2):
+            fm(self._fed_batch())
+            opt.step()
+        path = save_run_state(str(tmp_path / "rs"), fm, opt, sched,
+                              next_epoch=1)
+        fm2, opt2, sched2 = self._fed_model(collective_plan="int8")
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # an exact restore must not warn
+            load_run_state(path, fm2, opt2, sched2)
+        for name in ("velocity", "error", "qres", "dres"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(opt.server_state, name)),
+                np.asarray(getattr(opt2.server_state, name)), err_msg=name)
+        fm(self._fed_batch())
+        opt.step()
+        fm2(self._fed_batch())
+        opt2.step()
+        np.testing.assert_array_equal(np.asarray(fm.ps_weights),
+                                      np.asarray(fm2.ps_weights))
+
+    def test_dres_norm_rides_telemetry(self):
+        """The new dres_norm slot (schema v2) lands nonzero for a
+        compressed-downlink run and 0.0 for fp32 — per-round downlink
+        drift visibility with zero new host syncs."""
+        from commefficient_tpu.telemetry import METRIC_FIELDS
+
+        assert METRIC_FIELDS[-1] == "dres_norm"  # v2: appended LAST
+        fm, opt, _ = self._fed_model(collective_plan="int8",
+                                     telemetry=True)
+        fm(self._fed_batch())
+        opt.step()
+        vec = np.asarray(fm._pending_telemetry)
+        assert vec.shape == (len(METRIC_FIELDS),)
+        fields = dict(zip(METRIC_FIELDS, vec))
+        assert fields["dres_norm"] > 0 and fields["qres_norm"] > 0
+
+        fm2, opt2, _ = self._fed_model(telemetry=True)
+        fm2(self._fed_batch())
+        opt2.step()
+        fields2 = dict(zip(METRIC_FIELDS,
+                           np.asarray(fm2._pending_telemetry)))
+        assert fields2["dres_norm"] == 0.0 and fields2["qres_norm"] == 0.0
