@@ -150,6 +150,7 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
           mode: str = "sketch", num_workers: int = NUM_WORKERS,
           server_shard: bool = False, fused_epilogue: bool = False,
           guards: bool = False, stream_sketch: bool = False,
+          sketch_coalesce: bool = False,
           telemetry: bool = False, collective_plan: str = ""):
     import jax
     import jax.numpy as jnp
@@ -209,7 +210,8 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
         plan = parse_collective_plan(collective_plan)
     cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
                       server_shard=server_shard, guards=guards,
-                      stream_sketch=stream_sketch, telemetry=telemetry,
+                      stream_sketch=stream_sketch,
+                      sketch_coalesce=sketch_coalesce, telemetry=telemetry,
                       collective_plan=plan)
     loss_train, loss_val = make_cv_losses(model)
     # the entrypoints' real execution path: shard_map+psum over a clients
@@ -260,14 +262,15 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
 
 
 def build_gpt2(bf16: bool = False, fused_epilogue: bool = False,
-               stream_sketch: bool = False):
+               stream_sketch: bool = False, sketch_coalesce: bool = False):
     """GPT-2 PersonaChat sketched federated round (BASELINE.md config 5):
     full 124M double-heads geometry, 4 clients/round, 2 candidates x 256
     tokens per example, sketch 5x500k/k=50k (reference gpt2_train.py:255-313
     run shape). ``bf16`` switches the fwd/bwd compute to bf16 (--bf16);
     ``fused_epilogue`` turns on the one-sweep server epilogue
-    (docs/fused_epilogue.md) and ``stream_sketch`` the streaming client
-    phase (docs/stream_sketch.md) for their profiling A/Bs."""
+    (docs/fused_epilogue.md), ``stream_sketch`` the streaming client
+    phase (docs/stream_sketch.md), and ``sketch_coalesce`` the coalesced
+    multi-leaf accumulate on top of it, for their profiling A/Bs."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -310,7 +313,8 @@ def build_gpt2(bf16: bool = False, fused_epilogue: bool = False,
                         fused_epilogue=fused_epilogue)
     sketch = make_sketch(d, c=c, r=r, seed=42, num_blocks=blocks)
     cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
-                      stream_sketch=stream_sketch)
+                      stream_sketch=stream_sketch,
+                      sketch_coalesce=sketch_coalesce)
     loss_train, loss_val = make_gpt2_losses(
         model, compute_dtype=jnp.bfloat16 if bf16 else None)
     mesh = default_client_mesh(W)
@@ -570,6 +574,7 @@ class CfgLeg(NamedTuple):
     fused_epilogue: bool = False
     guards: bool = False
     stream_sketch: bool = False
+    sketch_coalesce: bool = False
     telemetry: bool = False
     collective_plan: str = ""
 
@@ -628,6 +633,18 @@ _CFG_LEGS = {
                      "--stream_sketch (ResNet9, sketch 5x500k k=50k, "
                      "streaming client-phase sketch)",
                      stream_sketch=True),
+    # the `stream` leg with the coalesced client-phase megakernel
+    # (--sketch_coalesce, docs/stream_sketch.md); same config-3 baseline
+    # anchor, so the coalesce-vs-per-leaf delta reads straight off this
+    # leg vs `stream` — the per-leaf table row-block RMW (2·r·c_pad·4
+    # bytes × ~leaf count per microbatch) drops to once per coalesced
+    # group, and the per-leaf kernel-launch overhead goes with it.
+    "coalesce": CfgLeg("sketch", 8, "BASELINE",
+                       "8-worker sketched rounds/sec/chip with "
+                       "--stream_sketch --sketch_coalesce (ResNet9, "
+                       "sketch 5x500k k=50k, coalesced client-phase "
+                       "sketch megakernel)",
+                       stream_sketch=True, sketch_coalesce=True),
     # the headline sketch leg with the telemetry plane's on-device round
     # metrics (--telemetry, docs/observability.md); same config-3 baseline
     # anchor so the telemetry-on overhead reads straight off this leg vs
@@ -679,7 +696,8 @@ def run_config_measurement(name: str) -> None:
         tiny=False, num_classes=num_classes, non_iid=leg.non_iid,
         mode=leg.mode, num_workers=W, server_shard=leg.server_shard,
         fused_epilogue=leg.fused_epilogue, guards=leg.guards,
-        stream_sketch=leg.stream_sketch, telemetry=leg.telemetry,
+        stream_sketch=leg.stream_sketch,
+        sketch_coalesce=leg.sketch_coalesce, telemetry=leg.telemetry,
         collective_plan=leg.collective_plan)
     if K > 1:
         inner = steps.train_step
@@ -799,6 +817,8 @@ _EXTRA_LEGS = {
                "guards_rounds_per_sec"),
     "stream": (["--run-cfg", "stream"], "BENCH_C12_TIMEOUT", 900,
                "stream_rounds_per_sec"),
+    "coalesce": (["--run-cfg", "coalesce"], "BENCH_C12_TIMEOUT", 900,
+                 "coalesce_rounds_per_sec"),
     "telemetry": (["--run-cfg", "telemetry"], "BENCH_C12_TIMEOUT", 900,
                   "telemetry_rounds_per_sec"),
     "downlink": (["--run-cfg", "downlink"], "BENCH_C12_TIMEOUT", 900,

@@ -231,6 +231,22 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                              "materializing the flat d-vector (sketch mode "
                              "with the fused client phase only; composed "
                              "path stays the default).")
+    # Coalesced client-phase sketch megakernel (docs/stream_sketch.md):
+    # refines --stream_sketch by batching adjacent gradient leaves into
+    # covering chunk-range groups, each accumulated with ONE kernel
+    # launch that keeps the table row block VMEM-resident across the
+    # group — one table read+write per group instead of per leaf (~150
+    # per-leaf launches/microbatch at GPT-2 geometry). Bit-identical to
+    # the per-leaf streaming path; env kill-switch
+    # COMMEFFICIENT_SKETCH_COALESCE=0 restores per-leaf without a flag
+    # change.
+    parser.add_argument("--sketch_coalesce", action="store_true",
+                        dest="sketch_coalesce",
+                        help="Coalesce the streamed client-phase sketch's "
+                             "per-leaf accumulate launches into one "
+                             "multi-segment kernel per group of adjacent "
+                             "leaves (requires --stream_sketch; per-leaf "
+                             "path stays the reference).")
     parser.add_argument("--metrics_drain_every", type=int, default=8,
                         help="Fetch per-round metrics in batches of N "
                              "rounds; 1 restores per-round (blocking) "
@@ -446,6 +462,12 @@ def validate_args(args):
                   "--local_momentum 0 / --error_type virtual — and no "
                   "clip, DP, or topk-down); this config runs the "
                   "composed path")
+    if getattr(args, "sketch_coalesce", False) and not args.stream_sketch:
+        # the coalescer refines the leaf-streamed accumulate; without
+        # --stream_sketch there are no per-leaf launches to coalesce
+        print("NOTE: --sketch_coalesce refines the streaming client "
+              "phase; without --stream_sketch it has nothing to coalesce "
+              "and this config runs the composed path")
     if args.reduce_dtype == "int8":
         assert args.server_shard, (
             "--reduce_dtype int8 quantizes the transmit reduce of the "

@@ -299,6 +299,13 @@ class FedModel:
         # d-vector; rounds.build_round_step composes silently when the
         # config is outside the legal window (the fused-epilogue pattern).
         self._stream_sketch = bool(getattr(args, "stream_sketch", False))
+        # Coalesced client-phase sketch (--sketch_coalesce,
+        # docs/stream_sketch.md): adjacent leaves batch into one
+        # multi-segment accumulate launch per covering chunk-range group;
+        # only active inside the streaming window (build_round_step
+        # ignores it otherwise, like the flags above).
+        self._sketch_coalesce = bool(getattr(args, "sketch_coalesce",
+                                             False))
         # Zero-sync telemetry plane (--telemetry, docs/observability.md):
         # the jitted server phase returns one extra fixed-schema device
         # metrics vector per round; it rides the round handle to the
@@ -316,6 +323,7 @@ class FedModel:
                           reduce_dtype=self._reduce_dtype,
                           collective_plan=self.collective_plan,
                           stream_sketch=self._stream_sketch,
+                          sketch_coalesce=self._sketch_coalesce,
                           guards=self._guards,
                           guard_max_abs=self._guard_max_abs,
                           telemetry=self._telemetry_cfg)

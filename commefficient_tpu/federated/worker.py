@@ -60,6 +60,7 @@ from commefficient_tpu.ops.sketch import (
     CountSketch,
     l2estimate,
     sketch_segment_accum,
+    sketch_segments_accum,
     sketch_vec,
 )
 from commefficient_tpu.ops.topk import topk
@@ -182,7 +183,7 @@ def probe_n_metrics(compute_loss, params, model_state, example_batch) -> int:
 
 
 def sketch_grad_tree(sketch: CountSketch, table, grad_tree, segments,
-                     scales=None, interpret: bool = False):
+                     scales=None, groups=None, interpret: bool = False):
     """Stream a gradient PYTREE into a running count-sketch table —
     the streaming client phase's replacement for
     ``sketch_vec(sketch, ravel(grad_tree))`` (docs/stream_sketch.md):
@@ -196,17 +197,40 @@ def sketch_grad_tree(sketch: CountSketch, table, grad_tree, segments,
     BEFORE sketching — a per-leaf constant of the flat rescale masks, and
     exact under the psum reorder for power-of-two mesh axes
     (docs/stream_sketch.md). bf16 leaves are cast to f32 per element
-    (exact), matching the composed path's pad/convert."""
+    (exact), matching the composed path's pad/convert.
+
+    ``groups`` (optional, an ``ops/flat.coalesce_segments`` plan
+    partitioning the leaves — --sketch_coalesce, docs/stream_sketch.md)
+    coalesces each group of adjacent leaves into ONE multi-segment
+    accumulate launch (ops/sketch.sketch_segments_accum): one table
+    row-block read + write per GROUP instead of per leaf, with the
+    per-leaf scales applied identically before the group concatenate —
+    the per-cell f32 add order replays the per-leaf fold (fewer boundary
+    ±0.0 terms is the one deviation, tests/test_sketch_coalesce.py)."""
     leaves = jax.tree_util.tree_leaves(grad_tree)
     assert len(leaves) == len(segments), (len(leaves), len(segments))
     assert scales is None or len(scales) == len(segments)
-    for i, (leaf, seg) in enumerate(zip(leaves, segments)):
+
+    def leaf_flat(i):
+        leaf, seg = leaves[i], segments[i]
         assert int(leaf.size) == seg.size, (leaf.shape, seg)
         x = leaf.reshape(-1).astype(jnp.float32)
         if scales is not None and float(scales[i]) != 1.0:
             x = x * jnp.float32(scales[i])
-        table = sketch_segment_accum(sketch, table, x, seg.offset,
-                                     interpret=interpret)
+        return x
+
+    if groups is None:
+        for i, seg in enumerate(segments):
+            table = sketch_segment_accum(sketch, table, leaf_flat(i),
+                                         seg.offset, interpret=interpret)
+        return table
+    assert groups[0].start == 0 and groups[-1].stop == len(segments) \
+        and all(a.stop == b.start for a, b in zip(groups[:-1], groups[1:])), \
+        "groups must partition the leaf segments in order"
+    for grp in groups:
+        table = sketch_segments_accum(
+            sketch, table, [leaf_flat(i) for i in range(grp.start, grp.stop)],
+            grp.offset, interpret=interpret)
     return table
 
 

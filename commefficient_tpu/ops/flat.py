@@ -24,8 +24,9 @@ the resident data plane.
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass
-from typing import Any, Callable, NamedTuple, Tuple
+from typing import Any, Callable, NamedTuple, Sequence, Tuple
 
 import numpy as np
 
@@ -69,6 +70,120 @@ def leaf_segments(tree: Any) -> Tuple[LeafSegment, ...]:
         segs.append(LeafSegment(path=keys, offset=start, size=n))
         start += n
     return tuple(segs)
+
+
+class SegmentGroup(NamedTuple):
+    """A contiguous run of ``leaf_segments`` leaves coalesced into ONE
+    multi-segment sketch-accumulate launch (--sketch_coalesce,
+    docs/stream_sketch.md). Because ``leaf_segments`` offsets are the
+    running cumulative size, the run covers one contiguous flat span
+    ``[offset, offset + size)`` whose covering chunk range is
+    ``[t_a, t_b)`` — the range the kernel keeps the table row block
+    VMEM-resident across."""
+
+    start: int   # index of the first leaf in the group (into segs)
+    stop: int    # one past the last leaf index
+    offset: int  # flat element offset of the group's first element
+    size: int    # total elements (the leaves are contiguous)
+    t_a: int     # first covering chunk
+    t_b: int     # one past the last covering chunk (== t_a when size == 0)
+
+
+def coalesce_segments(segs: Sequence[LeafSegment], vmem_budget: int, *,
+                      chunk_elems: int) -> Tuple[SegmentGroup, ...]:
+    """Greedy in-order grouping of adjacent ``leaf_segments`` leaves into
+    covering chunk-range groups under a static byte budget — the planner
+    of the coalesced client-phase sketch (docs/stream_sketch.md). A group
+    is extended while its covering chunk range ``[t_a, t_b)`` stays within
+    ``vmem_budget`` bytes of f32 chunks (``chunk_elems`` = the sketch's
+    ``c_pad``); the multi-segment kernel then pays ONE table row-block
+    read + write per group instead of per leaf.
+
+    Rules (pinned in tests/test_sketch_coalesce.py):
+
+    - groups PARTITION the leaves in order (every leaf in exactly one
+      group; flat spans are contiguous by the ``leaf_segments`` layout);
+    - zero-size leaves never open or close a group on their own — they
+      ride whichever group is current (their covering range is empty);
+    - a single leaf whose covering range alone exceeds the budget cannot
+      be split (splitting would only ADD launches): it forms its own
+      group — one launch, exactly the per-leaf path for that leaf, and
+      already optimal (a GPT-2-scale embedding leaf under the auto
+      budget is the normal case, so an oversized leaf alone is silent);
+    - when the budget is smaller than EVERY adjacency — no multi-leaf
+      group forms at all and the plan degenerates to the per-leaf path
+      (e.g. a budget below one chunk) — ONE warning per plan says so.
+
+    Host-side and deterministic; called once per round-step build, never
+    under jit.
+    """
+    segs = tuple(segs)
+    if not segs:
+        return ()
+    ce = int(chunk_elems)
+    budget = int(vmem_budget)
+    assert ce > 0, ce
+    assert budget > 0, budget
+    for a, b in zip(segs[:-1], segs[1:]):
+        # the single-span group math relies on the leaf_segments layout:
+        # each leaf starts exactly where the previous one ends
+        assert b.offset == a.offset + a.size, (a, b)
+
+    def span_bytes(e0: int, e1: int) -> int:
+        if e1 <= e0:
+            return 0
+        return (-(-e1 // ce) - e0 // ce) * ce * 4
+
+    def mk(start: int, stop: int) -> SegmentGroup:
+        e0 = segs[start].offset
+        e1 = segs[stop - 1].offset + segs[stop - 1].size
+        size = e1 - e0
+        t_a = e0 // ce
+        t_b = -(-e1 // ce) if size else t_a
+        return SegmentGroup(start=start, stop=stop, offset=e0, size=size,
+                            t_a=t_a, t_b=t_b)
+
+    groups = []
+    start = 0
+    g_e0 = segs[0].offset
+    cur_size = segs[0].size
+    for i in range(1, len(segs)):
+        s = segs[i]
+        end = s.offset + s.size
+        if (span_bytes(g_e0, end) <= budget or cur_size == 0
+                or s.size == 0):
+            # fits; or the group holds only zero-size leaves so far (an
+            # oversized leaf joining them still yields one launch); or
+            # the leaf itself is zero-size (adds no span)
+            cur_size += s.size
+            continue
+        groups.append(mk(start, i))
+        start, g_e0, cur_size = i, s.offset, s.size
+    groups.append(mk(start, len(segs)))
+
+    n_nonzero = sum(1 for s in segs if s.size)
+    multi = any(sum(1 for s in segs[g.start:g.stop] if s.size) > 1
+                for g in groups)
+    if n_nonzero > 1 and not multi:
+        # there WAS something to coalesce (>= 2 nonzero leaves) and the
+        # plan coalesced nothing — every adjacency (and possibly every
+        # single leaf) exceeds the budget, so --sketch_coalesce buys
+        # zero benefit: the degenerate misconfiguration worth one
+        # warning. (An oversized leaf INSIDE an otherwise-coalesced plan
+        # is normal — GPT-2's embedding under the auto budget — and its
+        # single launch is already optimal, so it stays silent.)
+        worst = max((g for g in groups if g.size),
+                    key=lambda g: g.t_b - g.t_a)
+        big = next(segs[i] for i in range(worst.start, worst.stop)
+                   if segs[i].size)
+        warnings.warn(
+            f"coalesce_segments: budget {budget} B is smaller than every "
+            f"leaf adjacency's covering chunk range (largest single leaf "
+            f"{big.path!r}: {worst.t_b - worst.t_a} chunks "
+            f"= {(worst.t_b - worst.t_a) * ce * 4} B); no adjacent "
+            f"leaves coalesced — the plan degenerates to one per-leaf "
+            f"launch each", RuntimeWarning)
+    return tuple(groups)
 
 
 def chunked_unravel(layout: "ChunkLayout",

@@ -362,6 +362,18 @@ def _use_pallas_sketch() -> bool:
             and os.environ.get("COMMEFFICIENT_PALLAS_SKETCH", "1") != "0")
 
 
+def _sketch_interpret_forced() -> bool:
+    """COMMEFFICIENT_PALLAS_SKETCH=interpret forces the running-table
+    accumulate kernels through the Pallas interpreter even off-TPU — the
+    CPU-mesh test hook (mirroring COMMEFFICIENT_FUSED_EPILOGUE=interpret)
+    that lets the structural launch-count asserts of
+    tests/test_sketch_coalesce.py see real ``pallas_call`` eqns in the
+    jitted client phase instead of the pure-XLA scan fold."""
+    import os
+
+    return os.environ.get("COMMEFFICIENT_PALLAS_SKETCH") == "interpret"
+
+
 def _use_pallas_estimates() -> bool:
     """Separate kill-switch for the query kernel so a failure there (newer,
     DMA-based) can be disabled without losing the proven accumulate kernel."""
@@ -495,6 +507,16 @@ def _check_sketch_kernel_once(eager: bool = False) -> None:
         want_a = _sketch_accum_chunks_jax(cs, tbl0, seg3, t_a)
         if not np.array_equal(np.asarray(got_a), np.asarray(want_a)):
             raise AssertionError("segment accumulate != pure XLA fold")
+        # coalesced multi-segment accumulate (--sketch_coalesce,
+        # docs/stream_sketch.md): ONE launch over a group of contiguous
+        # segments must equal the same span's single-segment accumulate
+        # (== comparison: fewer boundary ±0.0 terms is the one allowed
+        # deviation, same caveat class as the fused epilogue's)
+        cuts = (a, a + 11_003, a + 11_004, b)
+        got_g = sketch_segments_accum(
+            cs, tbl0, [v[x:y] for x, y in zip(cuts[:-1], cuts[1:])], a)
+        if not np.array_equal(np.asarray(got_g), np.asarray(want_a)):
+            raise AssertionError("multi-segment accumulate != segment fold")
     except Exception as e:  # noqa: BLE001 — any failure means: don't use it
         os.environ["COMMEFFICIENT_PALLAS_SKETCH"] = "0"
         warnings.warn(
@@ -538,18 +560,14 @@ def sketch_chunks(cs: CountSketch, v3: jax.Array) -> jax.Array:
     return _sketch_chunks_jax(cs, v3)
 
 
-@functools.partial(jax.jit, static_argnames=("S", "T", "interpret"))
-def _sketch_accum_pallas(tbl3, v3, shift_q, shift_w, sign_keys, t0, *, S, T,
-                         interpret=False):
-    """``_sketch_vec_pallas`` with a RUNNING-TABLE init: the output row
-    starts from ``tbl3``'s row instead of zeros, then accumulates the T
-    chunks exactly like the zero-init kernel. Per (row, cell) the f32 adds
-    are ``tbl + c_0 + c_1 + ...`` in chunk order — bit-continuing the pure
-    scan's left fold, which is what lets the streaming client phase
-    (docs/stream_sketch.md) sketch a gradient leaf-by-leaf and still match
-    the composed ravel-then-``sketch_vec`` path's per-cell add order.
-    ``t0`` is the chunks' global index offset as in ``_sketch_vec_pallas``
-    (shift arrays arrive pre-sliced to the local chunk range)."""
+def _accum_pallas_call(tbl3, v3, shift_q, shift_w, sign_keys, t0, S, T,
+                       interpret):
+    """Shared lowering of the RUNNING-TABLE accumulate kernels
+    (``_sketch_accum_pallas`` / ``_sketch_segments_pallas`` — one body so
+    the per-leaf and coalesced client phases cannot drift bit-wise; the
+    two jit wrappers exist so each path keeps its own name in traces and
+    the client-launch counter stays attributable,
+    scripts/tpu_profile.py)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -595,6 +613,36 @@ def _sketch_accum_pallas(tbl3, v3, shift_q, shift_w, sign_keys, t0, *, S, T,
         interpret=interpret,
     )(shift_q, shift_w, sign_keys, t0, tbl3, v3)
     return out
+
+
+@functools.partial(jax.jit, static_argnames=("S", "T", "interpret"))
+def _sketch_accum_pallas(tbl3, v3, shift_q, shift_w, sign_keys, t0, *, S, T,
+                         interpret=False):
+    """``_sketch_vec_pallas`` with a RUNNING-TABLE init: the output row
+    starts from ``tbl3``'s row instead of zeros, then accumulates the T
+    chunks exactly like the zero-init kernel. Per (row, cell) the f32 adds
+    are ``tbl + c_0 + c_1 + ...`` in chunk order — bit-continuing the pure
+    scan's left fold, which is what lets the streaming client phase
+    (docs/stream_sketch.md) sketch a gradient leaf-by-leaf and still match
+    the composed ravel-then-``sketch_vec`` path's per-cell add order.
+    ``t0`` is the chunks' global index offset as in ``_sketch_vec_pallas``
+    (shift arrays arrive pre-sliced to the local chunk range)."""
+    return _accum_pallas_call(tbl3, v3, shift_q, shift_w, sign_keys, t0,
+                              S, T, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("S", "T", "interpret"))
+def _sketch_segments_pallas(tbl3, v3, shift_q, shift_w, sign_keys, t0, *, S,
+                            T, interpret=False):
+    """The multi-segment (coalesced-group) accumulate kernel
+    (--sketch_coalesce, docs/stream_sketch.md): bit-for-bit the SAME
+    lowering as ``_sketch_accum_pallas`` (shared ``_accum_pallas_call``),
+    under its own jit name so client-phase launch counts are attributable
+    per path in traces — ``v3`` here holds a whole GROUP's covering chunk
+    range (many leaves, one launch), so the table row block is read and
+    written once per group instead of once per leaf."""
+    return _accum_pallas_call(tbl3, v3, shift_q, shift_w, sign_keys, t0,
+                              S, T, interpret)
 
 
 def _sketch_accum_chunks_jax(cs: CountSketch, table: jax.Array,
@@ -663,8 +711,80 @@ def sketch_segment_accum(cs: CountSketch, table: jax.Array, seg: jax.Array,
     v3, t_a = _segment_chunks(cs, seg, e0)
     if _trace_state_clean():
         _check_sketch_kernel_once(eager=True)
+    interpret = interpret or _sketch_interpret_forced()
     if _use_pallas_sketch() or interpret:
         out = _sketch_accum_pallas(
+            table.reshape(cs.r, cs.sublanes, _LANES), v3,
+            cs.shift_q[:, t_a:t_a + v3.shape[0]],
+            cs.shift_w[:, t_a:t_a + v3.shape[0]], cs.sign_keys,
+            np.full(1, t_a, np.int32), S=cs.sublanes, T=v3.shape[0],
+            interpret=interpret)
+        return out.reshape(cs.r, cs.c_pad)
+    return _sketch_accum_chunks_jax(cs, table, v3, t_a)
+
+
+# staging ceiling for the segment coalescer's auto budget: far above any
+# single covering chunk range worth coalescing, far below the d-plane
+_COALESCE_MAX_BUDGET = 32 * 1024 * 1024
+
+
+def coalesce_vmem_budget(cs: CountSketch) -> int:
+    """Auto group-sizing budget (bytes) for ``ops/flat.coalesce_segments``
+    (--sketch_coalesce, docs/stream_sketch.md). The multi-segment kernel
+    streams a group's chunks through VMEM one ``(S, 128)`` block at a time
+    while the table row block stays resident, so its per-step VMEM is
+    group-size-INDEPENDENT; what the budget actually bounds is the group's
+    covering chunk-range STAGING buffer — the trace-time concatenate+pad
+    of the group's leaves — which must stay well under d or the
+    O(d)→O(table) memory story --stream_sketch exists for quietly erodes
+    through the coalescer. ``min(32 MiB, max(one chunk, padded/4))``:
+    GPT-2 124M (c_pad≈500k, T=249) gets 32 MiB ≈ 16-chunk groups — ~150
+    per-leaf launches collapse to ~16 — while the CIFAR FetchSGD geometry
+    (T=14) gets ~7 MiB ≈ 3-chunk groups, and no geometry ever stages more
+    than max(one chunk, a quarter of its padded plane) — the one-chunk
+    floor means a T<4 geometry can stage up to its whole (tiny) plane,
+    which is already smaller than a single launch's table block."""
+    chunk_bytes = cs.c_pad * 4
+    padded = cs.T * chunk_bytes
+    return int(min(_COALESCE_MAX_BUDGET, max(chunk_bytes, padded // 4)))
+
+
+def sketch_segments_accum(cs: CountSketch, table: jax.Array, segs,
+                          e0: int, interpret: bool = False) -> jax.Array:
+    """ONE kernel launch for a GROUP of contiguous flat segments
+    (--sketch_coalesce, docs/stream_sketch.md): ``segs`` is a sequence of
+    1-D arrays where segment ``i`` starts exactly where ``i-1`` ends and
+    the first starts at STATIC flat offset ``e0`` (adjacent leaves of the
+    ``ops/flat.leaf_segments`` layout are contiguous by construction —
+    ``ops/flat.coalesce_segments`` plans the groups). The group's covering
+    chunk-range buffer is assembled at trace time (concatenate + the same
+    chunk-boundary pads ``_segment_chunks`` makes — group-sized, never
+    d-sized) and handed to the multi-segment kernel, which keeps each
+    table row block VMEM-resident across EVERY chunk of the group: one
+    table read + one table write per group instead of per leaf.
+
+    Bit-compatibility (pinned in tests/test_sketch_coalesce.py): per table
+    cell and chunk exactly one coordinate contributes and the fold visits
+    chunks in the same order as folding ``sketch_segment_accum`` over the
+    segments one by one, so the per-cell f32 add order replays the
+    per-leaf streaming fold — the only deviation is FEWER boundary-chunk
+    ``±0.0`` terms (per-leaf processes a straddled chunk once per leaf,
+    coalesced once per group), i.e. the sign of all-zero cells; never a
+    value under ``==``. Zero-size segments are skipped."""
+    e0 = int(e0)
+    xs = [s.reshape(-1).astype(jnp.float32) for s in segs if int(s.size)]
+    n = sum(int(x.size) for x in xs)
+    assert table.shape == cs.table_shape, (table.shape, cs.table_shape)
+    if n == 0:
+        return table
+    assert 0 <= e0 and e0 + n <= cs.d, (e0, n, cs.d)
+    v = xs[0] if len(xs) == 1 else jnp.concatenate(xs)
+    v3, t_a = _segment_chunks(cs, v, e0)
+    if _trace_state_clean():
+        _check_sketch_kernel_once(eager=True)
+    interpret = interpret or _sketch_interpret_forced()
+    if _use_pallas_sketch() or interpret:
+        out = _sketch_segments_pallas(
             table.reshape(cs.r, cs.sublanes, _LANES), v3,
             cs.shift_q[:, t_a:t_a + v3.shape[0]],
             cs.shift_w[:, t_a:t_a + v3.shape[0]], cs.sign_keys,
@@ -685,6 +805,7 @@ def sketch_chunks_accum(cs: CountSketch, table: jax.Array, v3: jax.Array,
     assert table.shape == cs.table_shape, (table.shape, cs.table_shape)
     if _trace_state_clean():
         _check_sketch_kernel_once(eager=True)
+    interpret = interpret or _sketch_interpret_forced()
     if _use_pallas_sketch() or interpret:
         out = _sketch_accum_pallas(
             table.reshape(cs.r, cs.sublanes, _LANES), v3, cs.shift_q,

@@ -370,6 +370,11 @@ def main(argv=None):
               "leaf-by-leaf into the count-sketch table "
               "(docs/stream_sketch.md; COMMEFFICIENT_STREAM_SKETCH=0 "
               "restores the composed path)")
+    if args.sketch_coalesce:
+        print("sketch-coalesce requested: adjacent gradient leaves batch "
+              "into one accumulate launch per chunk-range group "
+              "(docs/stream_sketch.md; COMMEFFICIENT_SKETCH_COALESCE=0 "
+              "restores the per-leaf streaming path)")
     print(args)
     timer = Timer()
     np.random.seed(args.seed)

@@ -252,6 +252,15 @@ def train(argv=None):
               "leaf-by-leaf into the count-sketch table "
               "(docs/stream_sketch.md; COMMEFFICIENT_STREAM_SKETCH=0 "
               "restores the composed path)")
+    if args.sketch_coalesce:
+        # the ~150 per-leaf accumulate launches of the GPT-2 streaming
+        # client phase re-read the table row block per leaf (~3 GB/round
+        # of table churn, docs/stream_sketch.md honest ledger) — the
+        # coalesced plan is where that churn drops to per-group
+        print("sketch-coalesce requested: adjacent gradient leaves batch "
+              "into one accumulate launch per chunk-range group "
+              "(docs/stream_sketch.md; COMMEFFICIENT_SKETCH_COALESCE=0 "
+              "restores the per-leaf streaming path)")
     print(args)
     timer = Timer()
 

@@ -15,6 +15,10 @@
 #     --fused_epilogue, plus the no-d-sized-movement and table-sized-carry
 #     structural asserts (tests/test_stream_sketch.py,
 #     docs/stream_sketch.md);
+#   - the coalesced client-phase megakernel's bit-identity to the
+#     per-leaf streaming path across the same matrix, the coalescer's
+#     planner contracts, and the launch-count == group-count structural
+#     assert (tests/test_sketch_coalesce.py, docs/stream_sketch.md);
 #   - the telemetry plane's non-perturbation (fp32 bit-identity with
 #     --telemetry on/off on BOTH planes) and its strict zero-host-sync
 #     audit with guards+telemetry through the engine
@@ -33,6 +37,6 @@ cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_sharded_server.py tests/test_fused_epilogue.py \
-    tests/test_stream_sketch.py tests/test_telemetry.py \
-    tests/test_compressed_collectives.py \
+    tests/test_stream_sketch.py tests/test_sketch_coalesce.py \
+    tests/test_telemetry.py tests/test_compressed_collectives.py \
     -q -p no:cacheprovider "$@"

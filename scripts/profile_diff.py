@@ -80,6 +80,19 @@ _PRESETS: Dict[str, Dict[str, float]] = {
         "convolution": 10.0,
         "matmul": 10.0,
     },
+    # the --sketch_coalesce claim (docs/stream_sketch.md): the client
+    # phase's sketch-accumulate launch bucket ("client sketch accumulate
+    # (launches)" — the _sketch_accum_pallas/_sketch_segments_pallas
+    # spans scripts/tpu_profile.py counts) must not grow at all and is
+    # expected to collapse from ~leaf count to the coalesced group count.
+    # Diff the *_coalesce.md capture against the *_stream.md one (the
+    # per-leaf streaming build is the baseline); the model itself must
+    # stay flat (10% covers tenancy noise between captures).
+    "sketch-coalesce": {
+        "client sketch accumulate (launches)": 0.0,
+        "convolution": 10.0,
+        "matmul": 10.0,
+    },
 }
 
 

@@ -66,10 +66,13 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # (or the whole state file) at the next window so it re-runs alongside the
 # new stream/stream_sketch/profile_stream legs; one pass decides both
 # defaults (docs/stream_sketch.md, docs/fused_epilogue.md).
+# NOTE (sketch-coalesce PR): the coalesce/sketch_coalesce/
+# profile_coalesce steps ride the same window — profile_coalesce diffs
+# against the profile_stream capture, so run profile_stream first.
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
-telemetry downlink compressed_collectives stream_sketch fused_epilogue \
-learning profile profile_fused profile_stream profile_gpt2 host_offload \
-imagenet ops"}
+coalesce telemetry downlink compressed_collectives stream_sketch \
+sketch_coalesce fused_epilogue learning profile profile_fused \
+profile_stream profile_coalesce profile_gpt2 host_offload imagenet ops"}
 i=0
 for step in $STEPS; do
   i=$((i + 1))
@@ -97,7 +100,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|telemetry|downlink)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|downlink)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact.
       # `telemetry` is the telemetry-overhead A/B leg: headline geometry
@@ -200,6 +203,42 @@ for step in $STEPS; do
         mark_done profile_stream
       fi
       log "step $i rc=$rc (docs/measurements/tpu_profile_stream.md on success)"
+      ;;
+    profile_coalesce)
+      # --sketch_coalesce per-op capture + the launch-count gate against
+      # the PER-LEAF streaming capture (docs/stream_sketch.md): the
+      # "client sketch accumulate (launches)" bucket must not grow and is
+      # expected to collapse to the group count. Needs the per-leaf
+      # streaming capture first (the 'profile_stream' step).
+      log "step $i: tpu_profile.py sketch-coalesce capture + diff (40m)"
+      TPU_PROFILE_COALESCE=1 timeout 2400 python scripts/tpu_profile.py \
+        >"$OUT/profile_coalesce.log" 2>&1
+      rc=$?
+      if [ $rc -eq 0 ]; then
+        python scripts/profile_diff.py \
+          docs/measurements/tpu_profile_stream.md \
+          docs/measurements/tpu_profile_coalesce.md \
+          --preset sketch-coalesce \
+          >"$OUT/profile_coalesce_diff.log" 2>&1 || \
+          log "note: sketch-coalesce launch gate FAILED (see diff log)"
+        mark_done profile_coalesce
+      fi
+      log "step $i rc=$rc (docs/measurements/tpu_profile_coalesce.md on success)"
+      ;;
+    sketch_coalesce)
+      # per-leaf vs coalesced streaming client phase A/B at the headline
+      # CIFAR geometry (docs/stream_sketch.md gate decision rule) — run
+      # in the same window as the stream/fused/telemetry A/Bs so one
+      # pass decides the whole client-phase default stack
+      log "step $i: tpu_measure.py sketch_coalesce A/B (timeout 30m)"
+      timeout 1800 python scripts/tpu_measure.py sketch_coalesce \
+        >"$OUT/tpu_measure_coalesce.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_coalesce.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "coalesced round" "$OUT/tpu_measure_coalesce.log"; then
+        mark_done sketch_coalesce
+      fi
       ;;
     fused_epilogue)
       # composed-vs-fused epilogue chain A/B + the re-armed topk A/B with

@@ -40,12 +40,19 @@ if TARGET not in ("cifar", "gpt2"):
 # (--preset fused-epilogue) — no hand-editing of captures.
 # TPU_PROFILE_STREAM=1 does the same for the --stream_sketch client phase
 # (*_stream.md capture; gate with profile_diff --preset stream-sketch).
+# TPU_PROFILE_COALESCE=1 profiles --stream_sketch --sketch_coalesce
+# (*_coalesce.md capture; gate with profile_diff --preset sketch-coalesce
+# AGAINST THE *_stream.md CAPTURE — the per-leaf streaming build is the
+# baseline whose launch count coalescing shrinks).
 FUSED = os.environ.get("TPU_PROFILE_FUSED") == "1"
 STREAM = os.environ.get("TPU_PROFILE_STREAM") == "1"
-if FUSED and STREAM:
-    sys.exit("set only one of TPU_PROFILE_FUSED / TPU_PROFILE_STREAM per "
-             "capture — a combined capture has no baseline to diff against")
-_SUFFIX = "_fused" if FUSED else ("_stream" if STREAM else "")
+COALESCE = os.environ.get("TPU_PROFILE_COALESCE") == "1"
+if sum([FUSED, STREAM, COALESCE]) > 1:
+    sys.exit("set only one of TPU_PROFILE_FUSED / TPU_PROFILE_STREAM / "
+             "TPU_PROFILE_COALESCE per capture — a combined capture has "
+             "no baseline to diff against")
+_SUFFIX = "_fused" if FUSED else (
+    "_stream" if STREAM else ("_coalesce" if COALESCE else ""))
 OUT_MD = os.path.join(
     _REPO, "docs", "measurements",
     f"tpu_profile{_SUFFIX}.md" if TARGET == "cifar"
@@ -75,6 +82,12 @@ COUNTERS = (
      "stream-sketch", "docs/stream_sketch.md"),
     ("reduce (transmit collectives)", "transmit_collectives",
      "sharded-server", "docs/sharded_server.md"),
+    # client-phase sketch-accumulate kernel launches/round: the running-
+    # table accumulate kernels are exclusively client-phase, so their
+    # span count IS the launch count --sketch_coalesce shrinks from
+    # ~leaf count to group count (docs/stream_sketch.md)
+    ("client sketch accumulate (launches)", "client_sketch_launches",
+     "sketch-coalesce", "docs/stream_sketch.md"),
 )
 
 
@@ -109,6 +122,20 @@ def _category(op_name: str) -> str:
          r"|_descent_pallas|compare_select_fusion|multiply_subtract_fusion"
          r"|convert_reduce_fusion[^=]*= s32\[(15|7|16)\]",
          "server epilogue (d-plane sweeps)"),
+        # Client-phase sketch-accumulate launches (docs/stream_sketch.md):
+        # the RUNNING-TABLE accumulate kernels are exclusively client-
+        # phase — the --stream_sketch per-leaf path launches
+        # _sketch_accum_pallas once per gradient leaf (each re-reading/
+        # re-writing the 2·r·c_pad·4-byte table row block), the
+        # --sketch_coalesce megakernel launches _sketch_segments_pallas
+        # once per coalesced group — so this bucket's span count/round IS
+        # the client phase's kernel-launch count, the quantity the
+        # sketch-coalesce preset gates at zero growth. Deliberately NOT
+        # _sketch_vec_pallas: that zero-init kernel also serves the
+        # composed client sketch AND the server re-sketch, which would
+        # pollute the launch count with server-phase spans.
+        (r"_sketch_accum_pallas|_sketch_segments_pallas",
+         "client sketch accumulate (launches)"),
         # Client flatten/movement (docs/stream_sketch.md): the d-sized
         # 1-D layout ops the streaming sketch exists to delete — the
         # flat-gradient concatenate of the backward pass, the pad/reshape
@@ -217,6 +244,8 @@ def write_report(plane, line, agg, wall_ms_per_round, backend, d, tiny,
         geom += ", --fused_epilogue"
     if STREAM:
         geom += ", --stream_sketch"
+    if COALESCE:
+        geom += ", --stream_sketch --sketch_coalesce"
     os.makedirs(os.path.dirname(out_md), exist_ok=True)
     with open(out_md, "w") as f:
         f.write(f"# Per-op profile: {title}\n\n")
@@ -276,10 +305,12 @@ def main() -> int:
             print("gpt2 profile target is chip-only (d=124M)", flush=True)
             return 2
         steps, ps, ss, cs, batch, _tokens = B.build_gpt2(
-            bf16=True, fused_epilogue=FUSED, stream_sketch=STREAM)
+            bf16=True, fused_epilogue=FUSED,
+            stream_sketch=STREAM or COALESCE, sketch_coalesce=COALESCE)
     else:
         steps, ps, ss, cs, batch = B.build(tiny=tiny, fused_epilogue=FUSED,
-                                           stream_sketch=STREAM)
+                                           stream_sketch=STREAM or COALESCE,
+                                           sketch_coalesce=COALESCE)
     d = int(ps.size)
 
     def drain(x):

@@ -1,0 +1,514 @@
+"""Coalesced client-phase sketch megakernel (--sketch_coalesce,
+docs/stream_sketch.md).
+
+Contracts pinned on the forced-8-device CPU mesh:
+
+1. planner (``ops/flat.coalesce_segments``): groups partition the leaves
+   in order under the byte budget — zero-size leaves ride their
+   neighbors, a leaf straddling many chunk boundaries coalesces or falls
+   back cleanly, a budget covering the padded plane yields ONE group,
+   and a budget smaller than one leaf falls back to per-leaf with ONE
+   warning;
+2. op level: ``ops/sketch.sketch_segments_accum`` (one launch per group)
+   equals the per-leaf ``sketch_segment_accum`` fold and the composed
+   ``sketch_vec`` (``==``: all-zero cells may differ in zero sign), on
+   the pure path and the Pallas kernel through the interpreter;
+3. tree level: ``worker.sketch_grad_tree(groups=...)`` equals the
+   per-leaf call bit-for-bit, per-leaf tp/ep scales included;
+4. round level: fp32 ``--sketch_coalesce`` trajectories are
+   BIT-IDENTICAL to the per-leaf ``--stream_sketch`` path across
+   replicated/``--server_shard`` × composed/``--fused_epilogue`` —
+   coalescing replays the per-leaf fold's add order, so unlike
+   stream-vs-composed there is NO microbatch/wd window caveat;
+5. structure: with COMMEFFICIENT_PALLAS_SKETCH=interpret the jitted
+   client phase's sketch-accumulate ``pallas_call`` count EQUALS the
+   coalesce plan's group count — strictly fewer than the per-leaf
+   build's launch count (shown to trip the detector) — and
+   COMMEFFICIENT_SKETCH_COALESCE=0 restores the per-leaf counts;
+6. rollout: --sketch_coalesce without --stream_sketch runs the composed
+   client phase (d-sized scan carry), not a half-enabled stream.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from commefficient_tpu.federated.rounds import (
+    RoundConfig,
+    build_round_step,
+    init_client_states,
+)
+from commefficient_tpu.federated.server import (
+    ServerConfig,
+    init_server_state,
+)
+from commefficient_tpu.federated.worker import WorkerConfig, sketch_grad_tree
+from commefficient_tpu.ops.flat import (
+    LeafSegment,
+    SegmentGroup,
+    coalesce_segments,
+    leaf_segments,
+    ravel_pytree,
+)
+from commefficient_tpu.ops.sketch import (
+    coalesce_vmem_budget,
+    make_sketch,
+    sketch_segment_accum,
+    sketch_segments_accum,
+    sketch_vec,
+)
+from tests.test_sharded_server import N, _mesh
+from tests.test_stream_sketch import (
+    _batch,
+    _max_scan_carry,
+    _mlp_loss,
+    _mlp_params,
+    _run_rounds,
+)
+
+CE = 512  # chunk elements used by the planner-only tests
+
+
+def _segs(*sizes, names=None):
+    """A contiguous LeafSegment layout from leaf sizes (incl. zeros)."""
+    out, off = [], 0
+    for i, n in enumerate(sizes):
+        name = names[i] if names else f"leaf{i}"
+        out.append(LeafSegment(path=name, offset=off, size=n))
+        off += n
+    return tuple(out)
+
+
+def _span_bytes(g: SegmentGroup) -> int:
+    return (g.t_b - g.t_a) * CE * 4
+
+
+# ---- 1. planner: partition / budget / edge cases -------------------------
+
+class TestCoalescePlanner:
+    def _check_partition(self, segs, groups):
+        assert groups[0].start == 0 and groups[-1].stop == len(segs)
+        for a, b in zip(groups[:-1], groups[1:]):
+            assert a.stop == b.start
+        for g in groups:
+            assert g.offset == segs[g.start].offset
+            assert g.size == sum(s.size for s in segs[g.start:g.stop])
+            if g.size:
+                assert g.t_a == g.offset // CE
+                assert g.t_b == -(-(g.offset + g.size) // CE)
+
+    def test_gpt2_like_layout_groups_fewer_than_leaves(self):
+        """A GPT-2-shaped layout — one embedding-scale leaf followed by
+        many small ln/bias/attn leaves — must coalesce to strictly fewer
+        launches than leaves under a mid budget."""
+        sizes = [10 * CE + 37]  # 'wte': straddles 11 chunk boundaries
+        for _ in range(12):
+            sizes += [CE // 2, 64, 0, 3 * CE + 5, 64]  # blocks w/ empties
+        segs = _segs(*sizes)
+        budget = 6 * CE * 4
+        groups = coalesce_segments(segs, budget, chunk_elems=CE)
+        self._check_partition(segs, groups)
+        nonzero = sum(1 for s in segs if s.size)
+        assert len(groups) < nonzero, (len(groups), nonzero)
+        for g in groups:
+            # only single-nonzero-leaf groups may exceed the budget
+            if _span_bytes(g) > budget:
+                assert sum(1 for s in segs[g.start:g.stop] if s.size) == 1
+
+    def test_zero_size_leaves_ride_neighbors(self):
+        """Zero-size leaves never form their own group — leading,
+        embedded, and trailing empties all attach."""
+        segs = _segs(0, 0, 100, 0, 200, 0, 0)
+        groups = coalesce_segments(segs, 10 * CE * 4, chunk_elems=CE)
+        self._check_partition(segs, groups)
+        assert len(groups) == 1
+        assert groups[0].size == 300
+
+    def test_single_group_covers_whole_layout(self):
+        segs = _segs(137, 1, CE, 3 * CE + 11, 40)
+        total = segs[-1].offset + segs[-1].size
+        padded_bytes = -(-total // CE) * CE * 4
+        groups = coalesce_segments(segs, padded_bytes, chunk_elems=CE)
+        self._check_partition(segs, groups)
+        assert len(groups) == 1
+        assert groups[0] == SegmentGroup(0, len(segs), 0, total, 0,
+                                         -(-total // CE))
+
+    def test_budget_smaller_than_leaf_falls_back_per_leaf_one_warning(self):
+        """Every leaf's covering range exceeds a sub-chunk budget: the
+        plan degenerates to one group per nonzero leaf (zero-size leaves
+        still ride), with exactly ONE warning for the whole plan."""
+        segs = _segs(CE, 0, 2 * CE, CE // 2, 0)
+        with pytest.warns(RuntimeWarning,
+                          match="covering chunk range") as rec:
+            groups = coalesce_segments(segs, 100, chunk_elems=CE)
+        assert len([w for w in rec
+                    if issubclass(w.category, RuntimeWarning)]) == 1
+        self._check_partition(segs, groups)
+        assert len(groups) == 3  # one per nonzero leaf
+        for g in groups:
+            assert sum(1 for s in segs[g.start:g.stop] if s.size) == 1
+
+    def test_degenerate_plan_warns_even_when_each_leaf_fits(self):
+        """Leaves that each fit the budget alone but where NO adjacency
+        does: the plan is fully per-leaf — zero benefit from the flag —
+        and must warn, even though no single leaf is oversized."""
+        segs = _segs(2 * CE, 2 * CE, 2 * CE)
+        with pytest.warns(RuntimeWarning, match="no adjacent leaves "
+                          "coalesced"):
+            groups = coalesce_segments(segs, 2 * CE * 4, chunk_elems=CE)
+        self._check_partition(segs, groups)
+        assert len(groups) == 3
+
+    def test_single_leaf_layout_is_silent(self):
+        """One leaf = nothing to coalesce; a one-group plan is not a
+        misconfiguration and must not warn."""
+        segs = _segs(3 * CE)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            groups = coalesce_segments(segs, 100, chunk_elems=CE)
+        assert len(groups) == 1
+
+    def test_budget_respected_under_fit(self):
+        """When no single leaf is oversized, every group's covering range
+        fits the budget."""
+        segs = _segs(*([CE // 4] * 40))
+        budget = 3 * CE * 4
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # no fallback warning allowed
+            groups = coalesce_segments(segs, budget, chunk_elems=CE)
+        self._check_partition(segs, groups)
+        assert 1 < len(groups) < 40
+        for g in groups:
+            assert _span_bytes(g) <= budget
+
+    def test_empty_layout(self):
+        assert coalesce_segments((), 1024, chunk_elems=CE) == ()
+
+    def test_auto_budget_sane(self):
+        cs = make_sketch(5000, 512, 3, seed=1, num_blocks=1)
+        b = coalesce_vmem_budget(cs)
+        # at least one chunk, at most the 32 MiB staging ceiling
+        assert cs.c_pad * 4 <= b <= 32 * 1024 * 1024
+
+
+class TestLeafSegmentsEdges:
+    """ops/flat.leaf_segments edge cases the coalescer leans on: empty
+    leaves occupy zero width (their neighbors stay contiguous) and scalar
+    leaves occupy one slot — offsets always match the ravel layout."""
+
+    def test_zero_size_and_scalar_leaves(self):
+        tree = {
+            "a": jnp.zeros((3, 4)),
+            "empty": jnp.zeros((0, 7)),
+            "s": jnp.asarray(2.5),
+            "z": jnp.zeros((5,)),
+        }
+        segs = leaf_segments(tree)
+        sizes = {s.path: s.size for s in segs}
+        assert sizes["empty"] == 0
+        assert sizes["s"] == 1
+        # contiguity incl. across the empty leaf
+        for a, b in zip(segs[:-1], segs[1:]):
+            assert b.offset == a.offset + a.size
+        flat, _ = ravel_pytree(tree)
+        assert segs[-1].offset + segs[-1].size == int(flat.size)
+        for s in segs:
+            if s.path == "s":
+                np.testing.assert_array_equal(
+                    np.asarray(flat[s.offset]), np.float32(2.5))
+
+
+# ---- 2. op level: grouped accumulate == per-leaf fold == composed --------
+
+class TestSegmentsAccum:
+    # (d, c, r, leaf boundaries) — unaligned cuts, 1-element leaves, a
+    # leaf straddling many chunk boundaries, zero-size leaves
+    CASES = [
+        (5000, 512, 3, (0, 137, 138, 512, 512, 4000, 5000)),
+        (5000, 512, 3, (0, 5000)),
+        (3000, 128, 2, (0, 1, 2, 129, 129, 2900, 3000)),
+    ]
+
+    @staticmethod
+    def _cuts(bounds):
+        cuts = sorted(set(bounds))
+        return list(zip(cuts[:-1], cuts[1:]))
+
+    @pytest.mark.parametrize("d,c,r,bounds", CASES,
+                             ids=[f"d{d}-{len(b)}cuts" for d, c, r, b
+                                  in CASES])
+    @pytest.mark.parametrize("interpret", [False, True],
+                             ids=["pure", "interpret"])
+    def test_grouped_equals_perleaf_and_composed(self, d, c, r, bounds,
+                                                 interpret):
+        cs = make_sketch(d, c, r, seed=7, num_blocks=2)
+        v = jnp.asarray(np.random.RandomState(3).randn(d), jnp.float32)
+        cuts = self._cuts(bounds)
+        # per-leaf reference fold
+        ref = jnp.zeros(cs.table_shape, jnp.float32)
+        for a, b in cuts:
+            ref = sketch_segment_accum(cs, ref, v[a:b], a,
+                                       interpret=interpret)
+        # grouped: split the leaves into two groups at an arbitrary point
+        mid = max(1, len(cuts) // 2)
+        tbl = jnp.zeros(cs.table_shape, jnp.float32)
+        for grp in (cuts[:mid], cuts[mid:]):
+            if not grp:
+                continue
+            tbl = sketch_segments_accum(cs, tbl,
+                                        [v[a:b] for a, b in grp],
+                                        grp[0][0], interpret=interpret)
+        want = sketch_vec(cs, v)
+        np.testing.assert_array_equal(np.asarray(tbl), np.asarray(ref))
+        np.testing.assert_array_equal(np.asarray(tbl), np.asarray(want))
+
+    def test_zero_size_segments_inside_group(self):
+        cs = make_sketch(2000, 256, 3, seed=2, num_blocks=2)
+        v = jnp.asarray(np.random.RandomState(9).randn(2000), jnp.float32)
+        t = jnp.zeros(cs.table_shape, jnp.float32)
+        got = sketch_segments_accum(
+            cs, t, [v[0:0], v[:700], jnp.zeros(0), v[700:2000]], 0)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(sketch_vec(cs, v)))
+
+    def test_single_segment_group_equals_segment_accum(self):
+        cs = make_sketch(2000, 256, 3, seed=4, num_blocks=2)
+        v = jnp.asarray(np.random.RandomState(1).randn(900), jnp.float32)
+        base = jnp.asarray(
+            np.random.RandomState(2).randn(*cs.table_shape), jnp.float32)
+        got = sketch_segments_accum(cs, base, [v], 613)
+        want = sketch_segment_accum(cs, base, v, 613)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_empty_group_and_bounds(self):
+        cs = make_sketch(1000, 128, 2, seed=3, num_blocks=1)
+        t = jnp.zeros(cs.table_shape, jnp.float32)
+        out = sketch_segments_accum(cs, t, [jnp.zeros(0), jnp.zeros(0)],
+                                    500)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(t))
+        with pytest.raises(AssertionError):
+            sketch_segments_accum(cs, t, [jnp.zeros(10)], 995)  # past d
+
+
+# ---- 3. tree level: sketch_grad_tree(groups=) == per-leaf ----------------
+
+def _tree(dtype=jnp.float32, seed=0):
+    r = np.random.RandomState(seed)
+    return {
+        "block": {"w": jnp.asarray(r.randn(13, 31), dtype),
+                  "b": jnp.asarray(r.randn(31), dtype)},
+        "head": [jnp.asarray(r.randn(31, 7), dtype),
+                 jnp.asarray(r.randn(1), dtype)],
+        "scalar": jnp.asarray(r.randn(), dtype),
+    }
+
+
+class TestGradTreeCoalesced:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                             ids=["f32", "bf16"])
+    def test_groups_equal_perleaf(self, dtype):
+        tree = _tree(dtype=dtype, seed=4)
+        flat, _ = ravel_pytree(tree)
+        d = int(flat.size)
+        segs = leaf_segments(tree)
+        cs = make_sketch(d, 128, 3, seed=11, num_blocks=1)
+        groups = coalesce_segments(segs, 4 * 128 * 4,
+                                   chunk_elems=cs.c_pad)
+        assert 1 < len(groups) < len(segs)
+        zero = jnp.zeros(cs.table_shape, jnp.float32)
+        got = sketch_grad_tree(cs, zero, tree, segs, groups=groups)
+        want = sketch_grad_tree(cs, zero, tree, segs)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        np.testing.assert_array_equal(
+            np.asarray(got),
+            np.asarray(sketch_vec(cs, flat)))
+
+    def test_per_leaf_scales_with_groups(self):
+        tree = _tree(seed=6)
+        flat, _ = ravel_pytree(tree)
+        d = int(flat.size)
+        segs = leaf_segments(tree)
+        scales = tuple(1.0 if i % 2 else 0.5 for i in range(len(segs)))
+        cs = make_sketch(d, 128, 3, seed=12, num_blocks=1)
+        groups = coalesce_segments(segs, 4 * 128 * 4,
+                                   chunk_elems=cs.c_pad)
+        zero = jnp.zeros(cs.table_shape, jnp.float32)
+        got = sketch_grad_tree(cs, zero, tree, segs, scales=scales,
+                               groups=groups)
+        want = sketch_grad_tree(cs, zero, tree, segs, scales=scales)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_groups_must_partition(self):
+        tree = _tree(seed=7)
+        segs = leaf_segments(tree)
+        d = segs[-1].offset + segs[-1].size
+        cs = make_sketch(d, 128, 3, seed=13, num_blocks=1)
+        groups = coalesce_segments(segs, 4 * 128 * 4,
+                                   chunk_elems=cs.c_pad)
+        assert len(groups) >= 2
+        zero = jnp.zeros(cs.table_shape, jnp.float32)
+        with pytest.raises(AssertionError, match="partition"):
+            sketch_grad_tree(cs, zero, tree, segs, groups=groups[:-1])
+
+
+# ---- 4./5./6. round level on the 8-device mesh ---------------------------
+
+# a budget that coalesces the MLP's 6 leaves (d=4141, c_pad=128, T=33)
+# into 2 groups — fewer launches than leaves, more than one group
+BUDGET = 32 * 128 * 4
+
+
+def _build(stream, coalesce, server_shard=False, fused=False,
+           budget=BUDGET):
+    """The tests/test_stream_sketch.py MLP round on the 8-device mesh,
+    with the coalesced client phase opt-in on top."""
+    mesh = _mesh()
+    rep = NamedSharding(mesh, P())
+    params = _mlp_params()
+    flat, unravel = ravel_pytree(params)
+    d = int(flat.size)
+
+    def ravel(tree):
+        return ravel_pytree(tree)[0]
+
+    wcfg = WorkerConfig(mode="sketch", error_type="virtual", k=5,
+                        num_workers=N)
+    scfg = ServerConfig(mode="sketch", error_type="virtual", k=5,
+                        grad_size=d, virtual_momentum=0.9,
+                        fused_epilogue=fused)
+    cs_geo = make_sketch(d, 16, 3, seed=0, num_blocks=1)
+    cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=d,
+                      server_shard=server_shard, stream_sketch=stream,
+                      sketch_coalesce=coalesce,
+                      sketch_coalesce_budget=budget)
+    steps = build_round_step(_mlp_loss, _mlp_loss, unravel, ravel, cfg,
+                             sketch=cs_geo, mesh=mesh)
+    ss = init_server_state(scfg, cs_geo)
+    ss = ss._replace(velocity=jax.device_put(ss.velocity, rep),
+                     error=jax.device_put(ss.error, rep))
+    ps = jax.device_put(steps.layout.chunk(flat), rep)
+    cstates = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, rep),
+        init_client_states(16, d, wcfg, init_weights=flat, sketch=cs_geo))
+    return steps, ps, ss, cstates, d
+
+
+def _plan(d=4141):
+    """The coalesce plan the BUDGET builds use (same inputs as
+    build_round_step's: the leaf offset map + the sketch's c_pad)."""
+    tpl = jax.eval_shape(_mlp_params)
+    segs = leaf_segments(tpl)
+    cs_geo = make_sketch(d, 16, 3, seed=0, num_blocks=1)
+    return segs, coalesce_segments(segs, BUDGET, chunk_elems=cs_geo.c_pad)
+
+
+class TestCoalesceRoundBitIdentity:
+    """Acceptance criterion: fp32 --sketch_coalesce trajectories are
+    bit-identical to the per-leaf --stream_sketch path's across both
+    server planes and both epilogues. No wd/microbatch caveat: the
+    coalesced fold replays the per-leaf add order exactly."""
+
+    @pytest.mark.parametrize("shard", [False, True],
+                             ids=["replicated", "server_shard"])
+    @pytest.mark.parametrize("fused", [False, True],
+                             ids=["composed", "fused_epilogue"])
+    def test_trajectory_bit_identical(self, shard, fused, monkeypatch):
+        if fused:
+            monkeypatch.setenv("COMMEFFICIENT_FUSED_EPILOGUE", "interpret")
+        a, ssa, csa = _run_rounds(*_build(True, False, shard, fused)[:4])
+        b, ssb, csb = _run_rounds(*_build(True, True, shard, fused)[:4])
+        for rnd, (x, y) in enumerate(zip(a, b)):
+            np.testing.assert_array_equal(
+                x, y,
+                err_msg=f"shard={shard} fused={fused} round {rnd} ps")
+        for name in ("velocity", "error"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ssa, name)),
+                np.asarray(getattr(ssb, name)), err_msg=name)
+
+    def test_coalesce_without_stream_runs_composed(self):
+        """--sketch_coalesce outside the streaming window must not
+        half-enable anything: the client phase is the composed one (scan
+        carry is d-sized), and the trajectory matches the composed
+        build's bit-for-bit."""
+        steps_c, ps_c, ss_c, cs_c, d = _build(False, True)
+        args = (ps_c, cs_c, {}, _batch(0), 0.1, jax.random.key(0))
+        carry = _max_scan_carry(steps_c.client_step, *args)
+        assert carry >= d, \
+            f"composed carry {carry} should be d-sized (d={d})"
+        a, _, _ = _run_rounds(*_build(False, False)[:4])
+        b, _, _ = _run_rounds(*_build(False, True)[:4])
+        for rnd, (x, y) in enumerate(zip(a, b)):
+            np.testing.assert_array_equal(x, y, err_msg=f"round {rnd}")
+
+
+# ---- structural assert: launch count == group count ----------------------
+
+def _count_accum_launches(fn, *args):
+    """Number of ``pallas_call`` eqns anywhere in the jaxpr — with
+    COMMEFFICIENT_PALLAS_SKETCH=interpret the streaming client phase's
+    only Pallas calls are the sketch-accumulate launches, so this IS the
+    client phase's kernel-launch count per microbatch."""
+    count = 0
+
+    def walk(jx):
+        nonlocal count
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                count += 1
+            for val in eqn.params.values():
+                for j in (val if isinstance(val, (list, tuple)) else [val]):
+                    if hasattr(j, "eqns"):
+                        walk(j)
+                    elif hasattr(j, "jaxpr") and hasattr(j.jaxpr, "eqns"):
+                        walk(j.jaxpr)
+
+    walk(jax.make_jaxpr(fn)(*args).jaxpr)
+    return count
+
+
+class TestCoalesceStructure:
+    """Acceptance criterion: the coalesced client phase launches exactly
+    ONE sketch-accumulate kernel per plan group — strictly fewer than the
+    per-leaf build's one-per-leaf, which is shown to trip the detector."""
+
+    def _launches(self, steps, ps, cstates):
+        return _count_accum_launches(
+            steps.client_step, ps, cstates, {}, _batch(0), 0.1,
+            jax.random.key(0))
+
+    def test_launches_equal_group_count(self, monkeypatch):
+        monkeypatch.setenv("COMMEFFICIENT_PALLAS_SKETCH", "interpret")
+        segs, groups = _plan()
+        n_leaves = sum(1 for s in segs if s.size)
+        assert 1 < len(groups) < n_leaves, \
+            "test layout must coalesce to fewer groups than leaves"
+
+        steps_p, ps_p, _, cs_p, _ = _build(True, False)
+        per_leaf = self._launches(steps_p, ps_p, cs_p)
+        assert per_leaf == n_leaves, \
+            f"per-leaf build launches {per_leaf} != leaf count {n_leaves}"
+
+        steps_c, ps_c, _, cs_c, _ = _build(True, True)
+        coalesced = self._launches(steps_c, ps_c, cs_c)
+        assert coalesced == len(groups), \
+            f"coalesced build launches {coalesced} != " \
+            f"group count {len(groups)}"
+        assert coalesced < per_leaf
+
+    def test_kill_switch_restores_per_leaf(self, monkeypatch):
+        """COMMEFFICIENT_SKETCH_COALESCE=0 must restore one launch per
+        leaf even with the flag on — structural evidence, not just equal
+        numbers."""
+        monkeypatch.setenv("COMMEFFICIENT_PALLAS_SKETCH", "interpret")
+        monkeypatch.setenv("COMMEFFICIENT_SKETCH_COALESCE", "0")
+        segs, groups = _plan()
+        n_leaves = sum(1 for s in segs if s.size)
+        steps, ps, _, cstates, _ = _build(True, True)
+        assert self._launches(steps, ps, cstates) == n_leaves > len(groups)
