@@ -151,7 +151,8 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
           server_shard: bool = False, fused_epilogue: bool = False,
           guards: bool = False, stream_sketch: bool = False,
           sketch_coalesce: bool = False,
-          telemetry: bool = False, collective_plan: str = ""):
+          telemetry: bool = False, collective_plan: str = "",
+          participation: float = 1.0, drop_frac: float = 0.0):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -249,14 +250,32 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
         client_ids = rng.zipf(1.5, num_workers) % num_clients
     else:
         client_ids = np.arange(num_workers) % num_clients
+    # partial-cohort round shape (--participation, the `straggler` leg /
+    # tpu_measure participation A/B): the first ceil(p*W) worker slots
+    # are live, then drop_frac of THOSE are zero-masked too (the injected
+    # drops). The round math's data-weighted mean makes the missing
+    # clients an exact reweighting (docs/fault_tolerance.md), so the leg
+    # measures the same round under the partial-participation mask shape.
+    # Guarded so the legacy legs draw no extra RNG and stay bit-stable.
+    wm = np.ones(num_workers, np.float32)
+    if participation < 1.0 or drop_frac > 0.0:
+        live = max(1, int(np.ceil(participation * num_workers)))
+        wm[live:] = 0.0
+        dropped = (rng.random_sample(num_workers) < drop_frac) & (wm > 0)
+        wm[dropped] = 0.0
+        if wm.sum() == 0:
+            wm[0] = 1.0  # a zero-participant round has no defined mean
+        _log(f"participation mask: {int(wm.sum())}/{num_workers} live "
+             f"slots (target {live}, {int(dropped.sum())} dropped)")
     batch = {
         "inputs": jnp.asarray(
             rng.randn(num_workers, LOCAL_BS, 32, 32, 3), jnp.float32),
         "targets": jnp.asarray(
             rng.randint(0, num_classes, (num_workers, LOCAL_BS))),
-        "mask": jnp.ones((num_workers, LOCAL_BS), jnp.float32),
+        "mask": jnp.asarray(
+            np.ones((num_workers, LOCAL_BS), np.float32) * wm[:, None]),
         "client_ids": jnp.asarray(client_ids, jnp.int32),
-        "worker_mask": jnp.ones(num_workers, jnp.float32),
+        "worker_mask": jnp.asarray(wm),
     }
     return steps, flat, server_state, client_states, batch
 
@@ -577,6 +596,8 @@ class CfgLeg(NamedTuple):
     sketch_coalesce: bool = False
     telemetry: bool = False
     collective_plan: str = ""
+    participation: float = 1.0
+    drop_frac: float = 0.0
 
 
 _CFG_LEGS = {
@@ -671,6 +692,20 @@ _CFG_LEGS = {
                        "sketch 5x500k k=50k, full-compressed wire legs "
                        "incl. quantized downlink + dres carry)",
                        server_shard=True, collective_plan="int8"),
+    # the headline sketch leg at a PARTIAL cohort (--participation 0.5
+    # with 10% injected client drops — the straggler/dropout regime of
+    # docs/fault_tolerance.md §client faults); same config-3 baseline
+    # anchor so the partial-vs-full delta reads straight off this leg vs
+    # the headline. The masked slots still run their (zeroed) compute —
+    # XLA's static shapes don't shrink with the cohort — so the leg pins
+    # that a partial cohort costs no MORE than full participation; the
+    # three-way 1.0/0.5/0.1 sweep is `tpu_measure.py participation`.
+    "straggler": CfgLeg("sketch", 8, "BASELINE",
+                        "8-worker sketched rounds/sec/chip at "
+                        "--participation 0.5 with 10% injected client "
+                        "drops (ResNet9, sketch 5x500k k=50k, "
+                        "partial-cohort round)",
+                        participation=0.5, drop_frac=0.1),
 }
 
 
@@ -698,7 +733,8 @@ def run_config_measurement(name: str) -> None:
         fused_epilogue=leg.fused_epilogue, guards=leg.guards,
         stream_sketch=leg.stream_sketch,
         sketch_coalesce=leg.sketch_coalesce, telemetry=leg.telemetry,
-        collective_plan=leg.collective_plan)
+        collective_plan=leg.collective_plan,
+        participation=leg.participation, drop_frac=leg.drop_frac)
     if K > 1:
         inner = steps.train_step
 
@@ -823,6 +859,8 @@ _EXTRA_LEGS = {
                   "telemetry_rounds_per_sec"),
     "downlink": (["--run-cfg", "downlink"], "BENCH_C12_TIMEOUT", 900,
                  "downlink_rounds_per_sec"),
+    "straggler": (["--run-cfg", "straggler"], "BENCH_C12_TIMEOUT", 900,
+                  "straggler_rounds_per_sec"),
 }
 
 

@@ -341,6 +341,47 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--client_dropout", type=float, default=0.0,
                         help="Per-round probability that a sampled client "
                              "drops out (0 disables).")
+    # Straggler- and dropout-tolerant participation layer
+    # (federated/participation.py, docs/fault_tolerance.md §client
+    # faults): partial per-round cohorts through FedSampler, seeded
+    # client-level drop/slow/corrupt fault injection with graceful
+    # degradation (requeue / staleness-weighted late landing /
+    # client-level quarantine). Full participation with no faults is
+    # bit-identical to the pre-participation trajectories.
+    parser.add_argument("--participation", type=str, default="",
+                        help="Per-round cohort as a fraction of "
+                             "--num_workers in (0,1] or an absolute client "
+                             "count; unused worker slots are zero-masked "
+                             "and the data-weighted round mean makes the "
+                             "missing clients an exact reweighting. Empty "
+                             "= full participation (bit-identical legacy "
+                             "path).")
+    parser.add_argument("--participation_sampling",
+                        choices=["uniform", "weighted", "stratified"],
+                        default="uniform",
+                        help="Cohort draw for --participation: uniform "
+                             "(legacy np.random.choice), weighted "
+                             "(probability ~ remaining items), or "
+                             "stratified (one pick per remaining-size "
+                             "stratum).")
+    parser.add_argument("--inject_client_fault", type=str, default="",
+                        help="Debug: seeded per-client fault schedule "
+                             "'drop=P,slow=P,corrupt=P,delay=N,seed=N,"
+                             "quarantine_after=N' — per round each live "
+                             "slot independently drops (items requeued "
+                             "with bounded retries), straggles (transmit "
+                             "lands delay rounds late with the staleness "
+                             "decay), or is corrupted (masked out BEFORE "
+                             "the round sum — the guard never trips; "
+                             "repeat offenders are client-quarantined).")
+    parser.add_argument("--staleness_decay", type=float, default=0.5,
+                        help="Late-landing weight w(delta) = decay**delta "
+                             "for straggler cohorts landing delta rounds "
+                             "late (1.0 = undecayed).")
+    parser.add_argument("--client_retry_limit", type=int, default=3,
+                        help="Max requeues per client per epoch for "
+                             "dropped-client data before the drop is "
+                             "abandoned (participation layer).")
     # Zero-sync telemetry plane (docs/observability.md): on-device round
     # metrics computed inside the jitted server phase (norms of the
     # transmit / update / error-feedback carries, resolved top-k
@@ -441,6 +482,37 @@ def validate_args(args):
             "would not match the rounds actually applied")
     assert args.max_guard_trips >= 1, "--max_guard_trips must be >= 1"
     assert args.snapshot_every >= 0, "--snapshot_every must be >= 0"
+    # participation layer (federated/participation.py): fail fast on a
+    # malformed spec — not rounds into a run
+    assert 0.0 < args.staleness_decay <= 1.0, (
+        f"--staleness_decay {args.staleness_decay} must be in (0, 1]")
+    assert args.client_retry_limit >= 0, (
+        "--client_retry_limit must be >= 0")
+    if getattr(args, "participation", ""):
+        from commefficient_tpu.federated.participation import (
+            parse_participation,
+        )
+
+        parse_participation(args.participation, args.num_workers)
+    fault_spec = (getattr(args, "inject_client_fault", "") or "").strip()
+    if fault_spec:
+        from commefficient_tpu.federated.participation import (
+            parse_client_fault,
+        )
+
+        sched = parse_client_fault(fault_spec)
+        assert args.train_dataloader_workers == 0, (
+            "--inject_client_fault needs --train_dataloader_workers 0: "
+            "dropped clients requeue into the live sampler epoch, and a "
+            "prefetch thread would have drawn rounds past the requeue "
+            "point (same constraint as --checkpoint_every_rounds)")
+        if sched.slow and (args.local_momentum > 0
+                           or args.error_type == "local"
+                           or args.do_topk_down):
+            print("NOTE: straggler late landings fold the TRANSMIT only — "
+                  "per-client velocity/error/stale-weight state does not "
+                  "advance for a straggler cohort "
+                  "(docs/fault_tolerance.md)")
     if args.inject_fault:
         parse_inject_fault(args.inject_fault)  # fail fast on a bad spec
         if not args.guards:

@@ -11,13 +11,32 @@ exhausted.
 the TPU loader can build static-shaped client-major batches without
 re-deriving the client split.
 
+Participation layer (federated/participation.py, docs/fault_tolerance.md):
+
+- ``participation`` (``--participation``) caps the per-round cohort at a
+  SUBSET of the worker slots; the loader pads the rest with zero masks and
+  the round math's data-weighted mean makes the missing clients an exact
+  reweighting. ``sampling`` picks the cohort draw: ``uniform`` (the legacy
+  ``np.random.choice`` — bit-identical path when the cohort is full),
+  ``weighted`` (probability ∝ remaining items, favoring data-heavy
+  clients), or ``stratified`` (alive clients split into remaining-size
+  strata, one uniform pick per stratum — guarantees coverage across the
+  size distribution).
+- ``requeue`` returns a DROPPED client's just-consumed items to the epoch
+  pool (cursor rollback — the same permutation positions re-serve when the
+  client is re-sampled), bounded by ``retry_limit`` requeues per client
+  per epoch, after which the drop is abandoned (items stay consumed).
+- ``quarantine`` excludes a client from all future sampling this run (the
+  corrupt-client escalation of the client-fault ladder).
+
 Preemption-safe round-granular resume (docs/fault_tolerance.md):
 ``get_state``/``set_state`` capture and restore the active epoch's position
-(the within-client permutation and per-client cursors). Together with the
-global numpy RNG state — which drives both the per-round
-``np.random.choice`` and the transform augmentation draws, and is captured
-by ``save_run_state`` — a restored sampler replays the REMAINDER of a
-half-finished epoch exactly. The per-round cursor advance happens before
+(the within-client permutation and per-client cursors) PLUS the
+participation bookkeeping (retry counts, quarantine set). Together with the
+global numpy RNG state — which drives both the per-round cohort draw and
+the transform augmentation draws, and is captured by ``save_run_state`` —
+a restored sampler replays the REMAINDER of a half-finished epoch exactly,
+including any requeued drops. The per-round cursor advance happens before
 the ``yield`` so every yielded batch is already reflected in
 ``get_state()`` at the moment the training loop holds it.
 """
@@ -31,14 +50,46 @@ __all__ = ["FedSampler"]
 
 class FedSampler:
     def __init__(self, dataset, num_workers, local_batch_size,
-                 shuffle_clients=True):
+                 shuffle_clients=True, participation=None,
+                 sampling="uniform", retry_limit=3):
         self.dataset = dataset
         self.num_workers = num_workers
         self.local_batch_size = local_batch_size
         self.shuffle_clients = shuffle_clients
+        # participation knobs are read PER ROUND (not captured at iterator
+        # creation) so attach_participation can configure a sampler the
+        # loader already built
+        self.participation = participation  # cohort target or None (= all)
+        self.sampling = sampling            # uniform | weighted | stratified
+        self.retry_limit = int(retry_limit)
+        n = int(dataset.num_clients)
+        self._retry = np.zeros(n, np.int64)       # requeues this epoch
+        self._quarantined = np.zeros(n, bool)      # excluded for the run
+        self.requeues = 0
+        self.abandoned = 0
         self._permuted = None   # active epoch's within-client permutation
         self._cursor = None     # active epoch's per-client consumption
         self._pending_state = None
+
+    def _draw_cohort(self, alive, n, remaining):
+        """One round's cohort of ``n`` clients from the ``alive`` set.
+        The uniform branch is byte-for-byte the legacy draw (same call,
+        same RNG consumption), so full participation stays bit-identical
+        to pre-participation trajectories; weighted/stratified only
+        diverge when they actually have a choice (n < len(alive))."""
+        if self.sampling != "uniform" and n < len(alive):
+            rem = remaining.astype(np.float64)
+            if self.sampling == "weighted":
+                return np.random.choice(alive, n, replace=False,
+                                        p=rem / rem.sum())
+            # stratified: alive clients ordered by remaining items (stable
+            # — ties broken by client id), split into n strata, one
+            # uniform pick per stratum
+            order = alive[np.argsort(rem, kind="stable")]
+            strata = np.array_split(order, n)
+            return np.asarray(
+                [s[np.random.randint(len(s))] for s in strata], np.int64)
+        return np.random.choice(alive, n, replace=False)
 
     def _gen(self, structured):
         data_per_client = np.asarray(self.dataset.data_per_client)
@@ -55,14 +106,21 @@ class FedSampler:
                 for s, n in zip(cumsum, data_per_client)
             ]) if len(data_per_client) else np.array([], dtype=int)
             cursor = np.zeros(self.dataset.num_clients, dtype=np.int64)
+            # retry budgets are per-epoch (they bound requeues of THIS
+            # epoch's items); quarantine persists for the run
+            self._retry[:] = 0
         self._permuted, self._cursor = permuted, cursor
 
         while True:
-            alive = np.where(cursor < data_per_client)[0]
+            alive = np.where((cursor < data_per_client)
+                             & ~self._quarantined)[0]
             if len(alive) == 0:
                 return
-            n = min(self.num_workers, len(alive))
-            workers = np.random.choice(alive, n, replace=False)
+            target = (self.num_workers if self.participation is None
+                      else min(int(self.participation), self.num_workers))
+            n = min(target, len(alive))
+            workers = self._draw_cohort(
+                alive, n, data_per_client[alive] - cursor[alive])
             remaining = data_per_client[workers] - cursor[workers]
             if self.local_batch_size == -1:
                 sizes = remaining
@@ -79,20 +137,77 @@ class FedSampler:
             else:
                 yield np.hstack(per_client)
 
+    # -- participation bookkeeping (federated/participation.py) ----------
+
+    def requeue(self, client_ids, counts):
+        """Return dropped clients' just-consumed items to the epoch pool:
+        each client's cursor rolls back by its batch size, so the SAME
+        permutation positions re-serve when the client is re-sampled
+        later this epoch. Bounded: a client past ``retry_limit`` requeues
+        this epoch is ABANDONED instead (its items stay consumed — a
+        permanently failing client must not stall the epoch forever).
+        Returns ``(requeued, abandoned, attempts)`` where ``attempts``
+        lists each requeued client's retry ordinal (the retry ladder).
+
+        Mutates the live epoch's cursor in place — callers must requeue
+        before drawing the next round (``--train_dataloader_workers 0``,
+        enforced by config.validate_args for fault injection)."""
+        requeued = abandoned = 0
+        attempts = []
+        if self._cursor is None:
+            return 0, 0, []
+        for c, k in zip(np.asarray(client_ids), np.asarray(counts)):
+            c, k = int(c), int(round(float(k)))
+            if k <= 0:
+                continue
+            if self._retry[c] >= self.retry_limit:
+                abandoned += 1
+                self.abandoned += 1
+                continue
+            self._retry[c] += 1
+            attempts.append(int(self._retry[c]))
+            self._cursor[c] = max(int(self._cursor[c]) - k, 0)
+            requeued += 1
+            self.requeues += 1
+        return requeued, abandoned, attempts
+
+    def quarantine(self, client_id) -> None:
+        """Client-level quarantine (the corrupt-fault escalation): the
+        client leaves the alive set for the rest of the run — one repeat
+        offender is contained without tripping the round guard."""
+        self._quarantined[int(client_id)] = True
+
+    @property
+    def quarantined_clients(self) -> np.ndarray:
+        return np.where(self._quarantined)[0]
+
+    # -- checkpoint seam ---------------------------------------------------
+
     def get_state(self):
         """Position of the active epoch (None before the first round) —
         everything a mid-epoch ``set_state`` needs besides the global numpy
-        RNG state."""
+        RNG state. Includes the participation layer's retry/quarantine
+        bookkeeping so a fault-injected run resumes bit-exactly."""
         if self._permuted is None:
             return None
         return {"permuted": self._permuted.copy(),
-                "cursor": self._cursor.copy()}
+                "cursor": self._cursor.copy(),
+                "retry": self._retry.copy(),
+                "quarantined": self._quarantined.copy()}
 
     def set_state(self, state) -> None:
         """Arm a restored mid-epoch position: the NEXT ``__iter__`` /
-        ``iter_structured`` continues that epoch from the saved cursors."""
+        ``iter_structured`` continues that epoch from the saved cursors.
+        Retry/quarantine state restores immediately (it is not a
+        generator position); checkpoints from before the participation
+        layer simply lack the keys and keep the zero init."""
         self._pending_state = {"permuted": np.asarray(state["permuted"]),
                                "cursor": np.asarray(state["cursor"])}
+        if "retry" in state:
+            self._retry = np.asarray(state["retry"], np.int64).copy()
+        if "quarantined" in state:
+            self._quarantined = np.asarray(state["quarantined"],
+                                           bool).copy()
 
     def __iter__(self):
         return self._gen(structured=False)

@@ -105,6 +105,12 @@ class RoundHandle(NamedTuple):
     # practicality survey flags is free to surface): rounds since each
     # participating client last joined a round. None in regime (a).
     staleness: Optional[np.ndarray] = None
+    # participation-layer bookkeeping of this round (host dict, None
+    # without --participation/--inject_client_fault): cohort target,
+    # drop/slow/corrupt counts, requeue/retry ladder, late landings —
+    # merged into the telemetry `cohort` span at drain
+    # (federated/participation.py, docs/fault_tolerance.md).
+    cohort: Optional[dict] = None
 
 
 @jax.jit
@@ -405,6 +411,13 @@ class FedModel:
         # would make drop patterns depend on queue timing. Captured and
         # restored by the run-state checkpoint (resume-safe).
         self._drop_rng = np.random.RandomState(args.seed + 2)
+        # Client-participation layer (--participation /
+        # --inject_client_fault, federated/participation.py): attached by
+        # the entrypoints via attach_participation. None = full
+        # participation, no client faults — begin_round then takes the
+        # untouched legacy path (bit-identical trajectories, pinned in
+        # tests/test_participation.py).
+        self._participation = None
 
         # ---- fault-tolerance bookkeeping (docs/fault_tolerance.md) ----
         # guard verdict of the most recent server phase, waiting for
@@ -636,7 +649,27 @@ class FedModel:
             mask = np.asarray(batch["mask"])
             batch["mask"] = (mask * wmask.reshape(
                 wmask.shape + (1,) * (mask.ndim - 1))).astype(mask.dtype)
-        participating = np.unique(ids[wmask > 0])
+        # Client-participation layer (--participation /
+        # --inject_client_fault, federated/participation.py,
+        # docs/fault_tolerance.md): seeded per-slot drop/slow/corrupt
+        # classification splits the batch into the on-time cohort and an
+        # optional straggler (slow) cohort; dropped items were already
+        # requeued into the sampler pool inside apply_faults. All host
+        # data — no device work, no syncs.
+        part = self._participation
+        round_no = self._rounds_dispatched
+        late_batch = cohort_info = None
+        if part is not None:
+            batch, late_batch, cohort_info = part.apply_faults(batch,
+                                                               round_no)
+            wmask = np.asarray(batch["worker_mask"])
+        live = wmask > 0
+        if late_batch is not None:
+            # stragglers DO participate (their contribution lands late,
+            # decayed) — they download this round's model and upload a
+            # transmit, so the byte/staleness accounting includes them
+            live = live | (np.asarray(late_batch["worker_mask"]) > 0)
+        participating = np.unique(ids[live])
 
         download_dev, upload = self._account_bytes_deferred(participating)
 
@@ -652,11 +685,38 @@ class FedModel:
             jbatch["client_ids"] = jnp.arange(
                 int(jbatch["client_ids"].shape[0]), dtype=jnp.int32)
             states_in = self._stream_round.proxy
+        pre_model_state = self._model_state
         ctx, self._model_state, metrics = self.steps.client_step(
             self.ps_weights, states_in, self._model_state, jbatch,
             lr, self._next_rng())
-        round_no = self._rounds_dispatched
         self._rounds_dispatched += 1
+        if late_batch is not None:
+            # Straggler dispatch (staleness-weighted late landing,
+            # docs/fault_tolerance.md): the cohort's client phase runs NOW,
+            # against THIS round's weights (true staleness — the cohort
+            # sampled w_t), through the SAME jitted client_step (identical
+            # shapes: one jit cache entry). Its un-normalized transmit SUM
+            # stays a device array parked in the controller — riding the
+            # engine's in-flight window — until it folds into round
+            # t+delay's aggregate. Dispatch only; zero host fetches. The
+            # late call's model_state and client-state rows are discarded:
+            # a late landing folds the TRANSMIT only (module docstring).
+            from commefficient_tpu.federated.participation import (
+                _transmit_sum,
+            )
+
+            late_wmask = np.asarray(late_batch["worker_mask"])
+            late_count = float(max(np.asarray(late_batch["mask"]).sum(),
+                                   1.0))
+            jlate = {k: jnp.asarray(v) for k, v in late_batch.items()}
+            late_ctx, _, _ = self.steps.client_step(
+                self.ps_weights, states_in, pre_model_state, jlate,
+                lr, self._next_rng())
+            late_sum = (late_ctx.gradient if self._n_shard else
+                        _transmit_sum(late_ctx.gradient,
+                                      np.float32(late_count)))
+            part.hold(late_sum, late_count,
+                      np.unique(ids[late_wmask > 0]), round_no)
         poison = self._inject.get(round_no)
         if poison is not None:
             # --inject_fault debug hook (docs/fault_tolerance.md): overwrite
@@ -668,12 +728,26 @@ class FedModel:
             ctx = ctx._replace(gradient=g.at[(0,) * g.ndim].set(poison))
             print(f"inject_fault: poisoned round {round_no} transmit "
                   f"with {poison}")
+        if part is not None:
+            # fold every DUE straggler cohort into this round's aggregate
+            # with the staleness decay w(Δ) — device arithmetic on arrays
+            # already in flight (participation.fold_due; the count comes
+            # from the host-side mask, so no fetch)
+            ctx, landed = part.fold_due(
+                ctx, round_no, sharded=bool(self._n_shard),
+                count=float(max(np.asarray(batch["mask"]).sum(), 1.0)))
+            if cohort_info is not None:
+                if landed:
+                    cohort_info["landed"] = landed
+                if part.pending:
+                    cohort_info["pending"] = len(part.pending)
         self._round_ctx = ctx
         staleness, self._last_staleness = self._last_staleness, None
         return RoundHandle(metrics=metrics, valid=wmask > 0,
                            participating=participating,
                            download=download_dev, upload=upload,
-                           round_no=round_no, staleness=staleness)
+                           round_no=round_no, staleness=staleness,
+                           cohort=cohort_info or None)
 
     def finish_round(self, handle: RoundHandle):
         """Materialize a dispatched round's results — the ONE blocking host
@@ -711,6 +785,12 @@ class FedModel:
                 cohort["staleness_mean"] = float(
                     np.mean(handle.staleness))
                 cohort["staleness_max"] = int(np.max(handle.staleness))
+            if handle.cohort:
+                # participation-layer bookkeeping captured at dispatch
+                # (cohort target, drop/slow/corrupt counts, retry ladder,
+                # late landings — federated/participation.py); obs_report
+                # renders the participation section from these fields
+                cohort.update(handle.cohort)
             self.telemetry.on_metrics(
                 handle.round_no,
                 {k: float(v) for k, v in zip(METRIC_FIELDS, vals)},

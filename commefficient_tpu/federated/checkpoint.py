@@ -208,6 +208,18 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
         arrays["drop_rng/meta"] = np.asarray(
             [d_pos, d_gauss], np.int64)
         arrays["drop_rng/cached"] = np.asarray([d_cached], np.float64)
+    # participation layer (--participation / --inject_client_fault,
+    # federated/participation.py): the fault RNG, the pending straggler
+    # buffer (each cohort's held device transmit sum — table-/d-sized,
+    # fetched here where syncs are allowed), and the counters. A seeded
+    # fault-injected run SIGKILLed mid-epoch resumes bit-exactly.
+    part = getattr(fm, "_participation", None)
+    if part is not None:
+        p_arrays, p_meta = part.state_payload()
+        arrays.update({"part/" + k: v for k, v in p_arrays.items()})
+        meta_participation = p_meta
+    else:
+        meta_participation = None
     if fm._simple_download:
         arrays["acct/updated_since_init"] = canon(fm._updated_since_init)
     else:
@@ -227,10 +239,18 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
                    "has_gauss": int(np_has_gauss),
                    "cached": float(np_cached)},
         "round_idx": int(getattr(fm, "_round_idx", 0)),
+        # the GLOBAL dispatch counter (RoundHandle.round_no): the one
+        # round key telemetry, heartbeats, AND the participation layer's
+        # straggler due-rounds share — a resumed run must continue the
+        # same timeline or a pending late cohort would land at the wrong
+        # delay (or never)
+        "rounds_dispatched": int(getattr(fm, "_rounds_dispatched", 0)),
         # key-data layout differs per PRNG impl (--rng_impl); the restore
         # must rewrap with the same one
         "rng_impl": getattr(fm, "_rng_impl", "threefry2x32"),
     }
+    if meta_participation is not None:
+        meta["participation"] = meta_participation
     if mid_epoch is not None:
         sampler = mid_epoch.get("sampler")
         assert sampler is not None, (
@@ -239,6 +259,16 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
         arrays["sampler/permuted"] = np.asarray(sampler["permuted"],
                                                 np.int64)
         arrays["sampler/cursor"] = np.asarray(sampler["cursor"], np.int64)
+        # participation bookkeeping rides the existing sampler seam
+        # (FedSampler.get_state): per-client retry counts + the
+        # client-level quarantine set. Absent in pre-participation
+        # checkpoints — the restore treats them as optional.
+        if "retry" in sampler:
+            arrays["sampler/retry"] = np.asarray(sampler["retry"],
+                                                 np.int64)
+        if "quarantined" in sampler:
+            arrays["sampler/quarantined"] = np.asarray(
+                sampler["quarantined"], bool)
         extras = mid_epoch.get("extras") or {}
         for name, val in extras.items():
             arrays["mid/" + name] = np.asarray(val)
@@ -406,10 +436,16 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler,
         _verify_checksum(flat, meta, path)
     mid = None
     if meta.get("mid_epoch") is not None:
+        sampler_state = {"permuted": flat.pop("sampler/permuted"),
+                         "cursor": flat.pop("sampler/cursor")}
+        for key in ("retry", "quarantined"):
+            # participation bookkeeping (optional — absent in
+            # pre-participation checkpoints)
+            if "sampler/" + key in flat:
+                sampler_state[key] = flat.pop("sampler/" + key)
         mid = {
             "rounds_done": int(meta["mid_epoch"]["rounds_done"]),
-            "sampler": {"permuted": flat.pop("sampler/permuted"),
-                        "cursor": flat.pop("sampler/cursor")},
+            "sampler": sampler_state,
             "extras": {name: flat.pop("mid/" + name)
                        for name in meta["mid_epoch"]["extras"]},
         }
@@ -536,6 +572,32 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler,
         fm._drop_rng.set_state(("MT19937", flat["drop_rng/keys"],
                                 d_pos, d_gauss,
                                 float(flat["drop_rng/cached"][0])))
+    # participation layer: fault RNG + pending straggler buffer + counters
+    # (federated/participation.py). A checkpoint/run mismatch warns and
+    # starts the layer fresh instead of failing — like the EF carries, a
+    # fault schedule restarts safely from its seed.
+    part = getattr(fm, "_participation", None)
+    part_flat = {k[len("part/"):]: flat.pop(k) for k in list(flat)
+                 if k.startswith("part/")}
+    if meta.get("participation") is not None:
+        if part is not None:
+            part.restore_state(
+                part_flat, meta["participation"],
+                as_device=lambda a: place(jnp.asarray(a)))
+        else:
+            import warnings
+
+            warnings.warn(
+                "checkpoint carries participation/fault-injection state "
+                "but this run has no participation layer attached; "
+                "ignoring it")
+    elif part is not None and part.schedule is not None:
+        import warnings
+
+        warnings.warn(
+            "this run injects client faults but the checkpoint predates "
+            "the participation layer; the fault schedule restarts from "
+            "its seed")
     if fm._simple_download:
         fm._updated_since_init = resident(flat["acct/updated_since_init"])
     else:
@@ -546,6 +608,27 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler,
         fm._prev_ps = resident(flat["acct/prev_ps"])
     else:  # pre-fault-tolerance checkpoint: accept the one-round undercount
         fm._prev_ps = fm.ps_weights
+    if "rounds_dispatched" in meta:
+        # continue the global round_no timeline (telemetry round events,
+        # heartbeats, and straggler due-rounds all key on it); absent in
+        # pre-participation checkpoints, which restart the counter at 0
+        # as they always did
+        fm._rounds_dispatched = int(meta["rounds_dispatched"])
+        inject = getattr(fm, "_inject", None)
+        if inject and fm._rounds_dispatched > 0:
+            # --inject_fault rounds are keyed on this now-GLOBAL counter:
+            # a resumed run no longer restarts it at 0, so entries below
+            # the restored index will never fire — say so instead of
+            # letting a guard drill pass vacuously
+            stale = sorted(r for r in inject if r < fm._rounds_dispatched)
+            import warnings
+
+            warnings.warn(
+                "--inject_fault rounds are GLOBAL dispatch indices and "
+                f"this resume continues the timeline at round "
+                f"{fm._rounds_dispatched}"
+                + (f"; entries {stale} are already in the past and will "
+                   "never fire" if stale else ""))
 
     lr_scheduler._step_count = meta["lr_step_count"]
     lr_scheduler.optimizer.set_lr_factor(

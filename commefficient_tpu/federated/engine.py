@@ -171,6 +171,16 @@ class PipelinedRoundEngine:
                     ms=round((time.monotonic() - t0) * 1e3, 3))
         return results
 
+    def close(self) -> List[RoundResult]:
+        """Final drain (the docstring's ``close()``): materialize every
+        in-flight round and return the results. A convenience alias of
+        ``drain()`` for callers that drive the engine to completion —
+        NOTE it does NOT expire pending straggler cohorts
+        (federated/participation.py): stragglers may legally land in a
+        later epoch's engine instance, so end-of-run expiry belongs to
+        the entrypoints, which own the run lifetime."""
+        return self.drain()
+
     @property
     def pending(self) -> int:
         return len(self._pending)

@@ -1,0 +1,572 @@
+"""Straggler- and dropout-tolerant client participation.
+
+The round engine is pipelined, sharded, fused, guarded, and resumable
+(PRs 1-9), but until this module every sampled client participated, finished
+on time, and never dropped — exactly the assumption the FL practicality
+survey (arXiv:2405.20431) says real federations break first, in the Konečný
+setting (arXiv:1610.05492) this repo reproduces. This layer makes rounds
+correct and deterministic under partial, late, and failed client
+contributions, with three strictly separated mechanisms:
+
+1. **Partial participation** (``--participation <frac|count>``): the
+   FedSampler draws a per-round cohort SUBSET (uniform, ``weighted`` by
+   remaining data, or ``stratified`` over remaining-data strata —
+   ``--participation_sampling``); the loader pads the unused worker slots
+   with zero masks. No server-side correction is needed because the round
+   aggregate is the data-weighted mean Σᵢ maskᵢ·transmitᵢ / Σᵢ maskᵢ·countᵢ
+   — sketches and dense reduces are linear, so a missing client is an
+   EXACT reweighting by construction, not an approximation. The
+   full-participation path is bit-identical to the pre-participation code
+   (same sampler branch, same RNG consumption; pinned in
+   tests/test_participation.py across replicated/``--server_shard`` ×
+   composed/``--fused_epilogue``).
+
+2. **Client-level fault injection** (``--inject_client_fault``): a seeded
+   per-round schedule classifies each live worker slot as healthy / drop /
+   slow / corrupt (one uniform draw per slot from a dedicated
+   ``RandomState`` — deterministic in the schedule seed, independent of
+   loader threading, captured by checkpoints). The graceful-degradation
+   ladder (docs/fault_tolerance.md):
+
+   - **drop** — the slot is masked out of the round and the client's
+     just-consumed items RETURN to the sampler pool
+     (``FedSampler.requeue``: cursor rollback, bounded by
+     ``--client_retry_limit`` per epoch, then abandoned);
+   - **slow** — a straggler: the slot is masked out of round t's
+     aggregate, but its client phase still runs at round t against w_t
+     (true staleness — the cohort sampled those weights) and the
+     contribution is HELD ON DEVICE, riding the pipelined engine's
+     in-flight slot, until it folds into round t+Δ (see 3);
+   - **corrupt** — the contribution is masked out of the within-round sum
+     BEFORE it can reach the server phase, so one bad client never trips
+     the round guard and never quarantines the whole round
+     (contrast ``--inject_fault``, which poisons the aggregated transmit
+     itself). Corrupt data does NOT return to the pool; a client caught
+     corrupt ``quarantine_after`` times is quarantined at CLIENT
+     granularity (``FedSampler.quarantine`` — excluded from all future
+     sampling this run).
+
+3. **Staleness-weighted late landing**: a straggler cohort dispatched at
+   round t folds into round t' = t+Δ's aggregate with weight
+   w(Δ) = ``--staleness_decay`` ** Δ, as a weighted data mean — both the
+   transmit SUM and the datum count are scaled by w(Δ), so
+
+       g(t') = (S_ontime + w·S_late) / (C_ontime + w·C_late).
+
+   On the replicated plane the client phase emits the already-normalized
+   mean, so the fold un-normalizes first (``_transmit_sum``); on the
+   ``--server_shard`` plane the raw per-shard sums + count ride
+   ``RoundContext`` unreduced and the fold is a plain scaled add. Either
+   way the fold is device arithmetic on arrays already in flight — ZERO
+   blocking host fetches (the strict ``host_sync_monitor`` audit covers
+   participation + late landing, tests/test_participation.py), and the
+   landed value is pinned against a hand-computed reweighting.
+
+Per-client retry/staleness state lives in ``FedSampler`` (the existing
+``get_state``/``set_state`` checkpoint seam); the controller's fault RNG,
+pending straggler buffer, and counters ride ``save_run_state``/
+``load_run_state`` (``part/*`` keys), so a seeded fault-injected run
+SIGKILLed mid-epoch resumes bit-exactly with ``--resume auto``.
+
+Limitations (documented in docs/fault_tolerance.md): a straggler's late
+landing folds the TRANSMIT only — per-client velocity/error/stale-weight
+state does not advance for the straggler cohort (their slots are masked at
+dispatch, so the scatter leaves their rows at pre-round values); and the
+layer is incompatible with the host-offload row streamer (the late
+dispatch would need a second gather mid-round).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "SAMPLING_CHOICES",
+    "FaultSchedule",
+    "LateCohort",
+    "ParticipationController",
+    "attach_participation",
+    "parse_client_fault",
+    "parse_participation",
+    "staleness_weight",
+]
+
+SAMPLING_CHOICES = ("uniform", "weighted", "stratified")
+
+
+def parse_participation(spec, num_workers: int) -> Optional[int]:
+    """``--participation`` spec → per-round cohort target (clients).
+
+    A value in (0, 1] is a FRACTION of ``--num_workers`` (ceil, min 1);
+    a value > 1 must be an integral COUNT ≤ ``--num_workers``. Empty/None
+    means full participation (returns None — the sampler's legacy path,
+    structurally bit-identical to pre-participation code). A malformed
+    spec fails here at parse time, not rounds into a run.
+    """
+    if spec in (None, ""):
+        return None
+    s = str(spec).strip()
+    try:
+        val = float(s)
+    except ValueError:
+        raise ValueError(
+            f"--participation: {spec!r} is not a fraction in (0, 1] or a "
+            f"client count") from None
+    if val <= 0:
+        raise ValueError(f"--participation: {spec!r} must be > 0")
+    if val <= 1.0:
+        return max(1, int(math.ceil(val * num_workers)))
+    if val != int(val):
+        raise ValueError(
+            f"--participation: counts must be integral (got {spec!r}); "
+            f"use a fraction in (0, 1] for proportional cohorts")
+    n = int(val)
+    if n > num_workers:
+        raise ValueError(
+            f"--participation: count {n} exceeds --num_workers "
+            f"{num_workers} (the cohort is drawn from the round's worker "
+            f"slots)")
+    return n
+
+
+@dataclass(frozen=True)
+class FaultSchedule:
+    """Seeded per-client fault schedule (``--inject_client_fault``).
+
+    Each live worker slot independently draws one uniform per round;
+    the thresholds partition [0, 1): u < drop → drop;
+    u < drop+slow → slow; u < drop+slow+corrupt → corrupt; else healthy.
+    ``delay`` is the straggler landing delay Δ in rounds;
+    ``quarantine_after`` the per-client corrupt-event count that triggers
+    client-level quarantine. ``seed`` makes the whole schedule — and
+    therefore the injected run's trajectory — deterministic under rerun.
+    """
+
+    drop: float = 0.0
+    slow: float = 0.0
+    corrupt: float = 0.0
+    delay: int = 2
+    seed: int = 0
+    quarantine_after: int = 3
+
+    @property
+    def active(self) -> bool:
+        return bool(self.drop or self.slow or self.corrupt)
+
+    def spec(self) -> str:
+        return (f"drop={self.drop:g},slow={self.slow:g},"
+                f"corrupt={self.corrupt:g},delay={self.delay},"
+                f"seed={self.seed},quarantine_after={self.quarantine_after}")
+
+
+def parse_client_fault(spec: str) -> FaultSchedule:
+    """``--inject_client_fault`` grammar → FaultSchedule.
+
+    ``'drop=P,slow=P,corrupt=P,delay=N,seed=N,quarantine_after=N'`` —
+    every key optional, at least one probability > 0 required, probability
+    mass must leave room for healthy slots (drop+slow+corrupt < 1). Fails
+    at parse time with the offending entry named.
+    """
+    fields: Dict[str, Any] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            key, val = (x.strip() for x in part.split("="))
+        except ValueError:
+            raise ValueError(
+                f"--inject_client_fault: bad entry {part!r}; expected "
+                f"KEY=VALUE with KEY in drop|slow|corrupt|delay|seed|"
+                f"quarantine_after") from None
+        if key in ("drop", "slow", "corrupt"):
+            p = float(val)
+            assert 0.0 <= p < 1.0, (
+                f"--inject_client_fault: {key}={val} must be in [0, 1)")
+            fields[key] = p
+        elif key in ("delay", "seed", "quarantine_after"):
+            fields[key] = int(val)
+        else:
+            raise ValueError(
+                f"--inject_client_fault: unknown key {key!r}; use "
+                f"drop|slow|corrupt|delay|seed|quarantine_after")
+    sched = FaultSchedule(**fields)
+    assert sched.active, (
+        "--inject_client_fault: at least one of drop/slow/corrupt must "
+        "be > 0")
+    assert sched.drop + sched.slow + sched.corrupt < 1.0, (
+        "--inject_client_fault: drop+slow+corrupt must be < 1 (a round "
+        "needs room for healthy slots)")
+    assert sched.delay >= 1, (
+        "--inject_client_fault: delay must be >= 1 round (a Δ=0 straggler "
+        "is an on-time client)")
+    assert sched.quarantine_after >= 1, (
+        "--inject_client_fault: quarantine_after must be >= 1")
+    return sched
+
+
+def staleness_weight(delay: int, decay: float) -> float:
+    """w(Δ) = decay**Δ — the late-landing weight of a straggler cohort
+    that dispatched Δ rounds ago (``--staleness_decay``; 1.0 = no decay,
+    the cohort lands as if on time)."""
+    return float(decay) ** int(delay)
+
+
+class LateCohort(NamedTuple):
+    """One straggler cohort in flight: the UN-normalized transmit sum
+    (device array — the sketch table / dense sum, or the stacked per-shard
+    sums on the ``--server_shard`` plane), its datum count (host float),
+    the client ids, and the dispatch/due round indices (global
+    ``round_no`` space)."""
+
+    transmit_sum: Any
+    count: float
+    ids: np.ndarray
+    dispatch_round: int
+    due_round: int
+
+
+# Jitted fold helpers: scalar operands are passed as () f32 ARRAYS (not
+# python floats) so per-round values never become baked-in constants —
+# one compile each for the whole run, zero retraces.
+
+@jax.jit
+def _transmit_sum(grad_mean, count):
+    """Replicated plane: un-normalize the client phase's data-weighted
+    mean back to the transmit SUM (sums are what fold linearly)."""
+    return grad_mean * count
+
+
+@jax.jit
+def _fold_mean(grad_mean, count, late_sum, late_weighted_count, weight):
+    """Replicated-plane late landing: the staleness-weighted data mean
+    (S_now + w·S_late) / (C_now + w·C_late), with grad_mean = S_now/C_now
+    already normalized by the client phase."""
+    return ((grad_mean * count + weight * late_sum)
+            / (count + late_weighted_count))
+
+
+@jax.jit
+def _fold_sum(grad_sum, late_sum, weight):
+    """Sharded plane: the per-shard transmit sums ride RoundContext
+    unreduced, so the fold is a plain scaled add (the ÷count happens
+    after the server's reduce, with the count folded by ``_add``)."""
+    return grad_sum + weight * late_sum
+
+
+@jax.jit
+def _add(a, b):
+    return a + b
+
+
+def _f32(x):
+    return np.float32(x)
+
+
+class ParticipationController:
+    """Host-side orchestration of client faults and late landing, owned by
+    ``FedModel`` (``attach_participation``). All work here is numpy +
+    jitted device arithmetic on arrays already in flight — the engine's
+    zero-blocking-fetch invariant holds with the layer enabled."""
+
+    def __init__(self, schedule: Optional[FaultSchedule] = None,
+                 decay: float = 0.5, sampler=None,
+                 target: Optional[int] = None):
+        self.schedule = schedule
+        self.decay = float(decay)
+        self.sampler = sampler
+        self.target = target
+        seed = schedule.seed if schedule is not None else 0
+        self.rng = np.random.RandomState(seed)
+        self.pending: List[LateCohort] = []
+        # run counters — the obs_report acceptance compares these against
+        # the telemetry log's participation section
+        self.drops = 0
+        self.slows = 0
+        self.corrupts = 0
+        self.landed = 0
+        self.expired = 0
+        self.requeued = 0
+        self.abandoned = 0
+        self.fault_skips = 0
+        self._corrupt_counts: Dict[int, int] = {}
+        # the quarantine LEDGER lives here (not just in the sampler): it
+        # must survive epoch-boundary checkpoints, which carry no sampler
+        # state — restore re-applies it to the attached sampler
+        self._quarantined_clients: set = set()
+
+    @property
+    def quarantined(self) -> int:
+        return len(self._quarantined_clients)
+
+    # -- fault application (called by FedModel.begin_round) ---------------
+
+    def apply_faults(self, batch: dict, round_no: int
+                     ) -> Tuple[dict, Optional[dict], dict]:
+        """Classify this round's live slots and split the batch:
+        returns ``(primary_batch, late_batch_or_None, cohort_info)``.
+        ``primary_batch`` carries only the on-time slots (drop/slow/
+        corrupt slots zero-masked — exactly the padding path the round
+        math already handles); ``late_batch`` carries ONLY the straggler
+        slots, for the held late dispatch. ``cohort_info`` is the host
+        bookkeeping that lands in the telemetry ``cohort`` span."""
+        info: Dict[str, Any] = {}
+        if self.target is not None:
+            info["target"] = int(self.target)
+        sched = self.schedule
+        if sched is None or not sched.active:
+            return batch, None, info
+        wmask = np.asarray(batch["worker_mask"])
+        live = wmask > 0
+        # one draw per SLOT (padded slots included) so the schedule is
+        # independent of how many slots the sampler filled this round
+        draws = self.rng.random_sample(wmask.shape)
+        drop = live & (draws < sched.drop)
+        slow = live & ~drop & (draws < sched.drop + sched.slow)
+        corrupt = live & ~drop & ~slow \
+            & (draws < sched.drop + sched.slow + sched.corrupt)
+        faulted = drop | slow | corrupt
+        if live.any() and faulted[live].all():
+            # a round with no on-time AND no late contribution has no
+            # defined average — keep the full cohort this round (the
+            # --client_dropout precedent)
+            self.fault_skips += 1
+            info["fault_skip"] = True
+            return batch, None, info
+
+        ids = np.asarray(batch["client_ids"])
+        mask = np.asarray(batch["mask"])
+        slot_counts = mask.reshape(mask.shape[0], -1).sum(axis=1)
+
+        def _masked(keep):
+            out = dict(batch)
+            wm = np.where(keep, wmask, 0.0).astype(np.float32)
+            out["worker_mask"] = wm
+            out["mask"] = (mask * wm.reshape(
+                wm.shape + (1,) * (mask.ndim - 1))).astype(mask.dtype)
+            return out
+
+        primary = _masked(live & ~faulted)
+        late_batch = _masked(slow) if slow.any() else None
+
+        if drop.any():
+            n_drop = int(drop.sum())
+            self.drops += n_drop
+            info["dropped"] = n_drop
+            if self.sampler is not None:
+                # the dropped clients' data returns to the epoch pool
+                # with bounded retry bookkeeping (FedSampler.requeue)
+                req, aband, attempts = self.sampler.requeue(
+                    ids[drop], slot_counts[drop])
+                self.requeued += req
+                self.abandoned += aband
+                if req:
+                    info["requeued"] = req
+                if aband:
+                    info["abandoned"] = aband
+                if attempts:
+                    info["retry_attempts"] = attempts
+        if slow.any():
+            n_slow = int(slow.sum())
+            self.slows += n_slow
+            info["slow"] = n_slow
+        if corrupt.any():
+            n_cor = int(corrupt.sum())
+            self.corrupts += n_cor
+            info["corrupt"] = n_cor
+            quarantined_now = []
+            for c in np.unique(ids[corrupt]):
+                c = int(c)
+                n = self._corrupt_counts.get(c, 0) + 1
+                self._corrupt_counts[c] = n
+                # >= (not ==): a restored run whose corrupt count is
+                # already past the threshold must still (re-)quarantine
+                # on the next offense, not let the known-bad client be
+                # re-sampled forever
+                if (n >= sched.quarantine_after
+                        and c not in self._quarantined_clients):
+                    # client-level quarantine: the repeat offender leaves
+                    # the sampling pool for the rest of the run — one bad
+                    # client is contained at CLIENT granularity, the
+                    # round guard never has to fire
+                    self._quarantined_clients.add(c)
+                    quarantined_now.append(c)
+                    if self.sampler is not None:
+                        self.sampler.quarantine(c)
+            if quarantined_now:
+                info["quarantined_now"] = quarantined_now
+        if self.quarantined:
+            info["quarantined_total"] = self.quarantined
+        return primary, late_batch, info
+
+    # -- straggler buffer -------------------------------------------------
+
+    def hold(self, transmit_sum, count: float, ids, round_no: int) -> None:
+        """Park a straggler cohort's (device) transmit sum until its due
+        round — the array simply stays referenced, riding the engine's
+        in-flight window; no host fetch."""
+        assert self.schedule is not None
+        self.pending.append(LateCohort(
+            transmit_sum=transmit_sum, count=float(count),
+            ids=np.asarray(ids, np.int64),
+            dispatch_round=int(round_no),
+            due_round=int(round_no) + int(self.schedule.delay)))
+
+    def fold_due(self, ctx, round_no: int, sharded: bool, count: float
+                 ) -> Tuple[Any, List[dict]]:
+        """Fold every due straggler cohort into this round's aggregate
+        with the staleness decay w(Δ) = decay**Δ (module docstring math;
+        pinned against a hand-computed reweighting in
+        tests/test_participation.py). ``count`` is the primary batch's
+        datum count (host float — the mask is host data). Returns the
+        updated ctx and the per-cohort landing records for telemetry."""
+        landed: List[dict] = []
+        due = [c for c in self.pending if c.due_round <= round_no]
+        if not due:
+            return ctx, landed
+        self.pending = [c for c in self.pending if c.due_round > round_no]
+        for coh in due:
+            delay = round_no - coh.dispatch_round
+            w = staleness_weight(delay, self.decay)
+            if sharded:
+                ctx = ctx._replace(
+                    gradient=_fold_sum(ctx.gradient, coh.transmit_sum,
+                                       _f32(w)),
+                    count=_add(ctx.count, _f32(w * coh.count)))
+            else:
+                ctx = ctx._replace(gradient=_fold_mean(
+                    ctx.gradient, _f32(count), coh.transmit_sum,
+                    _f32(w * coh.count), _f32(w)))
+                count = count + w * coh.count
+            self.landed += 1
+            landed.append({"from_round": coh.dispatch_round,
+                           "delay": int(delay), "weight": round(w, 6),
+                           "count": coh.count,
+                           "clients": [int(c) for c in coh.ids]})
+        return ctx, landed
+
+    def expire_pending(self) -> int:
+        """Discard stragglers whose due round will never dispatch (run
+        end). Counted, never silent — the telemetry event and obs_report
+        carry the number."""
+        n = len(self.pending)
+        self.pending = []
+        self.expired += n
+        return n
+
+    # -- counters / checkpoint state --------------------------------------
+
+    def counters(self) -> Dict[str, int]:
+        return {"drops": self.drops, "slows": self.slows,
+                "corrupts": self.corrupts, "landed": self.landed,
+                "expired": self.expired, "requeued": self.requeued,
+                "abandoned": self.abandoned,
+                "quarantined": self.quarantined,
+                "fault_skips": self.fault_skips,
+                "pending": len(self.pending)}
+
+    def state_payload(self) -> Tuple[Dict[str, np.ndarray], dict]:
+        """Checkpoint half: (arrays, meta). Arrays carry the fault RNG
+        and each pending cohort's transmit sum (np.asarray gathers the
+        device array — the save point is a drain point, syncs allowed
+        there); meta carries counters, corrupt ledger, and cohort
+        round indices. Round-trips bit-exactly (``--resume auto``)."""
+        arrays: Dict[str, np.ndarray] = {}
+        _, keys, pos, has_gauss, cached = self.rng.get_state()
+        arrays["rng_keys"] = keys
+        arrays["rng_meta"] = np.asarray([pos, has_gauss], np.int64)
+        arrays["rng_cached"] = np.asarray([cached], np.float64)
+        for i, coh in enumerate(self.pending):
+            arrays[f"pending{i}/sum"] = np.asarray(coh.transmit_sum)
+            arrays[f"pending{i}/ids"] = np.asarray(coh.ids, np.int64)
+        meta = {
+            "counters": self.counters(),
+            "corrupt_counts": {str(k): int(v)
+                               for k, v in self._corrupt_counts.items()},
+            # the quarantine ledger rides the CONTROLLER state (the
+            # sampler's copy is saved only by mid-epoch checkpoints):
+            # epoch-boundary resumes must not re-admit known-bad clients
+            "quarantined_clients": sorted(self._quarantined_clients),
+            "pending": [{"count": c.count,
+                         "dispatch_round": c.dispatch_round,
+                         "due_round": c.due_round}
+                        for c in self.pending],
+        }
+        return arrays, meta
+
+    def restore_state(self, arrays: Dict[str, np.ndarray], meta: dict,
+                      as_device=None) -> None:
+        """Inverse of ``state_payload``; ``as_device`` lifts a pending
+        cohort's saved sum back to a (placed) device array."""
+        pos, has_gauss = (int(x) for x in arrays["rng_meta"])
+        self.rng.set_state(("MT19937", arrays["rng_keys"], pos, has_gauss,
+                            float(arrays["rng_cached"][0])))
+        ctr = meta.get("counters", {})
+        for name in ("drops", "slows", "corrupts", "landed", "expired",
+                     "requeued", "abandoned", "fault_skips"):
+            setattr(self, name, int(ctr.get(name, 0)))
+        self._corrupt_counts = {int(k): int(v) for k, v in
+                                meta.get("corrupt_counts", {}).items()}
+        self._quarantined_clients = {
+            int(c) for c in meta.get("quarantined_clients", [])}
+        if self.sampler is not None:
+            # re-arm the sampler's exclusion set: epoch-boundary
+            # checkpoints carry no sampler state, so the ledger here is
+            # the only copy that survives such a resume
+            for c in self._quarantined_clients:
+                self.sampler.quarantine(c)
+        lift = as_device if as_device is not None else jnp.asarray
+        self.pending = [
+            LateCohort(transmit_sum=lift(arrays[f"pending{i}/sum"]),
+                       count=float(p["count"]),
+                       ids=np.asarray(arrays[f"pending{i}/ids"], np.int64),
+                       dispatch_round=int(p["dispatch_round"]),
+                       due_round=int(p["due_round"]))
+            for i, p in enumerate(meta.get("pending", []))]
+
+
+def attach_participation(args, fed_model, sampler=None):
+    """Entrypoint hook (cv_train/gpt2_train, mirroring
+    ``telemetry.attach_run_telemetry``): parse ``--participation`` /
+    ``--inject_client_fault``, configure the sampler's cohort target +
+    retry bookkeeping, and attach a ``ParticipationController`` to the
+    model. Returns the controller, or None when neither flag is set (the
+    model's begin_round then takes the untouched legacy path)."""
+    target = parse_participation(getattr(args, "participation", "") or "",
+                                 args.num_workers)
+    spec = (getattr(args, "inject_client_fault", "") or "").strip()
+    schedule = parse_client_fault(spec) if spec else None
+    if sampler is not None:
+        sampler.participation = target
+        sampler.sampling = getattr(args, "participation_sampling",
+                                   "uniform")
+        sampler.retry_limit = int(getattr(args, "client_retry_limit", 3))
+    if target is None and schedule is None:
+        return None
+    assert getattr(fed_model, "_row_stream", None) is None, (
+        "--participation/--inject_client_fault are incompatible with "
+        "host-offloaded client state (the straggler late dispatch would "
+        "need a second row-stream gather mid-round)")
+    ctl = ParticipationController(
+        schedule=schedule,
+        decay=float(getattr(args, "staleness_decay", 0.5)),
+        sampler=sampler, target=target)
+    fed_model._participation = ctl
+    parts = []
+    if target is not None:
+        parts.append(f"cohort target {target}/{args.num_workers} "
+                     f"({getattr(args, 'participation_sampling', 'uniform')}"
+                     f" sampling)")
+    if schedule is not None:
+        parts.append(f"client faults {schedule.spec()} "
+                     f"(w(Δ)={ctl.decay:g}**Δ late landing)")
+    print("participation layer: " + "; ".join(parts)
+          + " (docs/fault_tolerance.md)")
+    return ctl

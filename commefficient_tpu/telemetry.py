@@ -423,6 +423,31 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
         "backend": jax.default_backend(),
         "ledger": ledger,
     }
+    # Participation-layer config (--participation / --inject_client_fault,
+    # federated/participation.py): recorded in the run header so a logged
+    # run is reproducible from the log alone — the fault schedule is
+    # SEEDED, so spec + seed IS the schedule (the same auditability
+    # contract --collective_plan already has).
+    run_info["participation"] = (getattr(args, "participation", "")
+                                 or "1.0")
+    run_info["participation_sampling"] = getattr(
+        args, "participation_sampling", "uniform")
+    run_info["staleness_decay"] = float(getattr(args, "staleness_decay",
+                                                0.5))
+    fault_spec = (getattr(args, "inject_client_fault", "") or "").strip()
+    if fault_spec:
+        from commefficient_tpu.federated.participation import (
+            parse_client_fault,
+        )
+
+        sched = parse_client_fault(fault_spec)
+        run_info["client_fault"] = {
+            "spec": sched.spec(), "drop": sched.drop, "slow": sched.slow,
+            "corrupt": sched.corrupt, "delay": sched.delay,
+            "seed": sched.seed,
+            "quarantine_after": sched.quarantine_after}
+    else:
+        run_info["client_fault"] = None
     if plan is not None:
         run_info["collective_plan"] = plan.spec()
     if getattr(fed_model, "plan_report", None):

@@ -45,6 +45,7 @@ from commefficient_tpu.federated.checkpoint import (
     save_round_state,
 )
 from commefficient_tpu.federated.losses import make_cv_losses
+from commefficient_tpu.federated.participation import attach_participation
 from commefficient_tpu.profiling import StepProfiler
 from commefficient_tpu.telemetry import attach_run_telemetry
 from commefficient_tpu.ops.flat import ravel_pytree
@@ -405,6 +406,12 @@ def main(argv=None):
                          init_params=init_params, model_state=model_state)
     param_groups = build_param_groups(args, fed_model.params)
     opt = FedOptimizer(fed_model, args, param_groups=param_groups)
+    # straggler-/dropout-tolerant participation layer (--participation /
+    # --inject_client_fault, docs/fault_tolerance.md): partial cohorts
+    # through the sampler, seeded client faults, late landing
+    pc = attach_participation(args, fed_model,
+                              sampler=getattr(train_loader, "sampler",
+                                              None))
 
     lr_schedule = PiecewiseLinear([0, args.pivot_epoch, args.num_epochs],
                                   [0, args.lr_scale, 0])
@@ -437,6 +444,13 @@ def main(argv=None):
                         timer=timer, start_epoch=start_epoch, totals=totals,
                         resume_mid=resume_mid)
     finally:
+        if pc is not None:
+            # stragglers whose due round will never dispatch: counted,
+            # never silent (the obs_report participation section and the
+            # run log both carry the number)
+            expired = pc.expire_pending()
+            if expired and rt is not None:
+                rt.event("straggler_expired", count=expired)
         if rt is not None:
             rt.close()
     fed_model.finalize()

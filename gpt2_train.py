@@ -44,6 +44,7 @@ from commefficient_tpu.federated.checkpoint import (
 )
 from commefficient_tpu.telemetry import attach_run_telemetry
 from commefficient_tpu.federated.losses import make_gpt2_losses
+from commefficient_tpu.federated.participation import attach_participation
 from commefficient_tpu.models.gpt2 import (
     GPT2DoubleHeads,
     load_hf_gpt2,
@@ -430,6 +431,13 @@ def train(argv=None):
         stats = test_gpt2(fed_model, val_loader, args, logger=TableLogger(),
                           timer=timer)
     else:
+        # straggler-/dropout-tolerant participation layer
+        # (--participation / --inject_client_fault,
+        # docs/fault_tolerance.md): partial cohorts through the sampler,
+        # seeded client faults, staleness-weighted late landing
+        pc = attach_participation(args, fed_model,
+                                  sampler=getattr(train_loader, "sampler",
+                                                  None))
         # zero-sync telemetry plane (--telemetry, on by default): per-round
         # device metrics + the structured run event log under log_dir
         # (docs/observability.md; render with scripts/obs_report.py)
@@ -446,6 +454,12 @@ def train(argv=None):
                                start_epoch=start_epoch, totals=totals,
                                resume_mid=resume_mid)
         finally:
+            if pc is not None:
+                # stragglers whose due round will never dispatch: counted,
+                # never silent (obs_report's participation section)
+                expired = pc.expire_pending()
+                if expired and rt is not None:
+                    rt.event("straggler_expired", count=expired)
             if rt is not None:
                 rt.close()
     fed_model.finalize()

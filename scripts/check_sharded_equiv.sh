@@ -30,7 +30,16 @@
 #     (mirroring the qres suite), int4/fp8 pack-unpack round-trips,
 #     payload_bytes == ledger == actual payload agreement, quarantine
 #     leaving dres untouched, and the fp32-plan -> compressed-plan
-#     checkpoint warn path (tests/test_compressed_collectives.py).
+#     checkpoint warn path (tests/test_compressed_collectives.py);
+#   - the participation layer (--participation / --inject_client_fault,
+#     docs/fault_tolerance.md §client faults): full participation
+#     bit-identical to the pre-participation path across both planes x
+#     both epilogues, the partial-cohort exact-reweighting linearity
+#     identity, the staleness-decayed late landing pinned against a
+#     hand-computed reweighting, a seeded drop+slow+corrupt run
+#     deterministic and guard-quarantine-free, and the strict
+#     zero-host-sync audit with late landing in flight
+#     (tests/test_participation.py).
 # Any extra args are passed through to pytest (e.g. -k bit_identical).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -39,4 +48,5 @@ exec env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_sharded_server.py tests/test_fused_epilogue.py \
     tests/test_stream_sketch.py tests/test_sketch_coalesce.py \
     tests/test_telemetry.py tests/test_compressed_collectives.py \
+    tests/test_participation.py \
     -q -p no:cacheprovider "$@"

@@ -117,6 +117,49 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
                 and math.isfinite(e["metrics"][name])]
         return (sum(vals) / len(vals)) if vals else None
 
+    # Participation section (federated/participation.py,
+    # docs/fault_tolerance.md): rebuilt entirely from the per-round
+    # `cohort` span fields + the run header — the acceptance drill is
+    # that a fault-injected run's participation history reproduces from
+    # the JSONL log ALONE (tests/test_participation.py compares these
+    # totals against the live controller's counters).
+    cohorts = [e["cohort"] for e in rounds if "cohort" in e]
+    landed = [rec for c in cohorts for rec in c.get("landed", [])]
+    staleness_hist: Dict[str, int] = {}
+    for rec in landed:
+        key = str(rec.get("delay"))
+        staleness_hist[key] = staleness_hist.get(key, 0) + 1
+    retry_ladder: Dict[str, int] = {}
+    for c in cohorts:
+        for attempt in c.get("retry_attempts", []):
+            retry_ladder[str(attempt)] = retry_ladder.get(str(attempt),
+                                                          0) + 1
+    expired = sum(e.get("count", 0) for e in events
+                  if e["ev"] == "straggler_expired")
+    participation = {
+        "participation": run_info.get("participation"),
+        "sampling": run_info.get("participation_sampling"),
+        "staleness_decay": run_info.get("staleness_decay"),
+        "client_fault": run_info.get("client_fault"),
+        "cohort_target": next((c["target"] for c in cohorts
+                               if "target" in c), None),
+        "dropped": sum(c.get("dropped", 0) for c in cohorts),
+        "slow": sum(c.get("slow", 0) for c in cohorts),
+        "corrupt": sum(c.get("corrupt", 0) for c in cohorts),
+        "requeued": sum(c.get("requeued", 0) for c in cohorts),
+        "abandoned": sum(c.get("abandoned", 0) for c in cohorts),
+        "landed": len(landed),
+        "landed_weight_mean": _mean([rec["weight"] for rec in landed
+                                     if isinstance(rec.get("weight"),
+                                                   (int, float))]),
+        "expired": expired,
+        "fault_skips": len([c for c in cohorts if c.get("fault_skip")]),
+        "quarantined": max((c.get("quarantined_total", 0)
+                            for c in cohorts), default=0),
+        "staleness_hist": staleness_hist,
+        "retry_ladder": retry_ladder,
+    }
+
     return {
         "log_rounds": len(rounds),
         "partial_rounds": len([e for e in events
@@ -172,11 +215,17 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         "mean_loss": _fin(_mean([e["loss"] for e in rounds
                                  if isinstance(e.get("loss"), float)
                                  and math.isfinite(e["loss"])])),
+        "participation": participation,
         "ledger": ledger_totals,
     }
 
 
-def render(events: List[dict], out=sys.stdout) -> Dict[str, Any]:
+def render(events: List[dict], out=None) -> Dict[str, Any]:
+    # resolve stdout at CALL time, not import time: a default bound to
+    # sys.stdout freezes whatever stream was installed when the module
+    # was first imported (e.g. one pytest test's capture object — closed
+    # by the time another test calls render)
+    out = out if out is not None else sys.stdout
     s = summarize(events)
     rounds = [e for e in events if e["ev"] == "round"]
     run_info = next((e for e in events if e["ev"] == "run_start"), {})
@@ -238,6 +287,40 @@ def render(events: List[dict], out=sys.stdout) -> Dict[str, Any]:
                 else "n/a (pre-dres schema log)")
         p(f"quantized-collective EF carries: mean qres (uplink) "
           f"{s['mean_qres_norm'] or 0:.3g}, mean dres (downlink) {dres}")
+
+    part = s["participation"]
+    if (part.get("client_fault") or part.get("cohort_target") is not None
+            or part.get("dropped") or part.get("landed")):
+        p("\n## Participation (docs/fault_tolerance.md §client faults)")
+        if part.get("cohort_target") is not None:
+            p(f"cohort target: {part['cohort_target']} clients/round "
+              f"(--participation {part.get('participation')}, "
+              f"{part.get('sampling')} sampling)")
+        if part.get("client_fault"):
+            p(f"fault schedule: {part['client_fault'].get('spec')}")
+        p(f"faults: {part['dropped']} dropped "
+          f"({part['requeued']} requeued, {part['abandoned']} abandoned), "
+          f"{part['slow']} stragglers ({part['landed']} landed, "
+          f"{part['expired']} expired), {part['corrupt']} corrupt "
+          f"({part['quarantined']} clients quarantined)"
+          + (f", {part['fault_skips']} all-fault rounds kept whole"
+             if part["fault_skips"] else ""))
+        if part["staleness_hist"]:
+            hist = ", ".join(
+                f"Δ={d}: {n}" for d, n in sorted(
+                    part["staleness_hist"].items(), key=lambda kv:
+                    int(kv[0])))
+            w = part.get("landed_weight_mean")
+            p(f"late-landing staleness histogram: {hist}"
+              + (f" (mean landing weight {w:.3g}; "
+                 f"w(Δ)={part.get('staleness_decay')}**Δ)"
+                 if isinstance(w, (int, float)) else ""))
+        if part["retry_ladder"]:
+            ladder = ", ".join(
+                f"attempt {a}: {n}" for a, n in sorted(
+                    part["retry_ladder"].items(),
+                    key=lambda kv: int(kv[0])))
+            p(f"drop-requeue retry ladder: {ladder}")
 
     p("\n## Guard / rollback history")
     if not s["guards"]:

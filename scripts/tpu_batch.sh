@@ -69,10 +69,14 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # NOTE (sketch-coalesce PR): the coalesce/sketch_coalesce/
 # profile_coalesce steps ride the same window — profile_coalesce diffs
 # against the profile_stream capture, so run profile_stream first.
+# NOTE (participation PR): the straggler capture + participation sweep
+# ride the same pending window as the stream/fused/telemetry/downlink
+# A/Bs — both reuse the headline compile, so they are cheap add-ons.
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
-coalesce telemetry downlink compressed_collectives stream_sketch \
-sketch_coalesce fused_epilogue learning profile profile_fused \
-profile_stream profile_coalesce profile_gpt2 host_offload imagenet ops"}
+coalesce telemetry downlink straggler participation \
+compressed_collectives stream_sketch sketch_coalesce fused_epilogue \
+learning profile profile_fused profile_stream profile_coalesce \
+profile_gpt2 host_offload imagenet ops"}
 i=0
 for step in $STEPS; do
   i=$((i + 1))
@@ -100,7 +104,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|downlink)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|downlink|straggler)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact.
       # `telemetry` is the telemetry-overhead A/B leg: headline geometry
@@ -156,6 +160,22 @@ for step in $STEPS; do
         mark_done profile_fused
       fi
       log "step $i rc=$rc (docs/measurements/tpu_profile_fused.md on success)"
+      ;;
+    participation)
+      # partial-cohort sweep (docs/fault_tolerance.md §client faults):
+      # rounds/sec at --participation 1.0 vs 0.5 vs 0.1 with 10%
+      # injected drops — static shapes predict a flat sweep; a slower
+      # partial leg is a masking-path regression
+      log "step $i: tpu_measure.py participation sweep (timeout 30m)"
+      timeout 1800 python scripts/tpu_measure.py participation \
+        >"$OUT/tpu_measure_participation.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_participation.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "participation 0.1" \
+            "$OUT/tpu_measure_participation.log"; then
+        mark_done participation
+      fi
       ;;
     compressed_collectives)
       # fp32-plan vs full-int8-plan sharded round A/B + per-dtype

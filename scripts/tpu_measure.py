@@ -431,6 +431,35 @@ def compressed_collectives_leg():
               f"{x.size:,} elems (rel err {rel:.4f})", flush=True)
 
 
+def participation_leg():
+    """Partial-cohort participation A/B (docs/fault_tolerance.md §client
+    faults): the headline sketched round at --participation 1.0 vs 0.5 vs
+    0.1, the partial legs with 10% injected client drops on top — the
+    deployment regime the FL practicality survey (arXiv:2405.20431) calls
+    central. XLA's static shapes mean the masked slots still run their
+    zeroed compute, so the expected result is FLAT rounds/sec across the
+    sweep (a partial cohort costs no more than full participation); a
+    partial leg running SLOWER than full would be a masking-path
+    regression worth a profile. Builds differ only in the batch masks —
+    one compile serves all three legs."""
+    rows = []
+    for p, drops in ((1.0, 0.0), (0.5, 0.1), (0.1, 0.1)):
+        steps, ps, ss, cs, batch = B.build(tiny=False, participation=p,
+                                           drop_frac=drops)
+        dt, rtt, _ = time_rounds(steps, (ps, ss, cs, {}), batch)
+        live = int(np.asarray(batch["worker_mask"]).sum())
+        rows.append((p, dt))
+        print(f"participation {p:g} (drops {drops:g}, {live}/8 live "
+              f"slots) round: {dt * 1e3:.2f} ms ({1 / dt:.1f} r/s), "
+              f"rtt {rtt * 1e3:.0f} ms", flush=True)
+    if len(rows) == 3:
+        base = rows[0][1]
+        deltas = ", ".join(f"p={p:g}: {(dt - base) * 1e3:+.2f} ms"
+                           for p, dt in rows[1:])
+        print(f"participation sweep vs full cohort: {deltas} "
+              f"(expected ~0 — static shapes)", flush=True)
+
+
 def gpt2_leg(bf16):
     steps, ps, ss, cs, batch, tokens = B.build_gpt2(bf16=bf16)
     # train_step donates ps/client_states: after this call the local
@@ -523,7 +552,7 @@ def main():
     """Leg names via argv select a subset (default: all)."""
     known = {"matmul", "cifar", "ops", "gpt2", "imagenet", "topk_ab",
              "fused_epilogue", "stream_sketch", "sketch_coalesce",
-             "compressed_collectives"}
+             "compressed_collectives", "participation"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -560,6 +589,8 @@ def main():
         leg("sketch_coalesce", sketch_coalesce_leg)
     if sel("compressed_collectives"):
         leg("compressed_collectives", compressed_collectives_leg)
+    if sel("participation"):
+        leg("participation", participation_leg)
 
 
 if __name__ == "__main__":
