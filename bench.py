@@ -152,7 +152,8 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
           guards: bool = False, stream_sketch: bool = False,
           sketch_coalesce: bool = False,
           telemetry: bool = False, collective_plan: str = "",
-          participation: float = 1.0, drop_frac: float = 0.0):
+          participation: float = 1.0, drop_frac: float = 0.0,
+          error_type: str = "virtual"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -194,10 +195,14 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
     # machinery: "sketch" (configs 3/4/5), "true_topk" (config 2), or
     # "uncompressed" (config 1); non-sketch modes transmit dense vectors,
     # so no sketch geometry is built
-    wcfg = WorkerConfig(mode=mode, error_type="virtual", k=k,
+    # local error feedback carries momentum client-side, so the server's
+    # virtual momentum must be 0 there (server.ServerConfig's contract) —
+    # the clients_sweep leg's per-client-state configuration
+    vmom = 0.9 if error_type == "virtual" else 0.0
+    wcfg = WorkerConfig(mode=mode, error_type=error_type, k=k,
                         num_workers=num_workers, weight_decay=5e-4)
-    scfg = ServerConfig(mode=mode, error_type="virtual", k=k,
-                        grad_size=d, virtual_momentum=0.9,
+    scfg = ServerConfig(mode=mode, error_type=error_type, k=k,
+                        grad_size=d, virtual_momentum=vmom,
                         fused_epilogue=fused_epilogue)
     sketch = make_sketch(d, c=c, r=r, seed=42, num_blocks=blocks) \
         if mode == "sketch" else None
@@ -243,7 +248,8 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
 
         server_state = place_server_state(server_state, mesh, mode,
                                           server_shard=True)
-    client_states = init_client_states(num_clients, d, wcfg)
+    client_states = init_client_states(num_clients, d, wcfg, sketch=sketch,
+                                       init_weights=flat)
 
     rng = np.random.RandomState(0)
     if non_iid:
@@ -775,6 +781,105 @@ def run_config_measurement(name: str) -> None:
     print(json.dumps(out), flush=True)
 
 
+def run_clients_sweep_measurement() -> None:
+    """Child-process entry (--run-cfg clients_sweep): rounds/sec vs client
+    POPULATION size with disk-tier client state (docs/host_offload.md) —
+    the million-client scale leg of ROADMAP item 1.
+
+    Synthetic populations of 10^4 / 10^5 / 10^6 clients back the headline
+    sketched round's per-client error state with a sparse
+    ``MemmapRowStore`` (rows materialize disk blocks only when touched, so
+    the 10^6 x 10 MB logical state costs ~W rows/round of real I/O).
+    Each timed round runs the full gather -> jitted round -> scatter
+    cycle through the ``CohortPrefetcher`` exactly as the aggregator
+    does, with round t+1's row read overlapping round t's compute. The
+    expected shape is a FLAT sweep — per-round work is W rows regardless
+    of population — so a rising curve is an out-of-core-path regression,
+    not a law of nature."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from commefficient_tpu.federated.host_state import (
+        CohortPrefetcher,
+        MemmapRowStore,
+    )
+    from commefficient_tpu.federated.rounds import ClientStates
+    from commefficient_tpu.parallel.mesh import default_client_mesh
+
+    _check_pallas_kernel()
+    tiny = jax.default_backend() not in ("tpu", "axon")
+    steps, ps, server_state, client_states, batch = build(
+        tiny=tiny, error_type="local")
+    import jax.numpy as jnp
+
+    # train_step donates its client_states argument, so the pre-round
+    # proxy rows must be copied for the delta (the aggregator reads them
+    # from the undonated round ctx; the fused step has no ctx)
+    _copy_rows = jax.jit(jnp.copy)
+    W = NUM_WORKERS
+    mesh = default_client_mesh(W)
+    row_shape = tuple(int(x) for x in client_states.errors.shape[1:])
+    batch = dict(batch)
+    batch["client_ids"] = jnp.arange(W, dtype=jnp.int32)  # proxy remap
+    iters, reps = (10, 2) if tiny else (20, 3)
+    out = {
+        "clients_sweep_metric": (
+            "8-worker sketched rounds/sec vs client-population size, "
+            "disk-tier (sparse memmap) per-client error state streamed "
+            f"{W} rows/round through the cohort prefetcher "
+            "(flat sweep expected; docs/host_offload.md)"),
+        "clients_sweep_row_bytes": int(np.prod(row_shape)) * 4,
+        "clients_sweep_tiny": tiny,
+        "platform": jax.default_backend(),
+    }
+    for n in (10_000, 100_000, 1_000_000):
+        tag = f"1e{len(str(n)) - 1}"
+        store_dir = tempfile.mkdtemp(prefix=f"clients_sweep_{tag}_")
+        store = MemmapRowStore(store_dir, n, {"errors": row_shape},
+                               mesh=mesh)
+        pf = CohortPrefetcher(store.gather_async)
+        rng = np.random.RandomState(7)
+        cohorts = [rng.choice(n, W, replace=False) for _ in range(iters + 2)]
+        # per-leg copies: train_step donates ps/client-state buffers, and
+        # the originals must survive for the next population leg
+        ps_leg = _copy_rows(ps)
+        ss_leg = jax.tree_util.tree_map(_copy_rows, server_state)
+
+        def run_rounds(k, ps, ss, ms):
+            pf.prefetch(cohorts[0])
+            for i in range(k):
+                stream, _ = pf.take(cohorts[i])
+                old = ClientStates(None, _copy_rows(stream.proxy.errors),
+                                   None)
+                o = steps.train_step(ps, ss, stream.proxy, ms, batch,
+                                     0.1, jax.random.key(i))
+                ps, ss, new_proxy, ms = o[:4]
+                store.scatter(stream, old, new_proxy)
+                pf.prefetch(cohorts[i + 1])
+            store.drain()
+            jax.block_until_ready(ps)
+            return ps, ss, ms
+
+        state = run_rounds(1, ps_leg, ss_leg, {})  # compile + warm
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            state = run_rounds(iters, *state)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        rps = iters / best
+        out[f"clients_sweep_rounds_per_sec_{tag}"] = round(rps, 4)
+        out[f"clients_sweep_prefetch_hits_{tag}"] = pf.hits
+        _log(f"clients_sweep n={n}: {rps:.2f} rounds/s "
+             f"({pf.hits} prefetch hits / {pf.misses} misses)")
+        store.close()
+        shutil.rmtree(store_dir, ignore_errors=True)
+    print(json.dumps(out), flush=True)
+
+
 # --------------------------------------------------------------------------
 # parent orchestration
 # --------------------------------------------------------------------------
@@ -861,6 +966,12 @@ _EXTRA_LEGS = {
                  "downlink_rounds_per_sec"),
     "straggler": (["--run-cfg", "straggler"], "BENCH_C12_TIMEOUT", 900,
                   "straggler_rounds_per_sec"),
+    # million-client host-offload data plane (docs/host_offload.md):
+    # rounds/sec vs synthetic population 10^4/10^5/10^6 with disk-tier
+    # (sparse memmap) client state streamed through the cohort prefetcher
+    "clients_sweep": (["--run-cfg", "clients_sweep"],
+                      "BENCH_CLIENTS_TIMEOUT", 1800,
+                      "clients_sweep_rounds_per_sec_1e6"),
 }
 
 
@@ -1153,12 +1264,20 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--run-cfg":
         sel = sys.argv[2] if len(sys.argv) >= 3 else "<missing>"
-        if sel not in ("c1", "c2", "shard", "fused", "guards", "stream",
-                       "telemetry", "downlink"):
+        if sel == "clients_sweep":
+            # the disk-tier population sweep has its own round loop (the
+            # gather->round->scatter cycle), not a CfgLeg timing
+            run_clients_sweep_measurement()
+            sys.exit(0)
+        # the allowlist IS the leg table — a hand-maintained copy here
+        # silently orphaned the coalesce/straggler captures (their
+        # children exited "unknown config" while the parent reported a
+        # failed leg)
+        if sel not in _CFG_LEGS:
             # a missing/typo'd operand must never fall through to the full
             # parent orchestration and claim the chip for a headline bench
             sys.exit(f"--run-cfg: unknown config {sel!r}; use "
-                     f"c1|c2|shard|fused|guards|stream|telemetry|downlink")
+                     + "|".join(sorted(_CFG_LEGS)) + "|clients_sweep")
         run_config_measurement(sel)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--capture":

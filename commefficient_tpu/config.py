@@ -110,6 +110,14 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                              "--checkpoint_path, pruning older ones after "
                              "each save (0 = keep all; existing workflows "
                              "unchanged).")
+    parser.add_argument("--state_dir", type=str, default="",
+                        help="Backing directory for disk-tier per-client "
+                             "state (the sparse memory-mapped row store, "
+                             "docs/host_offload.md). Default: a "
+                             "client_state/ directory under "
+                             "--checkpoint_path. Only used when the "
+                             "memory plan resolves the disk placement "
+                             "tier.")
     parser.add_argument("--finetune_path", type=str, default="./finetune")
     parser.add_argument("--finetuned_from", type=str, choices=_dataset_names(),
                         help="Name of the dataset you pretrained on.")

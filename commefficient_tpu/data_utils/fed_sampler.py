@@ -101,10 +101,18 @@ class FedSampler:
             cursor = np.array(self._pending_state["cursor"], np.int64)
             self._pending_state = None
         else:
+            # zero-item clients are skipped: np.random.permutation(0)
+            # contributes an empty array AND draws nothing from the MT
+            # stream (shuffle of length 0 never samples), so the RNG
+            # sequence — and therefore every seeded trajectory — is
+            # bit-identical to the unskipped loop. This matters at
+            # host-offload population scale (docs/host_offload.md): a
+            # 10^6-client federation where most clients hold no local
+            # data must not pay 10^6 no-op permutation calls per epoch.
             permuted = np.hstack([
                 s + np.random.permutation(n)
-                for s, n in zip(cumsum, data_per_client)
-            ]) if len(data_per_client) else np.array([], dtype=int)
+                for s, n in zip(cumsum, data_per_client) if n > 0
+            ]) if np.any(data_per_client) else np.array([], dtype=int)
             cursor = np.zeros(self.dataset.num_clients, dtype=np.int64)
             # retry budgets are per-epoch (they bound requeues of THIS
             # epoch's items); quarantine persists for the run

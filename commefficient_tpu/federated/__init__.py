@@ -6,6 +6,7 @@ from commefficient_tpu.federated.aggregator import (
 from commefficient_tpu.federated.engine import (
     PipelinedRoundEngine,
     RoundResult,
+    cohort_lookahead,
 )
 from commefficient_tpu.federated.checkpoint import (
     find_resume_checkpoint,
@@ -39,6 +40,7 @@ __all__ = [
     "LambdaLR",
     "PipelinedRoundEngine",
     "RoundResult",
+    "cohort_lookahead",
     "find_resume_checkpoint",
     "load_checkpoint",
     "load_matching",

@@ -41,6 +41,7 @@ reference's ``maxlen``-clamped deque does for very stale clients.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
@@ -111,6 +112,11 @@ class RoundHandle(NamedTuple):
     # merged into the telemetry `cohort` span at drain
     # (federated/participation.py, docs/fault_tolerance.md).
     cohort: Optional[dict] = None
+    # host-offload data-plane bookkeeping (host dict, None without row
+    # streaming): placement tier, gather/scatter timings, prefetch
+    # hit/miss — attached by seal_round like guard/telemetry and merged
+    # into the telemetry round record at drain (docs/host_offload.md).
+    offload: Optional[dict] = None
 
 
 @jax.jit
@@ -374,28 +380,85 @@ class FedModel:
             print(self.memory_plan.summary())
         state_sharding = client_state_sharding(self.mesh, self.memory_plan)
         self._state_sharding = state_sharding  # reused by --resume restore
-        self.client_states = init_client_states(
-            alloc_clients, self.grad_size, wcfg, init_weights=flat,
-            sketch=self.sketch, sharding=state_sharding)
+        has_state = (wcfg.has_velocity or wcfg.has_error
+                     or wcfg.do_topk_down)
         # Host-placed state cannot be indexed inside the device round step
         # (XLA memory spaces must match per op): stream the W participating
         # rows around the unchanged round instead (host_state.RowStreamer,
         # the reference's touched-rows shared-memory traffic,
         # fed_aggregator.py:105-129). Host-side compute needs the TPU
         # backend; on other backends the same row-proxy path runs with the
-        # memory kind degraded (client_state_sharding's documented fallback).
+        # memory kind degraded (client_state_sharding's documented
+        # fallback). The disk tier (docs/host_offload.md) serves the same
+        # contract from a sparse memory-mapped row store — the state is
+        # never materialized as one array at all.
         self._row_stream = None
+        self._row_store = None
         self._stream_round = None
-        if (self.memory_plan.placement == "host"
-                and (wcfg.has_velocity or wcfg.has_error
-                     or wcfg.do_topk_down)):
-            from commefficient_tpu.federated.host_state import RowStreamer
-            from commefficient_tpu.utils import is_tpu_backend
+        self._prefetcher = None
+        self._pending_offload = None
+        if self.memory_plan.placement == "disk" and has_state:
+            from commefficient_tpu.federated.host_state import (
+                CohortPrefetcher,
+                MemmapRowStore,
+            )
 
-            self._row_stream = RowStreamer(self.mesh, state_sharding,
-                                           host_compute=is_tpu_backend())
-            print("client state host-offload: streaming "
-                  f"{args.num_workers} rows/round around the device step")
+            row_shapes = {}
+            state_shape = ((self.sketch.table_shape
+                            if wcfg.mode == "sketch" else (self.grad_size,))
+                           if (wcfg.has_velocity or wcfg.has_error)
+                           else None)
+            if wcfg.has_velocity:
+                row_shapes["velocities"] = state_shape
+            if wcfg.has_error:
+                row_shapes["errors"] = state_shape
+            init_rows = {}
+            if wcfg.do_topk_down:
+                row_shapes["weights"] = (self.grad_size,)
+                # stored as deltas off the init row — no O(clients * d)
+                # tiling write at startup (host_state.MemmapRowStore)
+                init_rows["weights"] = np.asarray(flat, np.float32)
+            self._row_store = MemmapRowStore(
+                self._state_dir(args), alloc_clients, row_shapes,
+                mesh=self.mesh, init_rows=init_rows)
+            self._prefetcher = CohortPrefetcher(self._row_store.gather_async)
+            self.client_states = ClientStates(None, None, None)
+        else:
+            self.client_states = init_client_states(
+                alloc_clients, self.grad_size, wcfg, init_weights=flat,
+                sketch=self.sketch, sharding=state_sharding)
+            if self.memory_plan.placement == "host" and has_state:
+                from commefficient_tpu.federated.host_state import (
+                    CohortPrefetcher,
+                    RowStreamer,
+                )
+                from commefficient_tpu.utils import is_tpu_backend
+
+                self._row_stream = RowStreamer(self.mesh, state_sharding,
+                                               host_compute=is_tpu_backend())
+                self._prefetcher = CohortPrefetcher(self._gather_rows)
+        if self._prefetcher is not None:
+            # the streamed row count is the batch's client_ids SLOT count
+            # (the loader pads partial cohorts to W slots), not a worker
+            # count; say what actually moves per round and over what
+            # tier. Per-SLOT bytes come from the plan's total (members
+            # can have different row sizes — topk-down stale weights are
+            # (d,) while sketch vel/err rows are table-shaped), not
+            # row_bytes x member count.
+            plan = self.memory_plan
+            n_members = len([m for m in (wcfg.has_velocity, wcfg.has_error,
+                                         wcfg.do_topk_down) if m])
+            self._slot_bytes = plan.total_bytes // max(alloc_clients, 1)
+            per_round = args.num_workers * self._slot_bytes
+            print(f"client state host-offload ({plan.placement} tier): "
+                  f"streaming {args.num_workers} row slots/round x "
+                  f"{self._slot_bytes / 2**20:.2f} MiB/slot "
+                  f"({n_members} state array(s)) = "
+                  f"{per_round / 2**20:.2f} MiB/round "
+                  "around the device step"
+                  + ("" if self._prefetcher.enabled else
+                     " (cohort prefetch OFF: COMMEFFICIENT_COHORT_"
+                     "PREFETCH=0)"))
 
         self._round_ctx = None
         # --rng_impl: TPU-first extension (no reference equivalent). The
@@ -476,7 +539,45 @@ class FedModel:
         self.training = training
 
     def finalize(self):
-        """No worker processes to join (reference fed_aggregator.py:196-203)."""
+        """No worker processes to join (reference fed_aggregator.py:196-203)
+        — but the disk-tier row store's I/O worker is real: drain and join
+        it so every scatter is durably in the backing files."""
+        if self._row_store is not None:
+            self._row_store.close()
+
+    # -- host-offload data plane (docs/host_offload.md) --------------------
+
+    @staticmethod
+    def _state_dir(args) -> str:
+        """Disk-tier row-store location: ``--state_dir``, defaulting to a
+        ``client_state`` directory beside the run's checkpoints."""
+        explicit = getattr(args, "state_dir", "") or ""
+        if explicit:
+            return explicit
+        return os.path.join(getattr(args, "checkpoint_path", "."),
+                            "client_state")
+
+    @property
+    def streaming(self) -> bool:
+        """True when per-client state is row-streamed around the round
+        (host or disk tier) instead of indexed inside it."""
+        return self._prefetcher is not None
+
+    def _gather_rows(self, ids):
+        """The device/host tier's gather, shaped like the store's async
+        contract for the prefetcher (the jit dispatch IS async — the
+        returned proxy is an unmaterialized device array)."""
+        return self._row_stream.gather(self.client_states,
+                                       np.asarray(ids, np.int64))
+
+    def prefetch_cohort(self, batch: dict) -> None:
+        """Dispatch round t+1's cohort row gather while round t computes
+        (engine.cohort_lookahead peeks the next batch AFTER round t was
+        submitted, so sampler/fault RNG order is identical to the
+        non-prefetching loop). No-op without row streaming or with the
+        COMMEFFICIENT_COHORT_PREFETCH=0 kill-switch."""
+        if self._prefetcher is not None:
+            self._prefetcher.prefetch(np.asarray(batch["client_ids"]))
 
     def __call__(self, batch: dict):
         if self.training:
@@ -676,15 +777,32 @@ class FedModel:
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
         lr = self._current_lr()
         states_in = self.client_states
-        if self._row_stream is not None:
+        proxy_ids = None
+        if self.streaming:
             # stream the W participating rows to device and run the round
             # on the W-row proxy (ids remapped to arange(W)); the deltas
-            # scatter back into the big host-resident arrays in step()
-            self._stream_round = self._row_stream.gather(
-                self.client_states, jbatch["client_ids"])
-            jbatch["client_ids"] = jnp.arange(
-                int(jbatch["client_ids"].shape[0]), dtype=jnp.int32)
+            # scatter back into the big host/disk-resident rows in step().
+            # The gather goes through the prefetcher: a lookahead HIT means
+            # this round's rows were already read while the previous round
+            # computed (host_state.CohortPrefetcher, docs/host_offload.md)
+            t0 = time.perf_counter()
+            self._stream_round, hit = self._prefetcher.take(
+                np.asarray(batch["client_ids"]))
+            proxy_ids = jnp.arange(int(jbatch["client_ids"].shape[0]),
+                                   dtype=jnp.int32)
+            jbatch["client_ids"] = proxy_ids
             states_in = self._stream_round.proxy
+            self._pending_offload = {
+                "tier": self.memory_plan.placement,
+                "prefetch": "hit" if hit else (
+                    "miss" if self._prefetcher.enabled else "off"),
+                "gather_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            }
+            if self._row_store is not None:
+                # the worker-measured read+upload duration (the main-thread
+                # number above is only the wait, ~0 on a prefetch hit)
+                self._pending_offload["gather_io_ms"] = round(
+                    self._row_store.last_gather_ms, 3)
         pre_model_state = self._model_state
         ctx, self._model_state, metrics = self.steps.client_step(
             self.ps_weights, states_in, self._model_state, jbatch,
@@ -709,6 +827,15 @@ class FedModel:
             late_count = float(max(np.asarray(late_batch["mask"]).sum(),
                                    1.0))
             jlate = {k: jnp.asarray(v) for k, v in late_batch.items()}
+            if proxy_ids is not None:
+                # participation x RowStreamer composition: the straggler
+                # slots are a mask-split of the very cohort the stream
+                # already gathered, so the late dispatch rides the SAME
+                # W-row proxy with the same arange remap — there is no
+                # second mid-round gather to serialize (the incompatibility
+                # the old attach_participation assert guarded against;
+                # docs/host_offload.md)
+                jlate["client_ids"] = proxy_ids
             late_ctx, _, _ = self.steps.client_step(
                 self.ps_weights, states_in, pre_model_state, jlate,
                 lr, self._next_rng())
@@ -794,7 +921,8 @@ class FedModel:
             self.telemetry.on_metrics(
                 handle.round_no,
                 {k: float(v) for k, v in zip(METRIC_FIELDS, vals)},
-                loss=loss, guard_ok=guard_ok, cohort=cohort)
+                loss=loss, guard_ok=guard_ok, cohort=cohort,
+                offload=handle.offload)
         if guard_ok is not None:
             self._note_guard(guard_ok, round_no=handle.round_no)
         return [m[handle.valid] for m in ms] + [download, handle.upload]
@@ -812,6 +940,9 @@ class FedModel:
         if self._pending_telemetry is not None:
             handle = handle._replace(telemetry=self._pending_telemetry)
             self._pending_telemetry = None
+        if self._pending_offload is not None:
+            handle = handle._replace(offload=self._pending_offload)
+            self._pending_offload = None
         return handle
 
     def _note_guard(self, ok: bool, round_no: int = -1) -> None:
@@ -904,7 +1035,7 @@ class FedModel:
         server_step donates its client_states argument."""
         ctx = self._round_ctx
         rng = self._next_rng()
-        if self._row_stream is None:
+        if not self.streaming:
             out = self.steps.server_step(
                 self.ps_weights, server_state, self.client_states, ctx,
                 lr, rng)
@@ -921,9 +1052,25 @@ class FedModel:
             out = self.steps.server_step(
                 self.ps_weights, server_state, proxy, ctx, lr, rng)
             new_ps, new_ss, new_proxy = out[:3]
-            self.client_states = self._row_stream.scatter(
-                self.client_states, stream, old, new_proxy)
+            t0 = time.perf_counter()
+            if self._row_store is not None:
+                # delta dispatch here (async device sub); materialization
+                # and the file write happen on the store's ordered I/O
+                # worker, overlapped with the next round's compute
+                self._row_store.scatter(stream, old, new_proxy)
+            else:
+                self.client_states = self._row_stream.scatter(
+                    self.client_states, stream, old, new_proxy)
             self._stream_round = None
+            if self._pending_offload is not None:
+                self._pending_offload["scatter_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 3)
+                if self._row_store is not None:
+                    # the worker-measured duration of the most recently
+                    # COMPLETED background write (<= 1 round stale — this
+                    # round's write is still overlapping compute)
+                    self._pending_offload["scatter_io_ms"] = round(
+                        self._row_store.last_scatter_ms, 3)
         # trailing step outputs, in server_step's order (guard first, then
         # telemetry) — device arrays held for seal_round; fetching either
         # here would be the per-round blocking sync the engine removes

@@ -17,6 +17,13 @@ additionally capture MID-EPOCH state (FedSampler position, rounds done,
 partial epoch metrics) so a preempted run resumes at round granularity with
 a bit-identical fp32 trajectory; ``prune_run_states`` implements the
 ``--keep_checkpoints N`` retention.
+
+Disk-tier client state (docs/host_offload.md): a run whose per-client
+rows live in a ``host_state.MemmapRowStore`` snapshots them as a SPARSE
+sibling directory ``<run_state>.rows/`` (per-file logical CRCs recorded in
+``meta_json``, verified on restore) instead of materializing TB-scale
+state into the archive; restores also cross tiers in both directions
+(full arrays scatter into a store; a snapshot lifts to full arrays).
 """
 
 from __future__ import annotations
@@ -24,6 +31,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import shutil
 import zlib
 from typing import Any, Dict, Optional
 
@@ -274,15 +282,38 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
             arrays["mid/" + name] = np.asarray(val)
         meta["mid_epoch"] = {"rounds_done": int(mid_epoch["rounds_done"]),
                              "extras": sorted(extras)}
-    # content checksum (verified on load and by --resume auto discovery):
-    # a torn write that survives the atomic-rename pattern — e.g. a torn
-    # COPY of a checkpoint, or on-disk corruption — fails loudly
-    meta["checksum"] = _content_checksum(arrays)
-    arrays["meta_json"] = np.frombuffer(
-        json.dumps(meta).encode(), dtype=np.uint8)
     if not path.endswith(".npz"):
         path = path + ".npz"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    store = getattr(fm, "_row_store", None)
+    if store is not None:
+        # Disk-tier client state (host_state.MemmapRowStore,
+        # docs/host_offload.md): the rows live in sparse backing files far
+        # beyond what an .npz should hold, so the checkpoint snapshots
+        # them NEXT TO the archive (sparse chunk copy, logical-content
+        # CRCs in meta_json) under ``<name>.rows/``. save_snapshot drains
+        # the store's I/O worker first, so the copied rows reflect every
+        # round the (already drained) engine applied. The snapshot lands
+        # via tmp-dir + rename BEFORE the .npz does: an .npz at its final
+        # name never points at a snapshot that does not exist.
+        stem = path[:-len(".npz")]
+        tmp_rows = stem + ".tmp.rows"
+        if os.path.isdir(tmp_rows):
+            shutil.rmtree(tmp_rows)
+        store_meta = store.save_snapshot(tmp_rows)
+        store_meta["dir"] = os.path.basename(stem) + ".rows"
+        if os.path.isdir(stem + ".rows"):
+            shutil.rmtree(stem + ".rows")
+        os.replace(tmp_rows, stem + ".rows")
+        meta["client_store"] = store_meta
+    # content checksum (verified on load and by --resume auto discovery):
+    # a torn write that survives the atomic-rename pattern — e.g. a torn
+    # COPY of a checkpoint, or on-disk corruption — fails loudly. The
+    # disk-tier row snapshot carries its own per-file CRCs in meta_json,
+    # verified by restore_snapshot at load time.
+    meta["checksum"] = _content_checksum(arrays)
+    arrays["meta_json"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
     # atomic: a crash mid-save (the very event --resume exists for) must not
     # leave a truncated file at the expected name. The tmp name keeps the
     # .npz suffix so np.savez does not append another one.
@@ -384,15 +415,46 @@ def prune_run_states(checkpoint_path: str, keep: int) -> None:
     for path in _run_state_files(checkpoint_path)[keep:]:
         try:
             os.remove(path)
+            # a disk-tier checkpoint's row snapshot lives beside the .npz
+            rows = path[:-len(".npz")] + ".rows"
+            if os.path.isdir(rows):
+                shutil.rmtree(rows)
             print(f"pruned old run state {path} (--keep_checkpoints {keep})")
         except OSError as e:
             print(f"could not prune {path}: {e}")
 
 
+def _verify_row_snapshot(path: str, meta: dict) -> None:
+    """Validate a disk-tier checkpoint's ``.rows`` snapshot against the
+    CRCs recorded in meta_json — part of ``--resume auto`` discovery, so
+    a candidate whose row snapshot is missing or torn is SKIPPED (falling
+    back to an older checkpoint) instead of aborting the restore later.
+    The hazard is real by construction: the ``.rows`` dir lands before
+    the ``.npz`` and run-state names repeat across resumes, so a crash
+    between the two renames can pair an older valid ``.npz`` with newer
+    rows."""
+    store = meta.get("client_store")
+    if store is None:
+        return
+    from commefficient_tpu.federated.host_state import _file_crc
+
+    snap_dir = os.path.join(os.path.dirname(path) or ".", store["dir"])
+    for name, m in store["members"].items():
+        fn = os.path.join(snap_dir, f"{name}.f32")
+        if not os.path.exists(fn):
+            raise RuntimeError(f"row-store snapshot missing {fn}")
+        crc = _file_crc(fn)
+        if crc != int(m["crc"]):
+            raise RuntimeError(
+                f"row-store snapshot corrupt ({fn}): content CRC "
+                f"{crc:#010x} != recorded {int(m['crc']):#010x}")
+
+
 def find_resume_checkpoint(checkpoint_path: str,
                            return_contents: bool = False):
     """``--resume auto`` discovery: the newest run-state checkpoint under
-    ``checkpoint_path`` that reads AND checksums clean. Corrupt or
+    ``checkpoint_path`` that reads AND checksums clean — including, for
+    disk-tier checkpoints, the sibling ``.rows`` row snapshot. Corrupt or
     truncated candidates (e.g. a file torn by the very preemption being
     recovered from) are reported and skipped, falling back to the next
     newest; returns None when nothing valid exists (callers start fresh).
@@ -406,6 +468,7 @@ def find_resume_checkpoint(checkpoint_path: str,
             flat = _read_npz(path)
             meta = json.loads(bytes(flat.pop("meta_json")).decode())
             _verify_checksum(flat, meta, path)
+            _verify_row_snapshot(path, meta)
             return (path, (flat, meta)) if return_contents else path
         except Exception as e:  # corrupt candidate — fall back to older
             print(f"--resume auto: skipping {path}: {e}")
@@ -493,25 +556,74 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler,
         return place(c)
 
     fm.ps_weights = resident(flat["ps_weights"])
-    cs = {}
-    for name in ("velocities", "errors", "weights"):
-        key = "client/" + name
-        cur = getattr(fm.client_states, name)
-        if key in flat:
-            assert cur is not None, \
-                f"checkpoint has client {name} but this config allocates none"
-            check_shape(f"client {name}", flat[key].shape, tuple(cur.shape))
-            arr = jnp.asarray(flat[key])
-            if fm._state_sharding is not None:
-                arr = jax.device_put(arr, fm._state_sharding)
-            cs[name] = arr
-        else:
-            assert cur is None, \
-                f"config allocates client {name} but checkpoint has none"
-            cs[name] = None
     from commefficient_tpu.federated.rounds import ClientStates
 
-    fm.client_states = ClientStates(**cs)
+    store = getattr(fm, "_row_store", None)
+    store_meta = meta.get("client_store")
+    rows_dir = (os.path.join(os.path.dirname(path) or ".",
+                             store_meta["dir"])
+                if store_meta is not None else None)
+    pf = getattr(fm, "_prefetcher", None)
+    if pf is not None:
+        # ANY streamed tier: a prefetched cohort was gathered from
+        # pre-restore rows/arrays — stale whichever branch below runs
+        pf.invalidate()
+    if store is not None:
+        # disk-tier run (host_state.MemmapRowStore): rows restore from the
+        # checkpoint's .rows snapshot (CRC-verified sparse copy-back —
+        # discovery already CRC'd it once; the copy re-deriving the CRC is
+        # the price of validated fallback, since the copy must read those
+        # bytes anyway), or scatter in from a smaller-tier checkpoint's
+        # full arrays
+        if store_meta is not None:
+            store.restore_snapshot(rows_dir, store_meta)
+        else:
+            for name in ("velocities", "errors", "weights"):
+                key = "client/" + name
+                if name in store.row_shapes:
+                    assert key in flat, (
+                        f"config allocates client {name} but checkpoint "
+                        f"has none")
+                    check_shape(f"client {name}", flat[key].shape,
+                                (store.num_rows,) + store.row_shapes[name])
+                    store.write_full(name, flat.pop(key))
+                else:
+                    assert key not in flat, (
+                        f"checkpoint has client {name} but this config "
+                        f"allocates none")
+        fm.client_states = ClientStates(None, None, None)
+    else:
+        if store_meta is not None:
+            # disk-tier checkpoint into an hbm/host-tier run: lift each
+            # snapshot member to a full array (RAM must hold it — that is
+            # what the tier change means) and fall through to the normal
+            # shape-checked restore below
+            from commefficient_tpu.federated.host_state import (
+                read_snapshot_member,
+            )
+
+            for name in store_meta["members"]:
+                flat["client/" + name] = read_snapshot_member(
+                    rows_dir, store_meta, name)
+        cs = {}
+        for name in ("velocities", "errors", "weights"):
+            key = "client/" + name
+            cur = getattr(fm.client_states, name)
+            if key in flat:
+                assert cur is not None, \
+                    f"checkpoint has client {name} but this config " \
+                    f"allocates none"
+                check_shape(f"client {name}", flat[key].shape,
+                            tuple(cur.shape))
+                arr = jnp.asarray(flat[key])
+                if fm._state_sharding is not None:
+                    arr = jax.device_put(arr, fm._state_sharding)
+                cs[name] = arr
+            else:
+                assert cur is None, \
+                    f"config allocates client {name} but checkpoint has none"
+                cs[name] = None
+        fm.client_states = ClientStates(**cs)
     mstate_flat = {k[len("model_state/"):]: v for k, v in flat.items()
                    if k.startswith("model_state/")}
     if mstate_flat:

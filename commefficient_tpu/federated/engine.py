@@ -44,7 +44,44 @@ import jax
 
 from commefficient_tpu.profiling import Heartbeat
 
-__all__ = ["RoundResult", "PipelinedRoundEngine"]
+__all__ = ["RoundResult", "PipelinedRoundEngine", "cohort_lookahead"]
+
+
+def cohort_lookahead(loader, model):
+    """Batch iterator with one-round cohort lookahead for the host-offload
+    prefetcher (host_state.CohortPrefetcher, docs/host_offload.md).
+
+    Yields the loader's batches unchanged. After the caller finishes round
+    t's loop body (``engine.submit``), the NEXT batch is drawn and its
+    ``client_ids`` handed to ``model.prefetch_cohort`` BEFORE it is
+    yielded — so round t+1's row gather dispatches while round t (and the
+    rest of the engine's in-flight window) still computes on device.
+
+    Ordering is deliberately identical to the plain ``for batch in
+    loader`` loop: batch t+1 is drawn only AFTER round t's body ran, so
+    the sampler/augmentation RNG order — and the participation layer's
+    requeue/quarantine mutations, which must land before the next draw
+    (config.validate_args's --train_dataloader_workers 0 constraint) —
+    are untouched. Prefetch on/off therefore changes WHEN rows are read,
+    never which batches (or rows) a trajectory sees.
+
+    A no-op wrapper for models without row streaming (``prefetch_cohort``
+    returns immediately), so both entrypoints use it unconditionally."""
+    it = iter(loader)
+    prefetch = getattr(model, "prefetch_cohort", None)
+    try:
+        batch = next(it)
+    except StopIteration:
+        return
+    while True:
+        yield batch
+        try:
+            nxt = next(it)
+        except StopIteration:
+            return
+        if prefetch is not None:
+            prefetch(nxt)
+        batch = nxt
 
 
 class RoundResult(NamedTuple):

@@ -24,12 +24,32 @@ Host-side compute (``compute_on('device_host')``) requires the TPU backend;
 elsewhere (the CPU test mesh) the same streaming wrapper runs with default
 memory — the row-proxy data path is identical, only the memory kind
 degrades, matching ``client_state_sharding``'s documented behavior.
+
+Beyond host RAM — the ``disk`` placement tier (docs/host_offload.md) —
+the same gather/scatter contract is served by ``MemmapRowStore``: each
+state member is a SPARSE memory-mapped file of ``(num_clients, *row)``
+f32, so a 10^6-client population costs disk blocks only for rows ever
+touched and host pages only for the W rows a round streams.  All file
+I/O runs on ONE background worker thread that processes operations in
+submission order (gather(t+1) can never observe state from before
+scatter(t)), which is what makes ``CohortPrefetcher`` — a one-slot
+lookahead that dispatches round t+1's row gather while round t computes —
+bit-transparent: prefetch on/off changes WHEN the read happens, never
+what it reads.  ``COMMEFFICIENT_COHORT_PREFETCH=0`` is the kill-switch.
 """
 
 from __future__ import annotations
 
+import json
+import os
+import queue
+import threading
+import time
+import zlib
 from contextlib import nullcontext
-from typing import NamedTuple, Optional
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -38,7 +58,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from commefficient_tpu.federated.rounds import ClientStates
 
-__all__ = ["RowStreamer", "StreamedRound"]
+__all__ = ["RowStreamer", "StreamedRound", "MemmapRowStore",
+           "CohortPrefetcher", "prefetch_enabled", "read_snapshot_member"]
 
 
 class StreamedRound(NamedTuple):
@@ -149,3 +170,636 @@ class RowStreamer:
             weights=push(states.weights, old_proxy.weights,
                          new_proxy.weights),
         )
+
+
+# ---------------------------------------------------------------------------
+# Disk tier: out-of-core client state behind the same gather/scatter contract
+# ---------------------------------------------------------------------------
+
+_MEMBERS = ("velocities", "errors", "weights")
+
+_COPY_CHUNK = 1 << 23  # 8 MiB — bounds host RSS during snapshot copies
+
+
+@jax.jit
+def _proxy_delta(new, old):
+    return new - old
+
+
+# -- CRC32 over sparse files without reading the holes ----------------------
+#
+# The snapshot CRC is defined over the LOGICAL content (holes read as
+# zeros), so it is representation-independent — but computing it by
+# read()ing a 10^6-row store would materialize terabytes of zero pages and
+# make checkpoint cost scale with the population instead of the touched
+# rows. CRC32 is linear over GF(2), so appending N zero BYTES to a stream
+# is a closed-form operator (zlib's crc32_combine construction: apply
+# x^(8N) mod the CRC polynomial via O(log N) 32x32 bit-matrix squarings),
+# and the file's data extents (SEEK_DATA/SEEK_HOLE) tell us exactly where
+# the zeros are without reading them.
+
+_CRC_POLY = 0xEDB88320
+
+
+def _gf2_times(mat, vec: int) -> int:
+    s = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            s ^= mat[i]
+        vec >>= 1
+        i += 1
+    return s
+
+
+def _gf2_square(mat):
+    return [_gf2_times(mat, mat[n]) for n in range(32)]
+
+
+def _crc32_combine(crc1: int, crc2: int, len2: int) -> int:
+    """crc32(A || B) from crc32(A), crc32(B), len(B) — zlib's
+    crc32_combine in pure Python (the C one is not exposed)."""
+    if len2 <= 0:
+        return crc1
+    odd = [_CRC_POLY] + [1 << (n - 1) for n in range(1, 32)]
+    even = _gf2_square(odd)
+    odd = _gf2_square(even)
+    while True:
+        even = _gf2_square(odd)
+        if len2 & 1:
+            crc1 = _gf2_times(even, crc1)
+        len2 >>= 1
+        if not len2:
+            break
+        odd = _gf2_square(even)
+        if len2 & 1:
+            crc1 = _gf2_times(odd, crc1)
+        len2 >>= 1
+        if not len2:
+            break
+    return crc1 ^ crc2
+
+
+def _crc32_zeros(crc: int, n: int) -> int:
+    """Extend ``crc`` by ``n`` zero bytes in O(log^2 n) — the hole-skip
+    operator (verified against ``zlib.crc32(b'\\0' * n)`` in
+    tests/test_host_offload.py)."""
+    if n <= 0:
+        return crc
+    block_crc = zlib.crc32(b"\x00")
+    block_len = 1
+    zeros_crc, zeros_len = 0, 0
+    while n:
+        if n & 1:
+            zeros_crc = _crc32_combine(zeros_crc, block_crc, block_len)
+            zeros_len += block_len
+        n >>= 1
+        if n:
+            block_crc = _crc32_combine(block_crc, block_crc, block_len)
+            block_len *= 2
+    return _crc32_combine(crc, zeros_crc, zeros_len)
+
+
+def _data_extents(fd: int, size: int):
+    """Yield the file's (start, end) DATA extents in order via
+    SEEK_DATA/SEEK_HOLE; one whole-file extent when the filesystem does
+    not support extent queries (e.g. 9p test mounts) — the caller then
+    degrades to a full read, exactly the pre-extent behavior."""
+    try:
+        os.lseek(fd, 0, os.SEEK_HOLE)  # support probe
+    except (OSError, AttributeError):
+        yield (0, size)
+        return
+    off = 0
+    while off < size:
+        try:
+            data = os.lseek(fd, off, os.SEEK_DATA)
+        except OSError:  # ENXIO — nothing but hole to EOF
+            return
+        hole = os.lseek(fd, data, os.SEEK_HOLE)
+        yield (data, min(hole, size))
+        off = hole
+
+
+def _copy_sparse(src: str, dst: str) -> int:
+    """Stream-copy ``src`` to ``dst`` touching only DATA extents, writing
+    holes for hole ranges AND for all-zero data chunks, so a 10^6-row
+    store whose run touched W rows/round snapshots in O(touched rows)
+    I/O — not O(logical size) — and the snapshot stays sparse. Returns
+    the CRC32 of the LOGICAL content (hole ranges folded in via the
+    closed-form zero-extension, so the CRC is representation-
+    independent)."""
+    crc = 0
+    pos = 0
+    size = os.path.getsize(src)
+    with open(src, "rb") as s, open(dst, "wb") as d:
+        for lo, hi in _data_extents(s.fileno(), size):
+            crc = _crc32_zeros(crc, lo - pos)
+            s.seek(lo)
+            d.seek(lo)
+            remaining = hi - lo
+            while remaining > 0:
+                buf = s.read(min(_COPY_CHUNK, remaining))
+                if not buf:
+                    break
+                crc = zlib.crc32(buf, crc)
+                if buf.count(0) == len(buf):
+                    d.seek(len(buf), 1)  # hole — extend without writing
+                else:
+                    d.write(buf)
+                remaining -= len(buf)
+            pos = hi
+        crc = _crc32_zeros(crc, size - pos)
+        d.truncate(size)
+    return crc
+
+
+def _file_crc(path: str) -> int:
+    """Logical-content CRC32 of a (possibly sparse) file, reading only
+    its data extents — see ``_copy_sparse``."""
+    crc = 0
+    pos = 0
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        for lo, hi in _data_extents(f.fileno(), size):
+            crc = _crc32_zeros(crc, lo - pos)
+            f.seek(lo)
+            remaining = hi - lo
+            while remaining > 0:
+                buf = f.read(min(_COPY_CHUNK, remaining))
+                if not buf:
+                    break
+                crc = zlib.crc32(buf, crc)
+                remaining -= len(buf)
+            pos = hi
+        crc = _crc32_zeros(crc, size - pos)
+    return crc
+
+
+class _PendingStream:
+    """A gather in flight on the store's worker thread. ``get()`` blocks
+    the CALLING thread on a threading.Event — a thread join, not a device
+    fetch, so it is invisible to ``host_sync_monitor`` (the device proxy
+    upload happens inside the worker)."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._value: Optional[StreamedRound] = None
+        self._err: Optional[BaseException] = None
+        self.io_ms: float = 0.0  # worker-measured read+upload duration
+
+    def _set(self, value=None, err=None):
+        self._value, self._err = value, err
+        self._done.set()
+
+    def get(self) -> StreamedRound:
+        self._done.wait()
+        if self._err is not None:
+            raise self._err
+        return self._value
+
+
+class MemmapRowStore:
+    """Out-of-core ``(num_clients, *row)`` client state: one sparse
+    memory-mapped-style row file per allocated state member, with the
+    RowStreamer's ``gather(ids) → W-row device proxy`` /
+    ``scatter(ids, delta)`` contract. The aggregator drives it exactly
+    like the device/host-tier streamer; only the backing medium differs.
+
+    Row access is POSITIONAL file I/O (``os.pread``/``os.pwrite`` at
+    ``id × row_bytes``), not a live ``np.memmap`` view: mmap page-fault
+    semantics are exactly right on a local ext4/xfs, but virtualized
+    test filesystems (the 9p mounts CI runs on) fault in the ENTIRE
+    mapping on first access — materializing the population is the one
+    thing this store exists to avoid, and pread of W rows is the same
+    syscall count either way. The files themselves are still created
+    sparse (ftruncate to the logical size — a hole, not a write), so
+    disk blocks materialize only for rows ever scattered to.
+
+    All file I/O runs on ONE worker thread processing operations in
+    submission order — the ordering invariant the prefetcher relies on
+    (a gather enqueued after a scatter observes the post-scatter rows,
+    exactly like the jit data dependency orders the device tier). The
+    main thread never performs a blocking device fetch on this path: the
+    scatter's delta materialization happens on the worker, overlapped
+    with the next round's device compute. Scatter is a per-slot
+    read-modify-write in slot order, so duplicate worker slots
+    accumulate exactly like the device tier's ``.at[ids].add``.
+
+    ``init_rows`` carries a per-member base row added at gather time
+    (physical files stay zero-initialized/sparse): because the scatter is
+    add-of-deltas and rows are only ever read through gather, storing
+    ``state - init_row`` is exact — this is how ``do_topk_down``'s
+    init-weights tiling avoids an O(num_clients · d) write at startup.
+
+    Checkpoint integration (``save_snapshot``/``restore_snapshot``):
+    snapshots are sparse chunk copies of the backing files with logical-
+    content CRCs recorded in the run-state's ``meta_json`` — see
+    ``checkpoint.save_run_state``.
+    """
+
+    backend = "memmap"
+
+    def __init__(self, store_dir: str, num_rows: int,
+                 row_shapes: Dict[str, Tuple[int, ...]],
+                 mesh: Optional[Mesh] = None,
+                 init_rows: Optional[Dict[str, np.ndarray]] = None):
+        assert row_shapes, "a row store with no members is a bug upstream"
+        for name in row_shapes:
+            assert name in _MEMBERS, f"unknown state member {name!r}"
+        self.store_dir = store_dir
+        self.num_rows = int(num_rows)
+        self.row_shapes = {k: tuple(int(x) for x in v)
+                           for k, v in row_shapes.items()}
+        self.init_rows = {k: np.asarray(v, np.float32)
+                          for k, v in (init_rows or {}).items()}
+        os.makedirs(store_dir, exist_ok=True)
+        self._fd: Dict[str, int] = {}
+        self._row_nbytes: Dict[str, int] = {}
+        for name, shape in self.row_shapes.items():
+            path = self.member_path(name)
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+            nbytes = self.num_rows * int(np.prod(shape)) * 4
+            # ALWAYS truncate to zero first, then extend to the logical
+            # size (a hole, not a write): a fresh run must start from
+            # zero rows even when a previous run left same-sized backing
+            # files in this directory — state, unlike the hbm/host tiers'
+            # init_client_states zeros, would otherwise silently leak
+            # across runs. A --resume restore rebuilds content AFTER
+            # construction from the checkpoint's .rows snapshot
+            # (restore_snapshot), so discarding here is always correct.
+            os.ftruncate(fd, 0)
+            os.ftruncate(fd, nbytes)
+            self._fd[name] = fd
+            self._row_nbytes[name] = int(np.prod(shape)) * 4
+        self._rows_sharding = (NamedSharding(mesh, P("clients"))
+                               if mesh is not None else None)
+        # rolling I/O stats (telemetry: the offload span reads these)
+        self.last_gather_ms: float = 0.0
+        self.last_scatter_ms: float = 0.0
+        self.gathers = 0
+        self.scatters = 0
+        # the ordered I/O worker
+        self._q: "queue.Queue" = queue.Queue()
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="row-store-io")
+        self._closed = False
+        self._worker.start()
+
+    def member_path(self, name: str) -> str:
+        return os.path.join(self.store_dir, f"{name}.f32")
+
+    # -- the worker ---------------------------------------------------------
+
+    def _run(self):
+        from commefficient_tpu.profiling import offpath_fetches
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            kind, payload = item
+            try:
+                with offpath_fetches():
+                    self._run_one(kind, payload)
+            except BaseException as e:  # surfaced by the next get()/drain()
+                if kind == "gather":
+                    # BOTH channels: the pending handle (for a take() that
+                    # consumes it) AND the store error slot — a prefetched
+                    # gather whose cohort is later DISCARDED never has
+                    # get() called, and its I/O failure must not vanish;
+                    # drain() re-raising an already-surfaced error is the
+                    # fail-loud side of that trade
+                    payload[1]._set(err=e)
+                    self._err = e
+                else:
+                    self._err = e
+
+    def _read_row(self, name: str, row: int) -> np.ndarray:
+        nb = self._row_nbytes[name]
+        buf = os.pread(self._fd[name], nb, row * nb)
+        return np.frombuffer(buf, np.float32).reshape(
+            self.row_shapes[name]).copy()
+
+    def _write_row(self, name: str, row: int, values: np.ndarray) -> None:
+        nb = self._row_nbytes[name]
+        os.pwrite(self._fd[name], np.ascontiguousarray(
+            values, np.float32).tobytes(), row * nb)
+
+    def _run_one(self, kind, payload):
+        if kind == "gather":
+            ids, pending = payload
+            t0 = time.perf_counter()
+            proxy = {}
+            for name in self._fd:
+                rows = np.stack([self._read_row(name, int(i))
+                                 for i in ids])
+                base = self.init_rows.get(name)
+                if base is not None:
+                    rows = rows + base
+                dev = jnp.asarray(rows)
+                if self._rows_sharding is not None:
+                    dev = jax.device_put(dev, self._rows_sharding)
+                proxy[name] = dev
+            self.last_gather_ms = (time.perf_counter() - t0) * 1e3
+            self.gathers += 1
+            pending._set(StreamedRound(
+                ids=ids,
+                proxy=ClientStates(**{m: proxy.get(m) for m in _MEMBERS})))
+        elif kind == "scatter":
+            ids, deltas = payload
+            t0 = time.perf_counter()
+            for name, delta in deltas.items():
+                # the ONE device fetch of the disk tier, on the worker —
+                # it overlaps the next round's compute and never blocks
+                # the dispatch path (profiling.offpath_fetches)
+                d = np.asarray(delta)
+                # per-slot read-modify-write IN SLOT ORDER: duplicate ids
+                # accumulate sequentially, replaying `.at[ids].add`
+                for slot, row in enumerate(ids):
+                    row = int(row)
+                    self._write_row(name, row,
+                                    self._read_row(name, row) + d[slot])
+            self.last_scatter_ms = (time.perf_counter() - t0) * 1e3
+            self.scatters += 1
+        else:  # "barrier"
+            payload.set()
+
+    _err: Optional[BaseException] = None
+
+    # -- the gather/scatter contract ---------------------------------------
+
+    def gather_async(self, ids) -> _PendingStream:
+        """Enqueue a W-row read; returns a handle whose ``get()`` yields
+        the ``StreamedRound`` (row-sharded device proxy, original ids)."""
+        assert not self._closed, "gather on a closed row store"
+        ids = np.asarray(ids, np.int64)
+        pending = _PendingStream()
+        self._q.put(("gather", (ids, pending)))
+        return pending
+
+    def gather(self, ids) -> StreamedRound:
+        return self.gather_async(ids).get()
+
+    def scatter(self, stream: StreamedRound, old_proxy: ClientStates,
+                new_proxy: ClientStates) -> None:
+        """Enqueue the round's delta write-back: ``rows[ids] += new - old``
+        per member (duplicate slot ids accumulate in slot order, matching
+        the device tier's ``.at[ids].add``). The subtraction is dispatched
+        on device HERE (async); the worker materializes and writes."""
+        assert not self._closed, "scatter on a closed row store"
+        deltas = {}
+        for name in self._fd:
+            old = getattr(old_proxy, name)
+            new = getattr(new_proxy, name)
+            if old is None or new is None:
+                continue
+            deltas[name] = _proxy_delta(new, old)
+        self._q.put(("scatter", (np.asarray(stream.ids, np.int64), deltas)))
+
+    def drain(self) -> None:
+        """Barrier: wait for every enqueued gather/scatter to complete
+        (checkpoint save points and run teardown). Re-raises a worker-side
+        scatter failure instead of letting it vanish with the thread."""
+        done = threading.Event()
+        self._q.put(("barrier", done))
+        done.wait()
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self.drain()
+        self._closed = True
+        self._q.put(None)
+        self._worker.join()
+        for fd in self._fd.values():
+            os.close(fd)
+
+    # -- whole-array access (cross-tier checkpoint restore) -----------------
+
+    def write_full(self, name: str, array: np.ndarray) -> None:
+        """Overwrite one member from a full in-memory array (restoring an
+        hbm/host-tier checkpoint into a disk-tier run). Subtracts the
+        member's init row so the stored-delta representation is preserved."""
+        self.drain()
+        base = self.init_rows.get(name)
+        nb = self._row_nbytes[name]
+        # truncate-and-reextend first so the file is all holes, then skip
+        # all-zero chunks: a mostly-zero restore (never-sampled clients'
+        # rows, or topk-down weights that equal the base) stays sparse
+        # instead of materializing the full logical size
+        os.ftruncate(self._fd[name], 0)
+        os.ftruncate(self._fd[name], self.num_rows * nb)
+        step = max(1, _COPY_CHUNK // max(nb, 1))
+        for lo in range(0, self.num_rows, step):
+            chunk = np.ascontiguousarray(array[lo:lo + step], np.float32)
+            if base is not None:
+                chunk = chunk - base
+            if chunk.any():
+                os.pwrite(self._fd[name], chunk.tobytes(), lo * nb)
+
+    def read_full(self, name: str) -> np.ndarray:
+        """One member as a full in-memory array (restoring a disk-tier
+        checkpoint into an hbm/host-tier run — caller's RAM must hold it;
+        the clear failure there is the allocator's, not a silent wrong
+        restore)."""
+        self.drain()
+        base = self.init_rows.get(name)
+        nb = self._row_nbytes[name]
+        shape = (self.num_rows,) + self.row_shapes[name]
+        out = np.empty(shape, np.float32)
+        flat = out.reshape(self.num_rows, -1)
+        step = max(1, _COPY_CHUNK // max(nb, 1))
+        for lo in range(0, self.num_rows, step):
+            hi = min(lo + step, self.num_rows)
+            buf = os.pread(self._fd[name], (hi - lo) * nb, lo * nb)
+            flat[lo:hi] = np.frombuffer(buf, np.float32).reshape(
+                hi - lo, -1)
+        return out + base if base is not None else out
+
+    # -- checkpoint snapshots ----------------------------------------------
+
+    def save_snapshot(self, snap_dir: str) -> dict:
+        """Copy the backing files (sparsely) into ``snap_dir`` and return
+        the meta blob ``checkpoint.save_run_state`` embeds in meta_json:
+        member shapes/dtypes + logical-content CRCs + init-row CRCs. The
+        caller is responsible for the drain-before-save ordering (the
+        aggregator's save path drains engine then store)."""
+        self.drain()
+        os.makedirs(snap_dir, exist_ok=True)
+        members = {}
+        for name in self._fd:
+            crc = _copy_sparse(self.member_path(name),
+                               os.path.join(snap_dir, f"{name}.f32"))
+            members[name] = {"shape": list(self.row_shapes[name]),
+                             "crc": int(crc)}
+            base = self.init_rows.get(name)
+            if base is not None:
+                # rows are stored as deltas off this base (the topk-down
+                # init-weights trick); a restore into a DIFFERENT process
+                # must reproduce base + delta exactly, so the base rides
+                # the snapshot
+                np.save(os.path.join(snap_dir, f"init_{name}.npy"), base)
+                members[name]["init"] = True
+        meta = {"backend": self.backend, "rows": self.num_rows,
+                "members": members}
+        with open(os.path.join(snap_dir, "store.json"), "w") as f:
+            json.dump(meta, f)
+        return meta
+
+    def restore_snapshot(self, snap_dir: str, meta: dict) -> None:
+        """Copy a snapshot back over the live files, verifying each file's
+        logical CRC against the checkpoint's record — a torn or bit-rotted
+        row snapshot fails loudly like a torn .npz does."""
+        self.drain()
+        assert meta.get("backend") == self.backend, (
+            f"checkpoint row store backend {meta.get('backend')!r} != "
+            f"{self.backend!r}")
+        assert int(meta["rows"]) == self.num_rows, (
+            f"checkpoint row store has {meta['rows']} rows but this run "
+            f"allocates {self.num_rows} — different client population?")
+        saved = meta["members"]
+        assert set(saved) == set(self._fd), (
+            f"checkpoint row store members {sorted(saved)} != this "
+            f"config's {sorted(self._fd)}")
+        for name, m in saved.items():
+            # geometry must match BEFORE any bytes move: a different row
+            # shape with the same member set and row count would pass the
+            # CRC (it checks snapshot integrity, not config match) and
+            # then silently reinterpret misaligned bytes at this config's
+            # stride — same contract as the hbm/host path's check_shape
+            got = tuple(int(x) for x in m["shape"])
+            assert got == self.row_shapes[name], (
+                f"checkpoint row store geometry mismatch: {name} rows are "
+                f"{got} but this run expects {self.row_shapes[name]} — "
+                f"was the checkpoint written with a different "
+                f"model/sketch geometry or --mode?")
+        for name in self._fd:
+            src = os.path.join(snap_dir, f"{name}.f32")
+            if not os.path.exists(src):
+                raise RuntimeError(
+                    f"row-store snapshot missing {src}; the checkpoint's "
+                    f".rows directory is incomplete — try an earlier "
+                    f"run_state or --resume auto")
+            crc = _copy_sparse(src, self.member_path(name))
+            if crc != int(saved[name]["crc"]):
+                raise RuntimeError(
+                    f"row-store snapshot corrupt ({src}): content CRC "
+                    f"{crc:#010x} != recorded "
+                    f"{int(saved[name]['crc']):#010x}; try an earlier "
+                    f"run_state or --resume auto")
+            if saved[name].get("init"):
+                # the snapshot's base row wins over this process's own:
+                # stored rows are deltas off the SAVING run's base
+                self.init_rows[name] = np.load(
+                    os.path.join(snap_dir, f"init_{name}.npy"))
+            # _copy_sparse truncate-rewrote the file IN PLACE (same
+            # inode), so the held fd keeps addressing the restored bytes
+
+
+def read_snapshot_member(snap_dir: str, meta: dict,
+                         name: str) -> np.ndarray:
+    """Lift ONE member of a row-store snapshot to a full in-memory array —
+    the disk-tier-checkpoint → hbm/host-tier-run restore path
+    (``checkpoint.load_run_state``). Verifies the recorded CRC; the
+    caller's RAM must hold the result, which is exactly the point of the
+    tier change."""
+    m = meta["members"][name]
+    path = os.path.join(snap_dir, f"{name}.f32")
+    crc = _file_crc(path)
+    if crc != int(m["crc"]):
+        raise RuntimeError(
+            f"row-store snapshot corrupt ({path}): content CRC "
+            f"{crc:#010x} != recorded {int(m['crc']):#010x}; try an "
+            f"earlier run_state or --resume auto")
+    shape = (int(meta["rows"]),) + tuple(int(x) for x in m["shape"])
+    arr = np.array(np.memmap(path, np.float32, mode="r", shape=shape))
+    if m.get("init"):
+        arr = arr + np.load(os.path.join(snap_dir, f"init_{name}.npy"))
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered cohort prefetch
+# ---------------------------------------------------------------------------
+
+def prefetch_enabled() -> bool:
+    """The ``COMMEFFICIENT_COHORT_PREFETCH=0`` kill-switch (default ON)."""
+    return os.environ.get("COMMEFFICIENT_COHORT_PREFETCH", "1") != "0"
+
+
+class CohortPrefetcher:
+    """One-slot lookahead cache over a row plane's gather.
+
+    ``prefetch(ids)`` dispatches round t+1's row gather while round t
+    computes (``engine.cohort_lookahead`` feeds it the peeked next batch);
+    ``take(ids)`` hands the round its stream — a HIT consumes the slot, a
+    MISS (ids differ, slot empty, or kill-switch) gathers on the spot,
+    exactly the pre-prefetch behavior. Because the underlying gather is
+    ordering-safe (jit data dependencies on the device tier, the ordered
+    I/O worker on the disk tier), prefetch on/off is bit-transparent —
+    pinned in tests/test_host_offload.py.
+    """
+
+    def __init__(self, gather_async: Callable[[Any], Any],
+                 enabled: Optional[bool] = None):
+        self._gather = gather_async
+        self.enabled = prefetch_enabled() if enabled is None else enabled
+        self._slot: Optional[Tuple[bytes, Any]] = None
+        self.hits = 0
+        self.misses = 0
+        self.discarded = 0  # prefetched cohorts never consumed
+        self.last_wait_ms = 0.0  # take()'s block on an in-flight prefetch
+
+    @staticmethod
+    def _key(ids) -> bytes:
+        return np.ascontiguousarray(np.asarray(ids, np.int64)).tobytes()
+
+    def prefetch(self, ids) -> None:
+        if not self.enabled:
+            return
+        key = self._key(ids)
+        if self._slot is not None:
+            if self._slot[0] == key:
+                return
+            self.discarded += 1
+        self._slot = (key, self._gather(ids))
+
+    def take(self, ids):
+        """The round's stream: prefetched if the slot matches, gathered now
+        otherwise. Returns a resolved ``StreamedRound``; also reports
+        whether this was a hit (the telemetry offload span records it)."""
+        key = self._key(ids)
+        t0 = time.perf_counter()
+        if self._slot is not None and self._slot[0] == key:
+            _, handle = self._slot
+            self._slot = None
+            self.hits += 1
+            stream = handle.get() if isinstance(handle, _PendingStream) \
+                else handle
+            self.last_wait_ms = (time.perf_counter() - t0) * 1e3
+            return stream, True
+        if self._slot is not None:
+            self.discarded += 1
+            self._slot = None
+        self.misses += 1
+        handle = self._gather(ids)
+        stream = handle.get() if isinstance(handle, _PendingStream) \
+            else handle
+        self.last_wait_ms = (time.perf_counter() - t0) * 1e3
+        return stream, False
+
+    def invalidate(self) -> None:
+        """Drop a cached stream whose source rows are stale — called by
+        the checkpoint restore (the snapshot copy-back rewrote the rows a
+        prefetched cohort was gathered from)."""
+        if self._slot is not None:
+            self.discarded += 1
+            self._slot = None
+
+    def counters(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "discarded": self.discarded}

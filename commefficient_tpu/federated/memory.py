@@ -20,17 +20,40 @@ This module makes that budget explicit and plans placement:
   streaming itself is implemented by ``federated/host_state.py`` (a W-row
   proxy around the unchanged round step) and wired in the aggregator;
   ``COMMEFFICIENT_STATE_HBM_BUDGET`` overrides the budget to force the
-  path.
+  path;
+- when the TOTAL state exceeds even the host RAM budget — the 10^5–10^7
+  client regime of the Konečný setting (arXiv:1610.05492) that the FL
+  practicality survey (arXiv:2405.20431) calls the central deployment
+  obstacle — state is placed on **disk**: a sparse memory-mapped row
+  store (``host_state.MemmapRowStore``) with the same gather/scatter
+  contract, so only the W participating rows per round ever become
+  resident pages. ``COMMEFFICIENT_STATE_HOST_BUDGET`` overrides the host
+  RAM budget to force the tier.
+
+Both budget probes (device HBM via ``memory_stats()``, host RAM via
+``sysconf``) run ONCE per process and are cached — ``plan_client_state_
+memory`` is called per FedModel build and the probes are syscalls, not
+plan arithmetic.
 
 Capacity reference (v5e, 16 GiB HBM/chip, ResNet9 d=6.5M, budget = 50% of
-HBM for client state):
+HBM for client state; host column assumes a 256 GiB host, 50% budget):
 
   mode                      bytes/client   max clients/chip   3500 clients?
   dense velocity+error      2·d·4 ≈ 52 MB  ~160               host or 22+ chips
   sketch 5×500k vel+err     2·r·c̄·4 ≈ 20 MB ~400              host or 9+ chips
   sketch, one of vel/err    ≈ 10 MB        ~800               8 chips borderline
 
-(c̄ = lane-padded 500,096 columns.)
+  population scale          total (sketch one of vel/err @ 10 MB/client)
+  10^5 clients              ~1.0 TB        disk tier (host RAM can't hold it)
+  10^6 clients              ~10 TB         disk tier; sparse memmap — disk
+                                           blocks materialize only for rows
+                                           ever touched, and a round streams
+                                           just W·row_bytes (e.g. 8 × 10 MB)
+
+(c̄ = lane-padded 500,096 columns.)  The 10^5/10^6 rows are exactly why the
+disk tier exists: at those populations neither 16 GiB of HBM nor hundreds
+of GiB of host RAM hold the state, but the per-round working set is still
+W rows.
 """
 
 from __future__ import annotations
@@ -61,7 +84,8 @@ class ClientStateMemoryPlan:
     total_bytes: int
     num_shards: int
     per_device_bytes: int
-    placement: str  # "hbm" | "host"
+    placement: str  # "hbm" | "host" | "disk"
+    row_bytes: int = 0  # bytes of ONE client's row in one state array
 
     def summary(self) -> str:
         gb = 1024 ** 3
@@ -81,6 +105,42 @@ def _state_row_bytes(grad_size: int, wcfg: WorkerConfig,
     return grad_size * _F32
 
 
+# Budget probes are syscalls into the device runtime / libc; cache them
+# per process (the plan itself is called once per FedModel build, but the
+# probe must not be — `memory_stats()` walks the runtime allocator).
+_PROBE_CACHE: dict = {}
+
+
+def _device_hbm_budget() -> int:
+    """50% of the first device's reported HBM (8 GiB when the backend
+    reports nothing, e.g. CPU). Probed once per process."""
+    if "hbm" not in _PROBE_CACHE:
+        budget = None
+        try:
+            stats = jax.devices()[0].memory_stats()
+            if stats and "bytes_limit" in stats:
+                budget = stats["bytes_limit"] // 2
+        except Exception:
+            budget = None
+        _PROBE_CACHE["hbm"] = budget if budget else 8 * 1024 ** 3
+    return _PROBE_CACHE["hbm"]
+
+
+def _host_ram_budget() -> int:
+    """50% of physical host RAM (16 GiB when sysconf can't say). Probed
+    once per process; the ``COMMEFFICIENT_STATE_HOST_BUDGET`` override is
+    read per call so tests can force the disk tier at any state size."""
+    if "ram" not in _PROBE_CACHE:
+        budget = None
+        try:
+            budget = (os.sysconf("SC_PAGE_SIZE")
+                      * os.sysconf("SC_PHYS_PAGES")) // 2
+        except (ValueError, OSError, AttributeError):
+            budget = None
+        _PROBE_CACHE["ram"] = budget if budget else 16 * 1024 ** 3
+    return _PROBE_CACHE["ram"]
+
+
 def plan_client_state_memory(
     num_clients: int,
     grad_size: int,
@@ -88,13 +148,24 @@ def plan_client_state_memory(
     sketch: Optional[CountSketch] = None,
     mesh: Optional[Mesh] = None,
     hbm_budget_bytes: Optional[int] = None,
+    host_budget_bytes: Optional[int] = None,
 ) -> ClientStateMemoryPlan:
     """Account for every ClientStates array this config allocates (the same
-    conditions as ``init_client_states``) and decide HBM vs host placement.
+    conditions as ``init_client_states``) and decide the placement tier:
 
-    ``hbm_budget_bytes`` is the budget per device for client state; default
-    is 50% of the device's reported HBM (or 8 GiB when the backend doesn't
-    report memory, e.g. CPU).
+      hbm   per-device slice fits the HBM budget — direct device arrays;
+      host  slice busts HBM but the TOTAL fits the host RAM budget —
+            pinned-host arrays with the RowStreamer gather/scatter;
+      disk  the total busts host RAM too — a sparse memory-mapped row
+            store (host_state.MemmapRowStore), same gather/scatter
+            contract, W-row working set.
+
+    ``hbm_budget_bytes`` defaults to 50% of the device's reported HBM
+    (8 GiB when the backend doesn't report memory, e.g. CPU);
+    ``host_budget_bytes`` to 50% of physical RAM (16 GiB fallback). Both
+    probes are cached per process; ``COMMEFFICIENT_STATE_HBM_BUDGET`` /
+    ``COMMEFFICIENT_STATE_HOST_BUDGET`` override them (read per call so
+    tests and the offload scripts can force any tier at any size).
     """
     row = _state_row_bytes(grad_size, wcfg, sketch)
     vel = num_clients * row if wcfg.has_velocity else 0
@@ -107,25 +178,21 @@ def plan_client_state_memory(
 
     if hbm_budget_bytes is None:
         env = os.environ.get("COMMEFFICIENT_STATE_HBM_BUDGET")
-        if env:
-            # explicit override: lets tests and the host-offload script
-            # force the host-placement branch at any state size
-            hbm_budget_bytes = int(env)
-        else:
-            budget = None
-            try:
-                stats = jax.devices()[0].memory_stats()
-                if stats and "bytes_limit" in stats:
-                    budget = stats["bytes_limit"] // 2
-            except Exception:
-                budget = None
-            hbm_budget_bytes = budget if budget else 8 * 1024 ** 3
+        hbm_budget_bytes = int(env) if env else _device_hbm_budget()
+    if host_budget_bytes is None:
+        env = os.environ.get("COMMEFFICIENT_STATE_HOST_BUDGET")
+        host_budget_bytes = int(env) if env else _host_ram_budget()
 
-    placement = "hbm" if per_device <= hbm_budget_bytes else "host"
+    if per_device <= hbm_budget_bytes:
+        placement = "hbm"
+    elif total <= host_budget_bytes:
+        placement = "host"
+    else:
+        placement = "disk"
     return ClientStateMemoryPlan(
         velocity_bytes=vel, error_bytes=err, stale_weight_bytes=stale,
         total_bytes=total, num_shards=n_shards,
-        per_device_bytes=per_device, placement=placement)
+        per_device_bytes=per_device, placement=placement, row_bytes=row)
 
 
 def client_state_sharding(mesh: Optional[Mesh],
@@ -134,8 +201,13 @@ def client_state_sharding(mesh: Optional[Mesh],
     the clients axis, in HBM or host memory. Host placement needs TPU memory
     kinds; on other backends it degrades to default memory with the plan
     retained for accounting (host_state.RowStreamer runs the same row-proxy
-    data path either way, so the degraded mode stays execution-tested)."""
-    if mesh is None:
+    data path either way, so the degraded mode stays execution-tested).
+
+    The disk tier returns None: the state is never a device (or host-RAM)
+    array at all — it lives in ``host_state.MemmapRowStore``'s sparse
+    backing files, and only the W-row gather proxy ever gets a (row-)
+    sharding, applied by the store itself."""
+    if mesh is None or plan.placement == "disk":
         return None
     spec = P("clients")
     from commefficient_tpu.utils import is_tpu_backend

@@ -71,9 +71,15 @@ SIGKILLed mid-epoch resumes bit-exactly with ``--resume auto``.
 Limitations (documented in docs/fault_tolerance.md): a straggler's late
 landing folds the TRANSMIT only — per-client velocity/error/stale-weight
 state does not advance for the straggler cohort (their slots are masked at
-dispatch, so the scatter leaves their rows at pre-round values); and the
-layer is incompatible with the host-offload row streamer (the late
-dispatch would need a second gather mid-round).
+dispatch, so the scatter leaves their rows at pre-round values).
+
+The layer COMPOSES with host-offloaded client state (the host and disk
+RowStreamer/MemmapRowStore tiers, docs/host_offload.md): the straggler
+slots are a mask-split of the very cohort the round's row stream already
+gathered, so the late dispatch rides the SAME W-row proxy — no second
+mid-round gather exists, and partial cohorts, fault injection, and
+staleness-weighted late landing all run against state far beyond HBM (or
+host RAM), pinned in tests/test_host_offload.py.
 """
 
 from __future__ import annotations
@@ -550,10 +556,6 @@ def attach_participation(args, fed_model, sampler=None):
         sampler.retry_limit = int(getattr(args, "client_retry_limit", 3))
     if target is None and schedule is None:
         return None
-    assert getattr(fed_model, "_row_stream", None) is None, (
-        "--participation/--inject_client_fault are incompatible with "
-        "host-offloaded client state (the straggler late dispatch would "
-        "need a second row-stream gather mid-round)")
     ctl = ParticipationController(
         schedule=schedule,
         decay=float(getattr(args, "staleness_decay", 0.5)),

@@ -331,11 +331,14 @@ class RunTelemetry:
     def on_metrics(self, round_no: int, metrics: Dict[str, float],
                    loss: Optional[float] = None,
                    guard_ok: Optional[bool] = None,
-                   cohort: Optional[Dict[str, Any]] = None) -> None:
+                   cohort: Optional[Dict[str, Any]] = None,
+                   offload: Optional[Dict[str, Any]] = None) -> None:
         """Called by ``FedModel.finish_round`` with the drained (host)
         metric values; ``cohort`` carries the host-side participation/
         staleness summary (participants, slots, staleness_mean/max when
-        the accounting regime tracks per-client participation)."""
+        the accounting regime tracks per-client participation);
+        ``offload`` the host-offload data-plane record (placement tier,
+        gather/scatter ms, prefetch hit/miss — docs/host_offload.md)."""
         span = self._spans.setdefault(round_no, {})
         span["metrics"] = metrics
         if loss is not None:
@@ -344,6 +347,8 @@ class RunTelemetry:
             span["guard_ok"] = guard_ok
         if cohort:
             span["cohort"] = cohort
+        if offload:
+            span["offload"] = offload
 
     def on_drained(self, round_no: int, fetch_s: float) -> None:
         """The round's batched drain finished: derive the span fields and
@@ -360,7 +365,7 @@ class RunTelemetry:
         if "compute_ms" in span:
             rec["compute_ms"] = round(span["compute_ms"], 3)
         rec["drain_fetch_ms"] = round(fetch_s * 1e3, 3)
-        for key in ("loss", "guard_ok", "cohort", "metrics"):
+        for key in ("loss", "guard_ok", "cohort", "offload", "metrics"):
             if key in span:
                 rec[key] = span[key]
         self._f.write(json.dumps(_json_safe(rec), allow_nan=False) + "\n")
@@ -379,7 +384,7 @@ class RunTelemetry:
             span = self._spans[round_no]
             rec = {"round": round_no}
             for key in ("dispatch_ms", "occupancy", "compute_ms", "loss",
-                        "guard_ok", "cohort", "metrics"):
+                        "guard_ok", "cohort", "offload", "metrics"):
                 if key in span:
                     rec[key] = span[key]
             self.event("round_partial", **rec)
@@ -448,6 +453,21 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
             "quarantine_after": sched.quarantine_after}
     else:
         run_info["client_fault"] = None
+    # Host-offload data plane (docs/host_offload.md): the resolved
+    # placement tier + per-round streamed-row geometry, so the obs_report
+    # "Host offload" section reproduces the data-plane story from the log
+    # alone (same auditability contract as the participation config above)
+    mem_plan = getattr(fed_model, "memory_plan", None)
+    if mem_plan is not None and getattr(fed_model, "streaming", False):
+        run_info["state_placement"] = mem_plan.placement
+        run_info["state_row_bytes"] = int(mem_plan.row_bytes)
+        # ALL members' bytes for one client slot (members can differ in
+        # row size — aggregator computes it from the plan total)
+        run_info["state_slot_bytes"] = int(
+            getattr(fed_model, "_slot_bytes", mem_plan.row_bytes))
+        run_info["state_rows_per_round"] = int(args.num_workers)
+    elif mem_plan is not None and mem_plan.total_bytes:
+        run_info["state_placement"] = mem_plan.placement
     if plan is not None:
         run_info["collective_plan"] = plan.spec()
     if getattr(fed_model, "plan_report", None):

@@ -34,6 +34,7 @@ from commefficient_tpu.federated import (
     FedOptimizer,
     LambdaLR,
     PipelinedRoundEngine,
+    cohort_lookahead,
 )
 from commefficient_tpu.federated.checkpoint import (
     load_checkpoint,
@@ -152,7 +153,11 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
                 accs.extend(acc.tolist())
 
         try:
-            for i, batch in enumerate(loader):
+            # cohort_lookahead peeks batch t+1 AFTER round t submits and
+            # hands its client_ids to the host-offload prefetcher — the
+            # next round's row gather overlaps this round's device compute
+            # (no-op without row streaming; docs/host_offload.md)
+            for i, batch in enumerate(cohort_lookahead(loader, model)):
                 if i0 + i > spe * epoch_fraction:
                     break
                 prof.step(i)

@@ -33,6 +33,7 @@ from commefficient_tpu.federated import (
     FedOptimizer,
     LambdaLR,
     PipelinedRoundEngine,
+    cohort_lookahead,
 )
 from commefficient_tpu.federated.checkpoint import (
     load_checkpoint,
@@ -152,7 +153,12 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
                               batch_stats))
 
         try:
-            for batch_idx, batch in enumerate(loader):
+            # cohort_lookahead peeks batch t+1 AFTER round t submits and
+            # hands its client_ids to the host-offload prefetcher — the
+            # next round's row gather overlaps this round's device compute
+            # (no-op without row streaming; docs/host_offload.md)
+            for batch_idx, batch in enumerate(cohort_lookahead(loader,
+                                                               model)):
                 if batch_idx > 2 and args.do_test and batch_idx < spe - 10:
                     continue
                 if i0 + batch_idx > spe * epoch_fraction:

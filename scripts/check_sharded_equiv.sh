@@ -39,7 +39,16 @@
 #     hand-computed reweighting, a seeded drop+slow+corrupt run
 #     deterministic and guard-quarantine-free, and the strict
 #     zero-host-sync audit with late landing in flight
-#     (tests/test_participation.py).
+#     (tests/test_participation.py);
+#   - the million-client host-offload data plane (docs/host_offload.md):
+#     the memmap row store bit-identical to the device-tier streamer,
+#     cohort prefetch on/off bit-transparent, participation x offload
+#     composition bit-identical across host/disk tiers AND
+#     replicated/--server_shard planes, the gather(t+1)-before-
+#     finish_round(t) structural overlap assert under the strict
+#     zero-host-sync audit, disk-tier mid-epoch crash->resume
+#     bit-exactness, and the 10^6-client RSS bound
+#     (tests/test_host_offload.py — non-slow tier).
 # Any extra args are passed through to pytest (e.g. -k bit_identical).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -48,5 +57,5 @@ exec env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_sharded_server.py tests/test_fused_epilogue.py \
     tests/test_stream_sketch.py tests/test_sketch_coalesce.py \
     tests/test_telemetry.py tests/test_compressed_collectives.py \
-    tests/test_participation.py \
-    -q -p no:cacheprovider "$@"
+    tests/test_participation.py tests/test_host_offload.py \
+    -q -m "not slow" -p no:cacheprovider "$@"

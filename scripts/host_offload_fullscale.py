@@ -103,7 +103,13 @@ def main() -> int:
     # exactly this purpose).
     if platform == "cpu" and "COMMEFFICIENT_STATE_HBM_BUDGET" not in os.environ:
         os.environ["COMMEFFICIENT_STATE_HBM_BUDGET"] = "1"
-    plan = plan_client_state_memory(n, D, wcfg, sketch=sketch, mesh=mesh)
+    # this script drives the HOST (in-RAM streaming) tier specifically —
+    # the disk tier has its own legs (bench clients_sweep /
+    # tpu_measure host_offload_scale, docs/host_offload.md) — so pin the
+    # host budget above the 35 GB total or a small-RAM host would resolve
+    # "disk" and allocate nothing in RAM at all
+    plan = plan_client_state_memory(n, D, wcfg, sketch=sketch, mesh=mesh,
+                                    host_budget_bytes=1 << 46)
     print(f"[offload] plan: {plan}", flush=True)
     if not TINY and platform != "cpu" and plan.placement != "host":
         # only plausible on a giant-HBM device; record it rather than fail

@@ -160,6 +160,40 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         "retry_ladder": retry_ladder,
     }
 
+    # Host-offload section (docs/host_offload.md): rebuilt entirely from
+    # the per-round `offload` span fields + the run header — the same
+    # log-alone reproducibility drill as the participation section
+    # (tests/test_host_offload.py compares these against the live
+    # prefetcher's counters).
+    offloads = [e["offload"] for e in rounds if "offload" in e]
+    host_offload = None
+    if offloads or run_info.get("state_placement") in ("host", "disk"):
+        host_offload = {
+            "tier": (offloads[0].get("tier") if offloads
+                     else run_info.get("state_placement")),
+            "rows_per_round": run_info.get("state_rows_per_round"),
+            "row_bytes": run_info.get("state_row_bytes"),
+            "slot_bytes": run_info.get("state_slot_bytes",
+                                       run_info.get("state_row_bytes")),
+            "rounds": len(offloads),
+            "prefetch_hits": len([o for o in offloads
+                                  if o.get("prefetch") == "hit"]),
+            "prefetch_misses": len([o for o in offloads
+                                    if o.get("prefetch") == "miss"]),
+            "prefetch_off": len([o for o in offloads
+                                 if o.get("prefetch") == "off"]),
+            "gather_ms_p50": _fin(_pct([o["gather_ms"] for o in offloads
+                                        if "gather_ms" in o], 0.5)),
+            "gather_io_ms_p50": _fin(_pct(
+                [o["gather_io_ms"] for o in offloads
+                 if "gather_io_ms" in o], 0.5)),
+            "scatter_ms_p50": _fin(_pct([o["scatter_ms"] for o in offloads
+                                         if "scatter_ms" in o], 0.5)),
+            "scatter_io_ms_p50": _fin(_pct(
+                [o["scatter_io_ms"] for o in offloads
+                 if "scatter_io_ms" in o], 0.5)),
+        }
+
     return {
         "log_rounds": len(rounds),
         "partial_rounds": len([e for e in events
@@ -216,6 +250,7 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
                                  if isinstance(e.get("loss"), float)
                                  and math.isfinite(e["loss"])])),
         "participation": participation,
+        "host_offload": host_offload,
         "ledger": ledger_totals,
     }
 
@@ -321,6 +356,33 @@ def render(events: List[dict], out=None) -> Dict[str, Any]:
                     part["retry_ladder"].items(),
                     key=lambda kv: int(kv[0])))
             p(f"drop-requeue retry ladder: {ladder}")
+
+    ho = s.get("host_offload")
+    if ho:
+        p("\n## Host offload (docs/host_offload.md)")
+        geom = ""
+        if ho.get("rows_per_round") and ho.get("slot_bytes"):
+            geom = (f", streaming {ho['rows_per_round']} row slots/round x "
+                    f"{ho['slot_bytes'] / 2**20:.2f} MiB/slot")
+        p(f"placement tier: {ho.get('tier')}{geom}")
+        total = ho["prefetch_hits"] + ho["prefetch_misses"]
+        if total or ho["prefetch_off"]:
+            rate = (f"{ho['prefetch_hits'] / total:.0%}" if total
+                    else "n/a")
+            p(f"cohort prefetch: {ho['prefetch_hits']} hits / "
+              f"{ho['prefetch_misses']} misses (hit rate {rate})"
+              + (f", {ho['prefetch_off']} rounds with prefetch OFF"
+                 if ho["prefetch_off"] else ""))
+        if ho.get("gather_ms_p50") is not None:
+            io = (f" (worker read+upload p50 {ho['gather_io_ms_p50']} ms)"
+                  if ho.get("gather_io_ms_p50") is not None else "")
+            p(f"gather p50 {ho['gather_ms_p50']} ms on the dispatch "
+              f"path{io}")
+        if ho.get("scatter_ms_p50") is not None:
+            io = (f" (worker write p50 {ho['scatter_io_ms_p50']} ms, "
+                  "overlapped with the next round's compute)"
+                  if ho.get("scatter_io_ms_p50") is not None else "")
+            p(f"scatter dispatch p50 {ho['scatter_ms_p50']} ms{io}")
 
     p("\n## Guard / rollback history")
     if not s["guards"]:

@@ -72,8 +72,13 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # NOTE (participation PR): the straggler capture + participation sweep
 # ride the same pending window as the stream/fused/telemetry/downlink
 # A/Bs — both reuse the headline compile, so they are cheap add-ons.
+# NOTE (host-offload-scale PR): the clients_sweep capture and the
+# host_offload_scale prefetch A/B ride the same pending window as the
+# stream/fused/telemetry/downlink/straggler A/Bs — both reuse the
+# headline compile class (docs/host_offload.md).
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
-coalesce telemetry downlink straggler participation \
+coalesce telemetry downlink straggler clients_sweep participation \
+host_offload_scale \
 compressed_collectives stream_sketch sketch_coalesce fused_epilogue \
 learning profile profile_fused profile_stream profile_coalesce \
 profile_gpt2 host_offload imagenet ops"}
@@ -104,7 +109,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|downlink|straggler)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|downlink|straggler|clients_sweep)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact.
       # `telemetry` is the telemetry-overhead A/B leg: headline geometry
@@ -175,6 +180,21 @@ for step in $STEPS; do
           && grep -q "participation 0.1" \
             "$OUT/tpu_measure_participation.log"; then
         mark_done participation
+      fi
+      ;;
+    host_offload_scale)
+      # disk-tier row store at a 10^5-client synthetic population:
+      # prefetch on/off A/B (docs/host_offload.md) — quantifies how much
+      # of the W-row gather the CohortPrefetcher hides behind compute
+      log "step $i: tpu_measure.py host_offload_scale A/B (timeout 30m)"
+      timeout 1800 python scripts/tpu_measure.py host_offload_scale \
+        >"$OUT/tpu_measure_host_offload_scale.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_host_offload_scale.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "host_offload_scale A/B" \
+            "$OUT/tpu_measure_host_offload_scale.log"; then
+        mark_done host_offload_scale
       fi
       ;;
     compressed_collectives)

@@ -460,6 +460,90 @@ def participation_leg():
               f"(expected ~0 — static shapes)", flush=True)
 
 
+def host_offload_scale_leg():
+    """Host-offload data plane at population scale (docs/host_offload.md):
+    the headline sketched round with disk-tier (sparse memmap) per-client
+    error state at a 10^5-client synthetic population, prefetch ON vs OFF
+    A/B. ON overlaps round t+1's W-row read+upload with round t's device
+    compute (host_state.CohortPrefetcher); OFF serializes it on the
+    dispatch path — the delta IS the data plane's hidden cost. One
+    COMPILE serves both legs (the round step never sees the population;
+    it runs on the W-row proxy either way — the rebuild between legs only
+    re-inits the donated state)."""
+    import shutil
+    import tempfile
+
+    from commefficient_tpu.federated.host_state import (
+        CohortPrefetcher,
+        MemmapRowStore,
+    )
+    from commefficient_tpu.federated.rounds import ClientStates
+    from commefficient_tpu.parallel.mesh import default_client_mesh
+
+    # train_step donates its client_states argument, so the pre-round
+    # proxy rows are copied for the delta (the aggregator reads them from
+    # the undonated round ctx; the fused step has no ctx)
+    _copy_rows = jax.jit(jnp.copy)
+    n = int(os.environ.get("HOST_OFFLOAD_SCALE_CLIENTS", "100000"))
+    steps = ps = ss = cs = batch = None
+    W = mesh = row_shape = None
+    iters = 20
+    rows = []
+    for prefetch in (True, False):
+        # (re)build per leg: train_step donates the state buffers, so the
+        # second leg needs fresh ones — the COMPILE is shared via the jit
+        # cache, only the init re-runs
+        steps, ps, ss, cs, batch = B.build(tiny=False, error_type="local")
+        if W is None:
+            W = int(np.asarray(batch["worker_mask"]).shape[0])
+            mesh = default_client_mesh(W)
+            row_shape = tuple(int(x) for x in cs.errors.shape[1:])
+        batch = dict(batch)
+        batch["client_ids"] = jnp.arange(W, dtype=jnp.int32)
+        store_dir = tempfile.mkdtemp(prefix="host_offload_scale_")
+        store = MemmapRowStore(store_dir, n, {"errors": row_shape},
+                               mesh=mesh)
+        pf = CohortPrefetcher(store.gather_async, enabled=prefetch)
+        rng = np.random.RandomState(11)
+        cohorts = [rng.choice(n, W, replace=False)
+                   for _ in range(iters + 2)]
+
+        def run_rounds(k, ps_, ss_, ms):
+            pf.prefetch(cohorts[0])
+            for i in range(k):
+                stream, _ = pf.take(cohorts[i])
+                old = ClientStates(None, _copy_rows(stream.proxy.errors),
+                                   None)
+                o = steps.train_step(ps_, ss_, stream.proxy, ms, batch,
+                                     0.1, jax.random.key(i))
+                ps_, ss_, new_proxy, ms = o[:4]
+                store.scatter(stream, old, new_proxy)
+                pf.prefetch(cohorts[i + 1])
+            store.drain()
+            return ps_, ss_, ms
+
+        state = run_rounds(1, ps, ss, {})  # compile + touch rows
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            state = run_rounds(iters, *state)
+            drain(state[0])
+            best = min(best, (time.perf_counter() - t0) / iters)
+        tag = "prefetch on " if prefetch else "prefetch off"
+        rows.append((prefetch, best))
+        print(f"host_offload_scale n={n} {tag}: {best * 1e3:.2f} ms/round "
+              f"({1 / best:.1f} r/s; {pf.hits} hits/{pf.misses} misses, "
+              f"gather io {store.last_gather_ms:.2f} ms, scatter io "
+              f"{store.last_scatter_ms:.2f} ms)", flush=True)
+        store.close()
+        shutil.rmtree(store_dir, ignore_errors=True)
+    if len(rows) == 2:
+        on, off = rows[0][1], rows[1][1]
+        print(f"host_offload_scale A/B: prefetch saves "
+              f"{(off - on) * 1e3:+.2f} ms/round "
+              f"({off / on:.2f}x serial gather cost hidden)", flush=True)
+
+
 def gpt2_leg(bf16):
     steps, ps, ss, cs, batch, tokens = B.build_gpt2(bf16=bf16)
     # train_step donates ps/client_states: after this call the local
@@ -552,7 +636,8 @@ def main():
     """Leg names via argv select a subset (default: all)."""
     known = {"matmul", "cifar", "ops", "gpt2", "imagenet", "topk_ab",
              "fused_epilogue", "stream_sketch", "sketch_coalesce",
-             "compressed_collectives", "participation"}
+             "compressed_collectives", "participation",
+             "host_offload_scale"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -591,6 +676,8 @@ def main():
         leg("compressed_collectives", compressed_collectives_leg)
     if sel("participation"):
         leg("participation", participation_leg)
+    if sel("host_offload_scale"):
+        leg("host_offload_scale", host_offload_scale_leg)
 
 
 if __name__ == "__main__":

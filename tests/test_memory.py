@@ -73,8 +73,71 @@ class TestPlacement:
         wcfg = WorkerConfig(mode="uncompressed", local_momentum=0.9)
         plan = plan_client_state_memory(
             EMNIST_CLIENTS, D, wcfg, mesh=self._mesh(8),
-            hbm_budget_bytes=8 * GIB)  # 86/8 ≈ 10.7 GiB/dev > 8 GiB
+            hbm_budget_bytes=8 * GIB,  # 86/8 ≈ 10.7 GiB/dev > 8 GiB
+            host_budget_bytes=128 * GIB)  # total 86 GiB fits host RAM
         assert plan.placement == "host"
+
+    def test_placement_disk_when_over_host_budget(self):
+        """The third tier (docs/host_offload.md): state that busts even
+        the host RAM budget goes to the sparse memory-mapped row store —
+        the 10^5–10^6-client regime of the module docstring's capacity
+        table."""
+        wcfg = WorkerConfig(mode="sketch", error_type="local")
+        sketch = make_sketch(D, c=500_000, r=5, seed=0)
+        row = 5 * sketch.c_pad * 4  # ~10 MB/client, one state array
+        for n, expect in ((100_000, "disk"), (1_000_000, "disk")):
+            plan = plan_client_state_memory(
+                n, D, wcfg, sketch=sketch, mesh=self._mesh(8),
+                hbm_budget_bytes=8 * GIB,
+                host_budget_bytes=128 * GIB)  # 1–10 TB >> 128 GiB
+            assert plan.placement == "disk", (n, plan)
+            assert plan.error_bytes == n * row
+            assert plan.row_bytes == row
+        # and the budget ladder is a ladder: raise the host budget past
+        # the total and the same state drops back to the host tier
+        plan = plan_client_state_memory(
+            100_000, D, wcfg, sketch=sketch, mesh=self._mesh(8),
+            hbm_budget_bytes=8 * GIB, host_budget_bytes=4 * 1024 * GIB)
+        assert plan.placement == "host"
+
+    def test_budget_probe_cached_per_process(self):
+        """plan_client_state_memory used to call
+        jax.devices()[0].memory_stats() on EVERY invocation; both probes
+        (device HBM, host RAM) are now cached per process."""
+        from commefficient_tpu.federated import memory as M
+
+        M._PROBE_CACHE.clear()
+        wcfg = WorkerConfig(mode="sketch", error_type="local")
+        sketch = make_sketch(D, c=500_000, r=5, seed=0)
+        plan_client_state_memory(10, D, wcfg, sketch=sketch)
+        assert set(M._PROBE_CACHE) == {"hbm", "ram"}
+        probed = dict(M._PROBE_CACHE)
+        calls = []
+        orig = M.jax.devices
+
+        def counting_devices(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        M.jax.devices = counting_devices
+        try:
+            plan_client_state_memory(10, D, wcfg, sketch=sketch)
+        finally:
+            M.jax.devices = orig
+        assert calls == [], "second plan must not re-probe the device"
+        assert dict(M._PROBE_CACHE) == probed
+
+    def test_disk_tier_sharding_is_none(self):
+        wcfg = WorkerConfig(mode="sketch", error_type="local")
+        sketch = make_sketch(D, c=500_000, r=5, seed=0)
+        mesh = self._mesh(8)
+        plan = plan_client_state_memory(
+            1_000_000, D, wcfg, sketch=sketch, mesh=mesh,
+            hbm_budget_bytes=8 * GIB, host_budget_bytes=128 * GIB)
+        assert plan.placement == "disk"
+        # no device/host array exists to shard — the store row-shards
+        # only the W-row gather proxy itself
+        assert client_state_sharding(mesh, plan) is None
 
     def test_placement_hbm_when_it_fits(self):
         wcfg = WorkerConfig(mode="sketch", error_type="local")
