@@ -151,7 +151,8 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
           server_shard: bool = False, fused_epilogue: bool = False,
           guards: bool = False, stream_sketch: bool = False,
           sketch_coalesce: bool = False,
-          telemetry: bool = False, collective_plan: str = "",
+          telemetry: bool = False, telemetry_hist: bool = False,
+          collective_plan: str = "",
           participation: float = 1.0, drop_frac: float = 0.0,
           error_type: str = "virtual"):
     import jax
@@ -218,6 +219,7 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
                       server_shard=server_shard, guards=guards,
                       stream_sketch=stream_sketch,
                       sketch_coalesce=sketch_coalesce, telemetry=telemetry,
+                      telemetry_hist=telemetry_hist,
                       collective_plan=plan)
     loss_train, loss_val = make_cv_losses(model)
     # the entrypoints' real execution path: shard_map+psum over a clients
@@ -601,6 +603,7 @@ class CfgLeg(NamedTuple):
     stream_sketch: bool = False
     sketch_coalesce: bool = False
     telemetry: bool = False
+    telemetry_hist: bool = False
     collective_plan: str = ""
     participation: float = 1.0
     drop_frac: float = 0.0
@@ -698,6 +701,20 @@ _CFG_LEGS = {
                        "sketch 5x500k k=50k, full-compressed wire legs "
                        "incl. quantized downlink + dres carry)",
                        server_shard=True, collective_plan="int8"),
+    # the `telemetry` leg plus the schema-v3 histogram block + watch
+    # plane (--telemetry_hist, docs/observability.md §watch plane); same
+    # config-3 baseline anchor so the continuous-observability overhead
+    # reads straight off this leg vs the headline (gate <= 2% rounds/sec
+    # WITH histograms + watch enabled — the histogram adds two
+    # log/scatter passes over the update + the table-sized error carry;
+    # the watch rules are host arithmetic on drained values and cost the
+    # device nothing, so this leg times the device half and
+    # tpu_measure.py `watch` times both halves).
+    "watch": CfgLeg("sketch", 8, "BASELINE",
+                    "8-worker sketched rounds/sec/chip with --telemetry "
+                    "--telemetry_hist (ResNet9, sketch 5x500k k=50k, "
+                    "schema-v3 histogram metrics + watch plane)",
+                    telemetry=True, telemetry_hist=True),
     # the headline sketch leg at a PARTIAL cohort (--participation 0.5
     # with 10% injected client drops — the straggler/dropout regime of
     # docs/fault_tolerance.md §client faults); same config-3 baseline
@@ -739,6 +756,7 @@ def run_config_measurement(name: str) -> None:
         fused_epilogue=leg.fused_epilogue, guards=leg.guards,
         stream_sketch=leg.stream_sketch,
         sketch_coalesce=leg.sketch_coalesce, telemetry=leg.telemetry,
+        telemetry_hist=leg.telemetry_hist,
         collective_plan=leg.collective_plan,
         participation=leg.participation, drop_frac=leg.drop_frac)
     if K > 1:
@@ -962,6 +980,8 @@ _EXTRA_LEGS = {
                  "coalesce_rounds_per_sec"),
     "telemetry": (["--run-cfg", "telemetry"], "BENCH_C12_TIMEOUT", 900,
                   "telemetry_rounds_per_sec"),
+    "watch": (["--run-cfg", "watch"], "BENCH_C12_TIMEOUT", 900,
+              "watch_rounds_per_sec"),
     "downlink": (["--run-cfg", "downlink"], "BENCH_C12_TIMEOUT", 900,
                  "downlink_rounds_per_sec"),
     "straggler": (["--run-cfg", "straggler"], "BENCH_C12_TIMEOUT", 900,

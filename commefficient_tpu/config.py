@@ -409,6 +409,52 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                         dest="telemetry",
                         help="Disable the telemetry plane (bit-identical "
                              "trajectories either way).")
+    # Schema-v3 distribution telemetry (docs/observability.md): fixed-K
+    # log-magnitude histograms of the emitted update and the error carry
+    # appended to the on-device metrics vector — online threshold-drift /
+    # sketch-estimation-fidelity visibility scalar norms cannot give.
+    # Same non-perturbation contract (bit-identical trajectories on/off).
+    parser.add_argument("--telemetry_hist", action="store_true",
+                        dest="telemetry_hist", default=True,
+                        help="Append the schema-v3 log-magnitude "
+                             "histogram block (emitted update + error "
+                             "carry) to the on-device round metrics "
+                             "(the default with telemetry on).")
+    parser.add_argument("--no_telemetry_hist", action="store_false",
+                        dest="telemetry_hist",
+                        help="Drop the histogram block (12-field v2 "
+                             "metric schema; bit-identical trajectories "
+                             "either way).")
+    # Watch/alert rule engine (docs/observability.md §watch plane):
+    # declarative threshold + EWMA-drift rules evaluated over the drained
+    # metric stream at zero extra host syncs, emitting immediate
+    # watch_alert JSONL events with a reaction ladder (log / windowed
+    # trace capture of the next N rounds / forced run-state checkpoint).
+    parser.add_argument("--watch", action="store_true", dest="watch",
+                        default=True,
+                        help="Evaluate watch rules over the drained "
+                             "metric stream (the default with telemetry "
+                             "on; alerts land as watch_alert events).")
+    parser.add_argument("--no_watch", action="store_false", dest="watch",
+                        help="Disable the watch/alert plane.")
+    parser.add_argument("--watch_rules", type=str, default="",
+                        help="Watch rules 'METRIC{>|<}BOUND[@N]"
+                             "[->log|trace[:R]|checkpoint]' joined by "
+                             "','; BOUND a float or ewma*F (drift vs the "
+                             "metric's own EWMA). Empty = the default "
+                             "rule set (loss divergence, carry blowups, "
+                             "resolved-k collapse, occupancy drop, "
+                             "prefetch miss storm, rounds/sec "
+                             "regression).")
+    # Round-scoped trace capture (docs/observability.md §trace capture):
+    # windowed jax.profiler captures addressed by GLOBAL round_no —
+    # aimable at an absolute round instead of a loop index, landing in
+    # <run_dir>/trace_round_<N> with a trace_captured JSONL event.
+    parser.add_argument("--trace_rounds", type=str, default="",
+                        help="Windowed round-aligned profiler capture(s) "
+                             "'START:COUNT[,START:COUNT...]' over global "
+                             "round_no; traces land in the run dir named "
+                             "by the start round.")
     # On-device health guards + quarantine (docs/fault_tolerance.md): a
     # scalar finiteness/magnitude verdict per round, riding the batched
     # metric drain (zero extra host syncs). A tripped round's contribution
@@ -521,6 +567,22 @@ def validate_args(args):
                   "per-client velocity/error/stale-weight state does not "
                   "advance for a straggler cohort "
                   "(docs/fault_tolerance.md)")
+    # continuous-observability surface (docs/observability.md): fail fast
+    # on malformed watch-rule / trace-window specs, not rounds into a run
+    if getattr(args, "watch_rules", ""):
+        from commefficient_tpu.telemetry import parse_watch_rules
+
+        rules = parse_watch_rules(args.watch_rules)
+        if any(r.action == "checkpoint" for r in rules) \
+                and args.train_dataloader_workers > 0:
+            print("NOTE: a watch 'checkpoint' reaction needs "
+                  "--train_dataloader_workers 0 for a resumable save "
+                  "(same constraint as --checkpoint_every_rounds); the "
+                  "reaction will be skipped with a message")
+    if getattr(args, "trace_rounds", ""):
+        from commefficient_tpu.profiling import parse_trace_rounds
+
+        parse_trace_rounds(args.trace_rounds)
     if args.inject_fault:
         parse_inject_fault(args.inject_fault)  # fail fast on a bad spec
         if not args.guards:

@@ -63,6 +63,7 @@ from commefficient_tpu.federated.memory import (
     client_state_sharding,
     plan_client_state_memory,
 )
+from commefficient_tpu.profiling import annotate
 from commefficient_tpu.parallel.mesh import default_client_mesh
 
 # reference fed_aggregator.py:68-72
@@ -325,7 +326,20 @@ class FedModel:
         # RunTelemetry event log when one is attached (self.telemetry,
         # set by the entrypoints via telemetry.attach_run_telemetry).
         self._telemetry_cfg = bool(getattr(args, "telemetry", False))
+        # Schema-v3 histogram block (--telemetry_hist, default ON with
+        # telemetry; docs/observability.md): log-magnitude histograms of
+        # the emitted update + error carry appended to the metrics vector.
+        self._telemetry_hist = (self._telemetry_cfg
+                                and bool(getattr(args, "telemetry_hist",
+                                                 False)))
         self.telemetry = None  # RunTelemetry recorder (host-side sink)
+        # round-scoped trace capturer (profiling.RoundTracer, attached by
+        # telemetry.attach_run_telemetry; driven by the engine)
+        self.tracer = None
+        # the most recently drained round's guard verdict (None without
+        # --guards) — read by the engine's heartbeat so a stderr tail
+        # shows loss + verdict without the event log
+        self.last_guard_ok = None
         self._pending_telemetry = None
         self._last_staleness = None  # cohort staleness of the last dispatch
         cfg = RoundConfig(worker=wcfg, server=scfg, grad_size=self.grad_size,
@@ -338,7 +352,8 @@ class FedModel:
                           sketch_coalesce=self._sketch_coalesce,
                           guards=self._guards,
                           guard_max_abs=self._guard_max_abs,
-                          telemetry=self._telemetry_cfg)
+                          telemetry=self._telemetry_cfg,
+                          telemetry_hist=self._telemetry_hist)
         from commefficient_tpu.federated.losses import make_cv_losses  # noqa: F401
 
         self.steps = build_round_step(
@@ -786,8 +801,9 @@ class FedModel:
             # this round's rows were already read while the previous round
             # computed (host_state.CohortPrefetcher, docs/host_offload.md)
             t0 = time.perf_counter()
-            self._stream_round, hit = self._prefetcher.take(
-                np.asarray(batch["client_ids"]))
+            with annotate("fed_offload_gather"):
+                self._stream_round, hit = self._prefetcher.take(
+                    np.asarray(batch["client_ids"]))
             proxy_ids = jnp.arange(int(jbatch["client_ids"].shape[0]),
                                    dtype=jnp.int32)
             jbatch["client_ids"] = proxy_ids
@@ -804,9 +820,13 @@ class FedModel:
                 self._pending_offload["gather_io_ms"] = round(
                     self._row_store.last_gather_ms, 3)
         pre_model_state = self._model_state
-        ctx, self._model_state, metrics = self.steps.client_step(
-            self.ps_weights, states_in, self._model_state, jbatch,
-            lr, self._next_rng())
+        # round-scoped trace span (docs/observability.md §trace capture):
+        # names the client phase's dispatch inside a profiler capture; a
+        # TraceAnnotation is host-side and near-free when no trace is on
+        with annotate("fed_client_phase"):
+            ctx, self._model_state, metrics = self.steps.client_step(
+                self.ps_weights, states_in, self._model_state, jbatch,
+                lr, self._next_rng())
         self._rounds_dispatched += 1
         if late_batch is not None:
             # Straggler dispatch (staleness-weighted late landing,
@@ -894,6 +914,9 @@ class FedModel:
         guard_ok = None
         if handle.guard is not None:
             guard_ok = bool(materialize(handle.guard))
+        # published for the engine's heartbeat line (loss + verdict tail,
+        # docs/observability.md §heartbeat); None when guards are off
+        self.last_guard_ok = guard_ok
         if handle.telemetry is not None and self.telemetry is not None:
             # the round's device metrics vector — part of the SAME batched
             # drain (one counted materialize), recorded before the guard
@@ -1036,9 +1059,10 @@ class FedModel:
         ctx = self._round_ctx
         rng = self._next_rng()
         if not self.streaming:
-            out = self.steps.server_step(
-                self.ps_weights, server_state, self.client_states, ctx,
-                lr, rng)
+            with annotate("fed_server_phase"):
+                out = self.steps.server_step(
+                    self.ps_weights, server_state, self.client_states, ctx,
+                    lr, rng)
             new_ps, new_ss, self.client_states = out[:3]
         else:
             stream = self._stream_round
@@ -1049,8 +1073,9 @@ class FedModel:
                 errors=ctx.err_rows if proxy.errors is not None else None,
                 weights=(ctx.stale_rows if proxy.weights is not None
                          else None))
-            out = self.steps.server_step(
-                self.ps_weights, server_state, proxy, ctx, lr, rng)
+            with annotate("fed_server_phase"):
+                out = self.steps.server_step(
+                    self.ps_weights, server_state, proxy, ctx, lr, rng)
             new_ps, new_ss, new_proxy = out[:3]
             t0 = time.perf_counter()
             if self._row_store is not None:

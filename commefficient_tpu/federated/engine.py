@@ -40,9 +40,11 @@ import time
 from collections import deque
 from typing import Any, Deque, List, NamedTuple, Optional, Tuple
 
+import numpy as np
+
 import jax
 
-from commefficient_tpu.profiling import Heartbeat
+from commefficient_tpu.profiling import Heartbeat, annotate
 
 __all__ = ["RoundResult", "PipelinedRoundEngine", "cohort_lookahead"]
 
@@ -112,7 +114,7 @@ class PipelinedRoundEngine:
 
     def __init__(self, model, opt, lr_scheduler=None, window: int = 2,
                  drain_every: int = 8, telemetry=None,
-                 heartbeat: Optional[Heartbeat] = None):
+                 heartbeat: Optional[Heartbeat] = None, tracer=None):
         assert window >= 1, "in-flight window must be at least 1"
         assert drain_every >= 1, "drain_every must be at least 1"
         self.model = model
@@ -142,22 +144,44 @@ class PipelinedRoundEngine:
         # absolute round without counting lines. Armed by
         # COMMEFFICIENT_HEARTBEAT=1 (a no-op otherwise).
         self.heartbeat = heartbeat if heartbeat is not None else Heartbeat()
+        # Round-scoped trace capture (profiling.RoundTracer,
+        # docs/observability.md): the engine drives the tracer in the
+        # global round_no timeline — maybe-start before a round's
+        # dispatch, maybe-stop when the window's last round drains — so a
+        # capture is aimable at an absolute round (--trace_rounds, or the
+        # watch plane's trace reaction). Defaults to the model's attached
+        # tracer (telemetry.attach_run_telemetry).
+        self.tracer = (tracer if tracer is not None
+                       else getattr(model, "tracer", None))
 
     def submit(self, batch) -> List[RoundResult]:
         """Dispatch one training round; no blocking host transfer happens
         here unless this is a drain round (every ``drain_every``-th)."""
         t_start = time.monotonic()
-        if self.lr_scheduler is not None:
-            self.lr_scheduler.step()
-        handle = self.model.begin_round(batch)
-        self.opt.step()
-        seal = getattr(self.model, "seal_round", None)
-        if seal is not None:
-            # attach the server phase's on-device health verdict (--guards,
-            # docs/fault_tolerance.md) and telemetry metrics vector
-            # (--telemetry) to the handle they belong to; still device
-            # arrays — they drain with the batched metrics
-            handle = seal(handle)
+        # the round_no this dispatch will get (the model's global counter;
+        # models without one fall back to the engine-local index)
+        rn_next = getattr(self.model, "rounds_dispatched",
+                          self._next_index)
+        if self.tracer is not None:
+            # may start a windowed jax.profiler capture BEFORE dispatch,
+            # so this round's dispatch + device compute land in the trace
+            self.tracer.on_submit(rn_next)
+        # StepTraceAnnotation marks the round on the profiler timeline
+        # keyed by the global round_no (near-free when no trace is active)
+        with jax.profiler.StepTraceAnnotation("fed_round",
+                                              step_num=rn_next):
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            handle = self.model.begin_round(batch)
+            self.opt.step()
+            seal = getattr(self.model, "seal_round", None)
+            if seal is not None:
+                # attach the server phase's on-device health verdict
+                # (--guards, docs/fault_tolerance.md) and telemetry
+                # metrics vector (--telemetry) to the handle they belong
+                # to; still device arrays — they drain with the batched
+                # metrics
+                handle = seal(handle)
         self._pending.append((self._next_index, handle))
         self._next_index += 1
         self.rounds_submitted += 1
@@ -194,12 +218,32 @@ class PipelinedRoundEngine:
         while self._pending:
             idx, handle = self._pending.popleft()
             t_fetch = time.monotonic()
-            results.append(RoundResult(idx, self.model.finish_round(handle)))
+            with annotate("fed_drain"):
+                results.append(RoundResult(idx,
+                                           self.model.finish_round(handle)))
             rn = self._round_no(handle, idx)
-            self.heartbeat.round(rn)
+            if self.heartbeat.enabled:
+                # minimal live monitor even with telemetry off: the
+                # drained round's mean loss + guard verdict ride the
+                # heartbeat line (host math on already-fetched values)
+                vals = results[-1].values
+                loss_arr = vals[0] if len(vals) >= 3 else None
+                hb_loss = (float(np.mean(loss_arr))
+                           if loss_arr is not None
+                           and getattr(loss_arr, "size", 0) else None)
+                self.heartbeat.round(
+                    rn, loss=hb_loss,
+                    guard_ok=getattr(self.model, "last_guard_ok", None))
             if self.telemetry is not None:
                 self.telemetry.on_drained(rn,
                                           time.monotonic() - t_fetch)
+            if self.tracer is not None:
+                # stop an active capture once its window's last round has
+                # drained (device compute provably complete), and log the
+                # round-aligned capture record
+                cap = self.tracer.on_drained(rn)
+                if cap is not None and self.telemetry is not None:
+                    self.telemetry.event("trace_captured", **cap)
         if results:
             self.drains += 1
             if self.telemetry is not None:

@@ -265,6 +265,15 @@ class RoundConfig:
     # and the vector rides the round handle to the batched drain exactly
     # like the guard verdict (zero extra host syncs).
     telemetry: bool = False
+    # Schema-v3 histogram block (--telemetry_hist, the default with
+    # telemetry on; docs/observability.md): append the fixed-K
+    # log-magnitude histograms of the emitted update and the post-round
+    # error carry (telemetry.log_magnitude_histogram) to the metrics
+    # vector — online threshold-drift / estimation-fidelity visibility.
+    # Same non-perturbation contract as the scalar block (pure
+    # reductions; fp32 trajectories bit-identical on/off, pinned in
+    # tests/test_watch.py on both server planes).
+    telemetry_hist: bool = False
 
 
 class FederatedSteps(NamedTuple):
@@ -1056,7 +1065,8 @@ def build_round_step(
             from commefficient_tpu.telemetry import device_round_metrics
 
             tel = device_round_metrics(ctx.gradient, update, new_ps,
-                                       new_server_state, guard_ok=guard_ok)
+                                       new_server_state, guard_ok=guard_ok,
+                                       hists=cfg.telemetry_hist)
         if flat_caller:
             new_ps = layout.unchunk(new_ps)
         ret = (new_ps, new_server_state, cs)

@@ -37,7 +37,8 @@ import threading
 import jax
 
 __all__ = ["StepProfiler", "annotate", "SyncCounter", "host_sync_monitor",
-           "materialize", "offpath_fetches", "Heartbeat"]
+           "materialize", "offpath_fetches", "Heartbeat", "RoundTracer",
+           "parse_trace_rounds"]
 
 
 class Heartbeat:
@@ -54,21 +55,178 @@ class Heartbeat:
     When armed (``COMMEFFICIENT_HEARTBEAT=1``, or ``enabled=True``), each
     round emits one ``HEARTBEAT round=N`` line to stderr, flushed
     immediately — a supervisor that SIGKILLs the process at a randomized
-    round still holds an exact trail of how far training got. Disabled
-    (the default) it is a no-op on the hot path."""
+    round still holds an exact trail of how far training got. The engine
+    also passes the drained round's mean loss and (with ``--guards``) the
+    guard verdict, so a ``COMMEFFICIENT_HEARTBEAT=1`` stderr tail is a
+    minimal live monitor even with telemetry off. Supervisors key on the
+    leading ``round=N`` field; the extras append after it. Disabled (the
+    default) it is a no-op on the hot path."""
 
     def __init__(self, enabled: bool | None = None):
         if enabled is None:
             enabled = os.environ.get("COMMEFFICIENT_HEARTBEAT") == "1"
         self.enabled = bool(enabled)
 
-    def round(self, index: int, epoch: int | None = None) -> None:
+    def round(self, index: int, epoch: int | None = None,
+              loss: float | None = None,
+              guard_ok: bool | None = None) -> None:
         if not self.enabled:
             return
         line = f"HEARTBEAT round={index}"
         if epoch is not None:
             line += f" epoch={epoch}"
+        if loss is not None:
+            line += f" loss={loss:.6g}"
+        if guard_ok is not None:
+            line += f" guard={'ok' if guard_ok else 'TRIP'}"
         print(line, file=sys.stderr, flush=True)
+
+
+def parse_trace_rounds(spec: str) -> list:
+    """``--trace_rounds`` spec → list of (start_round, count) windows.
+    The spec is 'START:COUNT[,START:COUNT...]' over GLOBAL round_no
+    dispatch indices; malformed specs fail here at parse time."""
+    windows = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            start, count = (int(x) for x in part.split(":"))
+        except ValueError:
+            raise ValueError(
+                f"--trace_rounds: bad entry {part!r}; expected "
+                "START:COUNT (e.g. '10:3' or '10:3,200:5')") from None
+        assert start >= 0, f"--trace_rounds: start {start} must be >= 0"
+        assert count >= 1, f"--trace_rounds: count {count} must be >= 1"
+        windows.append((start, count))
+    return sorted(windows)
+
+
+# JAX allows ONE active profiler session per process: StepProfiler
+# (--profile, loop-index window) and RoundTracer (--trace_rounds / the
+# watch trace reaction, round_no window) must not both call start_trace.
+# Both starters consult this flag and DEFER/SKIP instead of crashing a
+# training run with "profiler already started"; the try/except around
+# each start covers third-party sessions the flag cannot see.
+_profiler_busy = False
+
+
+def _try_start_trace(logdir: str) -> bool:
+    global _profiler_busy
+    if _profiler_busy:
+        return False
+    # dir created only once the session is actually ours — a deferred
+    # window must not litter empty trace_round_* dirs while it retries
+    os.makedirs(logdir, exist_ok=True)
+    try:
+        jax.profiler.start_trace(logdir)
+    except Exception as e:  # noqa: BLE001 — a foreign active session
+        print(f"trace capture skipped: profiler unavailable ({e})")
+        return False
+    _profiler_busy = True
+    return True
+
+
+def _stop_trace() -> None:
+    global _profiler_busy
+    with contextlib.suppress(Exception):
+        jax.profiler.stop_trace()
+    _profiler_busy = False
+
+
+class RoundTracer:
+    """Round-scoped programmatic XLA trace capture (docs/observability.md).
+
+    ``StepProfiler`` traces a window of LOOP indices from one epoch's
+    loop; this tracer is addressed in the global round_no timeline instead
+    — ``--trace_rounds start:count`` windows, plus dynamic ``request(n)``
+    windows from the watch plane's trace reaction — so a capture is
+    aimable at an absolute round ("trace rounds 2000-2004 where the alert
+    fired") without hand-aiming a profiler session.
+
+    Driven by the engine: ``on_submit(round_no)`` BEFORE a round's
+    dispatch (starts ``jax.profiler.start_trace`` into
+    ``<logdir>/trace_round_<start>`` — the directory is NAMED by the
+    global round_no it actually starts at); ``on_drained(round_no)`` when
+    a round's batched drain lands (stops the trace once the window's last
+    round has drained — its device compute is provably complete then, so
+    the window's rounds are inside the capture). Returns the capture
+    record for the engine to log as a ``trace_captured`` JSONL event.
+    Pipelining caveat, by design: neighbors of the window that were in
+    flight during it appear in the trace too; the named window is a lower
+    bound, and round-aligned ``fed_round`` StepTraceAnnotations mark the
+    exact spans inside the capture."""
+
+    def __init__(self, logdir: str, windows=None):
+        self.logdir = logdir
+        self._pending = list(windows or [])   # static (start, count)
+        self._requests = 0                    # dynamic: rounds still owed
+        self._active = None                   # {start, until, dir}
+        self.captures = []                    # completed capture records
+
+    def request(self, count: int) -> bool:
+        """Dynamic capture request (the watch trace reaction): trace the
+        next ``count`` submitted rounds. Returns False when a capture is
+        already active or pending-dynamic (no nested traces)."""
+        if self._active is not None or self._requests:
+            return False
+        self._requests = int(count)
+        return True
+
+    def on_submit(self, round_no: int) -> None:
+        """Called before round ``round_no``'s dispatch; may start a
+        capture."""
+        if self._active is not None:
+            return
+        static = False
+        if self._requests:
+            count = self._requests
+        elif self._pending and round_no >= self._pending[0][0]:
+            # a static window whose start round is due (or was skipped
+            # over, e.g. resumed past it — start now rather than never)
+            count, static = self._pending[0][1], True
+        else:
+            return
+        trace_dir = os.path.join(self.logdir,
+                                 f"trace_round_{round_no:06d}")
+        if not _try_start_trace(trace_dir):
+            # another profiler session is active (e.g. --profile's
+            # StepProfiler window): DEFER — the window stays pending and
+            # retries at the next submit rather than crashing the run
+            return
+        if static:
+            self._pending.pop(0)
+        else:
+            self._requests = 0
+        self._active = {"start": round_no,
+                        "until": round_no + count - 1,
+                        "dir": trace_dir}
+
+    def on_drained(self, round_no: int):
+        """Called per drained round; stops the active capture once the
+        window's last round has drained. Returns the capture record (for
+        the ``trace_captured`` event) or None."""
+        if self._active is None or round_no < self._active["until"]:
+            return None
+        return self._stop()
+
+    def close(self):
+        """Stop a capture left open at run end (e.g. the run ended inside
+        the window). Returns the partial capture record or None."""
+        if self._active is None:
+            return None
+        return self._stop()
+
+    def _stop(self):
+        rec, self._active = self._active, None
+        _stop_trace()
+        rec = {"round_start": rec["start"], "round_until": rec["until"],
+               "dir": rec["dir"]}
+        self.captures.append(rec)
+        print(f"trace captured: rounds {rec['round_start']}-"
+              f"{rec['round_until']} -> {rec['dir']}")
+        return rec
 
 
 def annotate(name: str):
@@ -245,16 +403,17 @@ class StepProfiler:
         if not self.enabled:
             return
         if i == self.start_step and not self._active:
-            os.makedirs(self.logdir, exist_ok=True)
-            jax.profiler.start_trace(self.logdir)
+            # one profiler session per process: skip (not crash) when a
+            # RoundTracer window is already capturing
+            if not _try_start_trace(self.logdir):
+                return
             self._active = True
         elif i >= self.stop_step and self._active:
-            jax.profiler.stop_trace()
+            _stop_trace()
             self._active = False
             print(f"profiler: trace written to {self.logdir}")
 
     def close(self):
         if self._active:
-            with contextlib.suppress(Exception):
-                jax.profiler.stop_trace()
+            _stop_trace()
             self._active = False

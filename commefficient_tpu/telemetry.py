@@ -49,6 +49,17 @@ threshold exchange, per-client uplink), computed from the config the same
 way ``ops/collectives.py`` shapes its payloads — logged once in the
 run_start event so obs_report can price a run without re-deriving collective
 internals.
+
+The CONTINUOUS half (docs/observability.md: "what is happening", not
+"what happened") rides the same three layers: schema v3 appends fixed-K
+log-magnitude histograms of the emitted update and the error carry to
+the jitted metrics vector (``log_magnitude_histogram``, gated by
+``RoundConfig.telemetry_hist``), and ``WatchEngine`` evaluates
+declarative threshold + EWMA-drift rules over each DRAINED round record
+(``RunTelemetry.on_drained``) — host arithmetic on already-materialized
+values, zero extra syncs — emitting immediate ``watch_alert`` events
+with a log / trace-next-N-rounds (``profiling.RoundTracer``) /
+force-checkpoint reaction ladder.
 """
 
 from __future__ import annotations
@@ -57,18 +68,29 @@ import json
 import math
 import os
 import time
-from typing import Any, Dict, Iterator, Optional
+from typing import (
+    Any, Dict, Iterator, List, NamedTuple, Optional, Sequence, Tuple,
+)
 
 import jax
 import jax.numpy as jnp
 
 __all__ = [
     "METRIC_FIELDS",
+    "HIST_BINS",
+    "HIST_LO",
+    "HIST_STEP",
+    "metric_schema",
+    "log_magnitude_histogram",
     "device_round_metrics",
     "collective_ledger",
     "RunTelemetry",
     "attach_run_telemetry",
     "read_events",
+    "WatchRule",
+    "WatchEngine",
+    "parse_watch_rules",
+    "DEFAULT_WATCH_RULES",
 ]
 
 
@@ -105,6 +127,22 @@ __all__ = [
 #     fields) and v2 logs (12) disagree only in the tail — readers
 #     (obs_report.py, aggregator.finish_round's zip) key fields by the
 #     run_start schema list, so both versions parse.
+#   update_hist_* / error_hist_* — SCHEMA v3 (the continuous-observability
+#     PR): fixed-K log-magnitude histograms of the emitted update and the
+#     post-round error carry, appended AFTER dres_norm so v1 (11-field)
+#     and v2 (12-field) logs disagree only in the tail, exactly like the
+#     v1→v2 append. Bin i of log_magnitude_histogram counts elements with
+#     |x| in [10^(HIST_LO + i·HIST_STEP), 10^(HIST_LO + (i+1)·HIST_STEP))
+#     — zeros excluded (update_nnz already carries them), underflow/
+#     overflow clamped into the edge bins, non-finite values counted in
+#     the LAST bin (a poisoned round's histogram shows its mass at the
+#     top). Scalar norms cannot show threshold drift (the emitted-update
+#     mass sliding toward the threshold bin) or sketch-estimation fidelity
+#     decay (error-carry mass climbing bins); the histograms can, online,
+#     and they are still pure reductions riding the same batched drain.
+HIST_BINS = 8
+HIST_LO = -12.0   # log10 of the first finite bin's lower edge
+HIST_STEP = 2.0   # decades per bin: bins span 1e-12 .. 1e4
 METRIC_FIELDS = (
     "transmit_norm",
     "transmit_max_abs",
@@ -118,15 +156,57 @@ METRIC_FIELDS = (
     "ps_max_abs",
     "guard_ok",
     "dres_norm",
-)
+) + tuple(f"update_hist_{i}" for i in range(HIST_BINS)) \
+  + tuple(f"error_hist_{i}" for i in range(HIST_BINS))
+
+# the scalar (pre-histogram) prefix — v2's schema, and the vector length
+# when the histogram block is disabled (--no_telemetry_hist)
+N_SCALAR_FIELDS = 12
 
 
-def device_round_metrics(transmit, update, new_ps, state, guard_ok=None):
-    """The jit-side half: one ``(len(METRIC_FIELDS),)`` f32 device vector
-    from arrays the server phase already holds. Pure reductions — nothing
-    here feeds back into the state transition, which is what makes the
-    telemetry-on trajectory bit-identical to telemetry-off
-    (tests/test_telemetry.py pins it on both server planes)."""
+def metric_schema(hists: bool = True) -> Tuple[str, ...]:
+    """The ACTIVE metric schema of a run: the full v3 field tuple with the
+    histogram block on, the 12-field v2 prefix without. run_start records
+    this list verbatim and every reader keys metrics by name, which is the
+    whole cross-version parse contract (v1/v2/v3 logs all render)."""
+    return METRIC_FIELDS if hists else METRIC_FIELDS[:N_SCALAR_FIELDS]
+
+
+def log_magnitude_histogram(x):
+    """``(HIST_BINS,)`` f32 counts of ``|x|`` over fixed log10-magnitude
+    bins (edges ``10**(HIST_LO + i*HIST_STEP)``). Zeros are excluded,
+    under/overflow clamp into the edge bins, and non-finite elements land
+    in the last bin. Pure device reductions + one tiny scatter-add —
+    nothing feeds back into the state transition."""
+    ax = jnp.abs(x.astype(jnp.float32)).reshape(-1)
+    # != 0 (the update_nnz idiom), NOT > 0: NaN compares false under >
+    # and a poisoned round's NaN elements must land in the last bin, not
+    # silently vanish from the distribution
+    nz = ax != 0
+    # log10 of zeros would be -inf; substitute 1.0 (bin of it is discarded
+    # by the nz weight below)
+    e = (jnp.log10(jnp.where(nz, ax, 1.0)) - HIST_LO) / HIST_STEP
+    idx = jnp.clip(jnp.floor(e), 0, HIST_BINS - 1).astype(jnp.int32)
+    # non-finite |x| (a poisoned round): clip/floor of NaN is NaN and its
+    # int cast is undefined — pin those elements to the last bin instead
+    idx = jnp.where(jnp.isfinite(ax), idx, HIST_BINS - 1)
+    return jnp.zeros(HIST_BINS, jnp.float32).at[idx].add(
+        nz.astype(jnp.float32))
+
+
+def device_round_metrics(transmit, update, new_ps, state, guard_ok=None,
+                         hists: bool = False):
+    """The jit-side half: one ``(len(metric_schema(hists)),)`` f32 device
+    vector from arrays the server phase already holds. Pure reductions —
+    nothing here feeds back into the state transition, which is what makes
+    the telemetry-on trajectory bit-identical to telemetry-off
+    (tests/test_telemetry.py pins it on both server planes; the v3
+    histogram block rides the same contract, tests/test_watch.py).
+
+    ``hists`` appends the schema-v3 log-magnitude histogram block (the
+    emitted update's and the post-round error carry's
+    ``log_magnitude_histogram``) — online visibility into threshold drift
+    and sketch-estimation fidelity that scalar norms cannot show."""
 
     def l2(x):
         return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
@@ -151,7 +231,10 @@ def device_round_metrics(transmit, update, new_ps, state, guard_ok=None):
         l2(state.dres) if state.dres is not None else jnp.float32(0.0),
     )
     out = jnp.stack([jnp.asarray(v, jnp.float32).reshape(()) for v in vals])
-    assert out.shape == (len(METRIC_FIELDS),)
+    if hists:
+        out = jnp.concatenate([out, log_magnitude_histogram(update),
+                               log_magnitude_histogram(state.error)])
+    assert out.shape == (len(metric_schema(hists)),)
     return out
 
 
@@ -267,6 +350,270 @@ def _json_safe(x):
     return x
 
 
+# --------------------------------------------------------------------------
+# Watch / alert rule engine (--watch, docs/observability.md §watch plane)
+# --------------------------------------------------------------------------
+
+class WatchRule(NamedTuple):
+    """One declarative watch rule over the drained metric stream.
+
+    Spec grammar (one rule; rules join with ','):
+
+        METRIC OP BOUND [@N] [->ACTION]
+
+    - ``METRIC``: a metric-schema field name, a round-record span key
+      (``loss``, ``occupancy``, ``dispatch_ms``, ``compute_ms``,
+      ``drain_fetch_ms``), or a derived stream quantity
+      (``rounds_per_sec`` from successive dispatch stamps,
+      ``prefetch_miss`` — 1.0 when the round's offload span records a
+      prefetch miss).
+    - ``OP``: ``>`` or ``<``.
+    - ``BOUND``: a float threshold, or ``ewma*F`` — F times the rule's own
+      exponentially weighted moving average of the metric's history
+      (drift detection; armed only after ``WATCH_WARMUP`` observations).
+    - ``@N``: require N CONSECUTIVE violating rounds before firing
+      (default 1) — slow divergence is a streak, one noisy round is not.
+    - ``->ACTION``: the reaction ladder — ``log`` (default; the
+      ``watch_alert`` JSONL event every alert emits), ``trace[:R]``
+      (additionally request a windowed trace capture of the next R rounds
+      — default WATCH_TRACE_ROUNDS — through the attached
+      profiling.RoundTracer), or ``checkpoint`` (additionally request a
+      run-state checkpoint; the training loop services it at the next
+      round boundary).
+
+    A non-finite observed value violates ANY rule on its metric (NaN/Inf
+    is never healthy; NaN compares false against every bound, so this is
+    explicit)."""
+
+    metric: str
+    op: str                      # '>' | '<'
+    bound: float                 # absolute threshold (ewma_factor == 0)
+    ewma_factor: float           # > 0: bound = factor * EWMA(history)
+    consecutive: int
+    action: str                  # 'log' | 'trace' | 'checkpoint'
+    trace_rounds: int
+    spec: str                    # the source text, logged verbatim
+
+
+WATCH_WARMUP = 5          # observations before an EWMA bound arms
+WATCH_EWMA_ALPHA = 0.25   # EWMA update weight of the newest observation
+WATCH_COOLDOWN = 8        # rounds a fired rule stays silent
+WATCH_TRACE_ROUNDS = 3    # default trace-reaction window length
+
+# The default rule set — the runtime failure modes the continuous-
+# observability PR names (docs/observability.md): loss divergence, the
+# what-tripped transmit blowup, EF-carry blowup (error/qres/dres),
+# resolved-k (threshold) collapse, in-flight occupancy drop, prefetch
+# miss storms, and host rounds/sec regression. Absolute budgets (e.g. a
+# leg_budgets.json rounds/sec floor) go in --watch_rules.
+DEFAULT_WATCH_RULES = (
+    "loss>ewma*4@2->trace",
+    "transmit_norm>ewma*10->trace",
+    "error_norm>ewma*8@3",
+    "qres_norm>ewma*8@3",
+    "dres_norm>ewma*8@3",
+    "update_nnz<ewma*0.25@2",
+    "occupancy<ewma*0.5@4",
+    "prefetch_miss>0.5@8",
+    "rounds_per_sec<ewma*0.5@4",
+)
+
+
+# every name a watch rule may observe: the full v3 metric schema, the
+# round-record span keys, and the derived stream quantities — enumerable
+# at parse time, so a typo'd metric fails AT STARTUP instead of silently
+# never firing for the whole run
+WATCH_METRIC_NAMES = frozenset(METRIC_FIELDS) | {
+    "loss", "occupancy", "dispatch_ms", "compute_ms", "drain_fetch_ms",
+    "dispatch_to_drain_ms", "rounds_per_sec", "prefetch_miss",
+}
+
+
+def parse_watch_rules(spec: str) -> List[WatchRule]:
+    """Parse a ','-joined rule spec (see WatchRule). Empty/whitespace
+    entries are skipped; a malformed entry — including an unknown metric
+    name — raises at parse time: config errors must fail at startup, not
+    rounds into a run."""
+    rules = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        body, action, trace_rounds = part, "log", WATCH_TRACE_ROUNDS
+        if "->" in body:
+            body, act = body.split("->", 1)
+            act = act.strip()
+            if act.startswith("trace"):
+                action = "trace"
+                if ":" in act:
+                    trace_rounds = int(act.split(":", 1)[1])
+                    assert trace_rounds >= 1, part
+            elif act in ("log", "checkpoint"):
+                action = act
+            else:
+                raise ValueError(
+                    f"watch rule {part!r}: unknown action {act!r}; use "
+                    "log | trace[:N] | checkpoint")
+        consecutive = 1
+        if "@" in body:
+            body, n = body.rsplit("@", 1)
+            consecutive = int(n)
+            assert consecutive >= 1, part
+        op = ">" if ">" in body else ("<" if "<" in body else None)
+        if op is None:
+            raise ValueError(
+                f"watch rule {part!r}: expected METRIC>BOUND or "
+                "METRIC<BOUND (BOUND a float or ewma*F)")
+        metric, bound_s = (s.strip() for s in body.split(op, 1))
+        assert metric, f"watch rule {part!r}: empty metric name"
+        if metric not in WATCH_METRIC_NAMES:
+            raise ValueError(
+                f"watch rule {part!r}: unknown metric {metric!r}; known "
+                f"names: {', '.join(sorted(WATCH_METRIC_NAMES))}")
+        bound, factor = 0.0, 0.0
+        if bound_s.startswith("ewma"):
+            factor = (float(bound_s.split("*", 1)[1])
+                      if "*" in bound_s else 1.0)
+            assert factor > 0, f"watch rule {part!r}: ewma factor <= 0"
+        else:
+            bound = float(bound_s)
+        rules.append(WatchRule(metric=metric, op=op, bound=bound,
+                               ewma_factor=factor, consecutive=consecutive,
+                               action=action, trace_rounds=trace_rounds,
+                               spec=part))
+    return rules
+
+
+class _RuleState:
+    __slots__ = ("ewma", "n", "consec", "cooldown_until", "fired")
+
+    def __init__(self):
+        self.ewma = 0.0
+        self.n = 0
+        self.consec = 0
+        self.cooldown_until = -1
+        self.fired = 0
+
+
+class WatchEngine:
+    """Evaluate watch rules over the drained metric stream, at ZERO extra
+    host syncs: every value it reads is host data the batched drain
+    already materialized (``RunTelemetry.on_drained`` calls ``observe``
+    with the round record before JSON encoding). Alerts land as immediate
+    ``watch_alert`` JSONL events; the trace reaction requests a windowed
+    round-aligned capture through the attached ``profiling.RoundTracer``,
+    the checkpoint reaction raises ``checkpoint_pending`` for the training
+    loop — the same escalation design as the guard ladder
+    (docs/fault_tolerance.md), but for SLOW failure modes the binary
+    finiteness guard cannot see."""
+
+    def __init__(self, rules: Sequence[WatchRule], telemetry=None,
+                 tracer=None):
+        self.rules = list(rules)
+        self._rt = telemetry
+        self.tracer = tracer
+        self._state = [_RuleState() for _ in self.rules]
+        self._last_dispatch_t: Optional[float] = None
+        self.alerts = 0
+        self.fired: List[Tuple[int, str]] = []   # (round, rule spec)
+        self.checkpoint_pending = False
+
+    def pop_checkpoint(self) -> bool:
+        """True once per pending checkpoint request (the training loop
+        polls this at round boundaries and forces a run-state save)."""
+        pending, self.checkpoint_pending = self.checkpoint_pending, False
+        return pending
+
+    # -- the per-round evaluation ----------------------------------------
+
+    def _value(self, rec: Dict[str, Any], name: str):
+        metrics = rec.get("metrics") or {}
+        if name in metrics:
+            return metrics[name]
+        if name in ("loss", "occupancy", "dispatch_ms", "compute_ms",
+                    "drain_fetch_ms", "dispatch_to_drain_ms"):
+            return rec.get(name)
+        if name == "prefetch_miss":
+            off = rec.get("offload")
+            if not off or "prefetch" not in off:
+                return None
+            return 1.0 if off["prefetch"] == "miss" else 0.0
+        if name == "rounds_per_sec":
+            return rec.get("_rounds_per_sec")
+        return None
+
+    def observe(self, rec: Dict[str, Any]) -> None:
+        """Evaluate every rule against one drained round record."""
+        round_no = rec.get("round", -1)
+        # derived stream quantity: host rounds/sec from successive
+        # dispatch wall stamps (batched drains deliver per-round stamps)
+        t_disp = rec.get("t_dispatch")
+        if t_disp is not None:
+            if self._last_dispatch_t is not None \
+                    and t_disp > self._last_dispatch_t:
+                rec["_rounds_per_sec"] = 1.0 / (t_disp
+                                                - self._last_dispatch_t)
+            self._last_dispatch_t = t_disp
+        for rule, st in zip(self.rules, self._state):
+            raw = self._value(rec, rule.metric)
+            if raw is None or isinstance(raw, bool):
+                continue
+            try:
+                v = float(raw)
+            except (TypeError, ValueError):
+                continue
+            finite = math.isfinite(v)
+            if rule.ewma_factor > 0:
+                armed = st.n >= WATCH_WARMUP
+                bound = rule.ewma_factor * st.ewma
+                if finite:
+                    st.ewma = (v if st.n == 0 else
+                               (1 - WATCH_EWMA_ALPHA) * st.ewma
+                               + WATCH_EWMA_ALPHA * v)
+                    st.n += 1
+                if not armed:
+                    continue
+            else:
+                bound = rule.bound
+            violated = (not finite) or (v > bound if rule.op == ">"
+                                        else v < bound)
+            if round_no <= st.cooldown_until:
+                continue
+            if not violated:
+                st.consec = 0
+                continue
+            st.consec += 1
+            if st.consec < rule.consecutive:
+                continue
+            self._fire(rule, st, round_no, v, bound)
+        rec.pop("_rounds_per_sec", None)
+
+    def _fire(self, rule: WatchRule, st: _RuleState, round_no: int,
+              value: float, bound: float) -> None:
+        st.consec = 0
+        st.cooldown_until = round_no + WATCH_COOLDOWN
+        st.fired += 1
+        self.alerts += 1
+        self.fired.append((round_no, rule.spec))
+        traced = False
+        if rule.action == "trace" and self.tracer is not None:
+            # round-aligned reaction: capture the next N submitted rounds
+            # (profiling.RoundTracer names the dir by the actual global
+            # round_no it starts at)
+            traced = self.tracer.request(rule.trace_rounds)
+        if rule.action == "checkpoint":
+            self.checkpoint_pending = True
+        if self._rt is not None:
+            self._rt.event(
+                "watch_alert", round=round_no, rule=rule.spec,
+                metric=rule.metric, value=value, bound=bound,
+                fire=st.fired, action=rule.action,
+                **({"trace_requested": traced}
+                   if rule.action == "trace" else {}))
+        print(f"WATCH alert at round {round_no}: {rule.spec} "
+              f"(value {value:g}, bound {bound:g}, action {rule.action})")
+
+
 class RunTelemetry:
     """The host-side recorder: buffers per-round spans in memory and writes
     one JSONL line per drained round (plus immediate lines for lifecycle
@@ -280,7 +627,8 @@ class RunTelemetry:
     log — obs_report on a crashed run is a design goal, not a corner case.
     """
 
-    def __init__(self, path: str, run_info: Optional[dict] = None):
+    def __init__(self, path: str, run_info: Optional[dict] = None,
+                 schema: Optional[Sequence[str]] = None):
         self.path = path
         parent = os.path.dirname(path)
         if parent:
@@ -290,8 +638,18 @@ class RunTelemetry:
         self.rounds = 0
         self.events = 0
         self._closed = False
-        self.event("run_start", schema=list(METRIC_FIELDS),
-                    **(run_info or {}))
+        # the watch/alert rule engine, when attached
+        # (attach_run_telemetry): evaluated over each drained round record
+        # in on_drained — host arithmetic on already-materialized values,
+        # zero extra syncs
+        self.watch: Optional[WatchEngine] = None
+        # `schema` is THE active metric schema of this run (v2's 12-field
+        # prefix without the histogram block, the full v3 list with it) —
+        # recorded verbatim so readers key fields by name across versions
+        self.event("run_start",
+                   schema=list(schema if schema is not None
+                               else METRIC_FIELDS),
+                   **(run_info or {}))
 
     # -- immediate events --------------------------------------------------
 
@@ -372,6 +730,13 @@ class RunTelemetry:
         self._f.flush()
         self.rounds += 1
         self.events += 1
+        if self.watch is not None:
+            # the watch plane evaluates AFTER the round line lands, so its
+            # watch_alert events follow the round they describe in the log
+            # (obs_report --follow renders them in that order); rec still
+            # holds raw floats here — non-finite values reach the rules as
+            # real NaN/Inf, not the JSON string encoding
+            self.watch.observe(rec)
 
     def close(self, **totals) -> None:
         if self._closed:
@@ -399,10 +764,32 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
     """Entrypoint hook (cv_train/gpt2_train): build the per-run recorder,
     log the static collective ledger in run_start, and hand the recorder to
     the model (``FedModel.finish_round`` records drained metrics through
-    it; the engine picks it up via ``model.telemetry`` for spans). Returns
-    None when ``--no_telemetry``."""
+    it; the engine picks it up via ``model.telemetry`` for spans). Also
+    attaches the round-scoped trace capturer (``--trace_rounds`` windows,
+    plus the watch plane's trace reaction — ``model.tracer``, picked up by
+    the engine) and the watch/alert rule engine (``--watch``, default ON;
+    rules from ``--watch_rules`` or DEFAULT_WATCH_RULES). Returns None
+    when ``--no_telemetry`` (the tracer still attaches: a profiler window
+    is independent of the event log)."""
+    from commefficient_tpu.profiling import RoundTracer, parse_trace_rounds
+
+    trace_spec = (getattr(args, "trace_rounds", "") or "").strip()
+    watch_on = bool(getattr(args, "watch", False))
+    tracer = None
+    if trace_spec or (watch_on and getattr(args, "telemetry", False)):
+        # the watch plane's trace reaction needs a tracer even with no
+        # static --trace_rounds windows; an idle tracer is one integer
+        # compare per submitted round
+        tracer = RoundTracer(log_dir,
+                             windows=parse_trace_rounds(trace_spec))
+        fed_model.tracer = tracer
+        if trace_spec:
+            print(f"trace_rounds: windowed round-aligned capture(s) "
+                  f"{trace_spec} -> {log_dir}/trace_round_* "
+                  "(docs/observability.md)")
     if not getattr(args, "telemetry", False):
         return None
+    hists = bool(getattr(args, "telemetry_hist", False))
     path = os.path.join(log_dir, "telemetry.jsonl")
     # the RESOLVED per-leg plan (explicit spec, the auto-tune probe's
     # pick, or the legacy --reduce_dtype alias — aggregator._resolve_plan)
@@ -473,10 +860,25 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
     if getattr(fed_model, "plan_report", None):
         # the auto-tune probe's per-{leg x dtype} rel_err/probe_ms/bytes
         run_info["collective_plan_probe"] = fed_model.plan_report
-    rt = RunTelemetry(path, run_info=run_info)
+    # continuous-observability config (docs/observability.md): the active
+    # metric schema version, the resolved watch rules, and any static
+    # trace windows — same reproducible-from-the-header contract as the
+    # participation/collective-plan configs above
+    run_info["telemetry_hist"] = hists
+    rule_spec = (getattr(args, "watch_rules", "") or "").strip()
+    rules = (parse_watch_rules(rule_spec) if rule_spec
+             else parse_watch_rules(",".join(DEFAULT_WATCH_RULES)))
+    run_info["watch"] = ([r.spec for r in rules] if watch_on else None)
+    if trace_spec:
+        run_info["trace_rounds"] = trace_spec
+    rt = RunTelemetry(path, run_info=run_info, schema=metric_schema(hists))
+    if watch_on:
+        rt.watch = WatchEngine(rules, telemetry=rt, tracer=tracer)
     fed_model.telemetry = rt
     print(f"telemetry: run event log -> {path} "
-          "(docs/observability.md; --no_telemetry disables)")
+          "(docs/observability.md; --no_telemetry disables"
+          + (f"; watch plane ON, {len(rules)} rules — --no_watch disables"
+             if watch_on else "") + ")")
     return rt
 
 

@@ -137,6 +137,10 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
             drain_every=getattr(args, "metrics_drain_every", 8))
         nan_loss = False
         save_every = int(getattr(args, "checkpoint_every_rounds", 0) or 0)
+        # watch plane (telemetry.WatchEngine, docs/observability.md): the
+        # checkpoint reaction is serviced HERE — the engine drains and the
+        # entrypoint owns save_round_state, mirroring the save_every path
+        watch = getattr(getattr(model, "telemetry", None), "watch", None)
 
         def consume(results):
             nonlocal nan_loss, client_download, client_upload
@@ -164,7 +168,21 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
                 consume(engine.submit(batch))
                 if nan_loss:
                     return np.nan, np.nan, np.nan, np.nan
-                if save_every and (i0 + i + 1) % save_every == 0:
+                do_save = bool(save_every
+                               and (i0 + i + 1) % save_every == 0)
+                forced = False
+                if watch is not None and watch.pop_checkpoint():
+                    # the watch checkpoint reaction: force a run-state
+                    # save at this round boundary (a resumable save needs
+                    # the no-prefetch-thread constraint, like
+                    # --checkpoint_every_rounds — validate_args noted it)
+                    if args.train_dataloader_workers == 0:
+                        do_save = forced = True
+                    else:
+                        print("watch: checkpoint reaction skipped (needs "
+                              "--train_dataloader_workers 0 for a "
+                              "resumable save)")
+                if do_save:
                     # drain the in-flight window first: the saved sampler /
                     # RNG position must describe exactly the rounds whose
                     # state AND metrics are folded into the checkpoint
@@ -186,7 +204,9 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
                         model.telemetry.event(
                             "checkpoint", epoch=epoch,
                             round=model.rounds_dispatched - 1,
-                            round_in_epoch=i0 + i + 1)
+                            round_in_epoch=i0 + i + 1,
+                            **({"forced_by_watch": True} if forced
+                               else {}))
                 if args.do_test:
                     break
             consume(engine.drain())
@@ -456,6 +476,13 @@ def main(argv=None):
             expired = pc.expire_pending()
             if expired and rt is not None:
                 rt.event("straggler_expired", count=expired)
+        tracer = getattr(fed_model, "tracer", None)
+        if tracer is not None:
+            # a capture window left open at run end stops here; its
+            # (partial) record still lands in the event log
+            cap = tracer.close()
+            if cap is not None and rt is not None:
+                rt.event("trace_captured", **cap)
         if rt is not None:
             rt.close()
     fed_model.finalize()

@@ -115,6 +115,10 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
                                    client_upload)
         losses.extend(np.asarray(ex.get("losses", [])).tolist())
         save_every = int(getattr(args, "checkpoint_every_rounds", 0) or 0)
+        # watch plane (telemetry.WatchEngine, docs/observability.md): the
+        # checkpoint reaction is serviced at round boundaries, mirroring
+        # the save_every path (cv_train.run_batches precedent)
+        watch = getattr(getattr(model, "telemetry", None), "watch", None)
         # Pipelined round engine (federated/engine.py): rounds are
         # dispatched sync-free and metrics arrive in batches of
         # --metrics_drain_every, so logger rows are appended at drain time.
@@ -170,7 +174,20 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
                 meta_by_round[engine.rounds_submitted - 1] = (
                     i0 + batch_idx + 1, lr_scheduler.get_last_lr()[0])
                 consume(done)
-                if save_every and (i0 + batch_idx + 1) % save_every == 0:
+                do_save = bool(save_every
+                               and (i0 + batch_idx + 1) % save_every == 0)
+                forced = False
+                if watch is not None and watch.pop_checkpoint():
+                    # watch checkpoint reaction: force a run-state save
+                    # at this round boundary (resumable only without a
+                    # prefetch thread — same constraint as save_every)
+                    if args.train_dataloader_workers == 0:
+                        do_save = forced = True
+                    else:
+                        print("watch: checkpoint reaction skipped (needs "
+                              "--train_dataloader_workers 0 for a "
+                              "resumable save)")
+                if do_save:
                     # drain the in-flight window so the saved sampler/RNG
                     # position matches the rounds folded into the state
                     consume(engine.drain())
@@ -188,7 +205,9 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
                         model.telemetry.event(
                             "checkpoint", epoch=epoch or 0,
                             round=model.rounds_dispatched - 1,
-                            round_in_epoch=i0 + batch_idx + 1)
+                            round_in_epoch=i0 + batch_idx + 1,
+                            **({"forced_by_watch": True} if forced
+                               else {}))
             consume(engine.drain())
         finally:
             prof.close()
@@ -466,6 +485,13 @@ def train(argv=None):
                 expired = pc.expire_pending()
                 if expired and rt is not None:
                     rt.event("straggler_expired", count=expired)
+            tracer = getattr(fed_model, "tracer", None)
+            if tracer is not None:
+                # a capture window left open at run end stops here; its
+                # (partial) record still lands in the event log
+                cap = tracer.close()
+                if cap is not None and rt is not None:
+                    rt.event("trace_captured", **cap)
             if rt is not None:
                 rt.close()
     fed_model.finalize()

@@ -23,6 +23,14 @@
 #     --telemetry on/off on BOTH planes) and its strict zero-host-sync
 #     audit with guards+telemetry through the engine
 #     (tests/test_telemetry.py, docs/observability.md);
+#   - the continuous-observability plane (tests/test_watch.py,
+#     docs/observability.md): the schema-v3 histogram block's fp32
+#     bit-identity on/off on BOTH planes, the strict zero-host-sync
+#     audit with guards + telemetry + histograms + watch through the
+#     engine, watch-rule grammar/EWMA/reaction contracts, an injected
+#     fault's alert + round-aligned triggered trace capture reproduced
+#     from the JSONL alone, v1/v2/v3 schema cross-parse, and the
+#     obs_report --follow torn-tail live reader + --compare delta table;
 #   - the per-leg compressed-collective plan (--collective_plan,
 #     docs/compressed_collectives.md): the fp32 plan bit-identical to the
 #     legacy --reduce_dtype path across both planes x both epilogues, the
@@ -56,6 +64,7 @@ exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_sharded_server.py tests/test_fused_epilogue.py \
     tests/test_stream_sketch.py tests/test_sketch_coalesce.py \
-    tests/test_telemetry.py tests/test_compressed_collectives.py \
+    tests/test_telemetry.py tests/test_watch.py \
+    tests/test_compressed_collectives.py \
     tests/test_participation.py tests/test_host_offload.py \
     -q -m "not slow" -p no:cacheprovider "$@"

@@ -19,14 +19,27 @@ with ``--telemetry``, the default), print
 
 The LAST line of output is always one machine-readable JSON object
 (``summary_dict``) so bench/CI can consume the numbers without parsing
-prose — same contract as bench.py's one-JSON-line stdout.
+prose — same contract as bench.py's one-JSON-line stdout. The tail
+carries ``alerts`` (count + worst watch rule) and the schema-v3
+histogram summaries so CI can gate on them without parsing the report
+body.
 
 Usage:
     python scripts/obs_report.py RUN_DIR_OR_JSONL [--json]
+    python scripts/obs_report.py RUN_DIR_OR_JSONL --follow [--interval S]
+    python scripts/obs_report.py --compare RUN_A RUN_B
 
 ``--json`` suppresses the human report and prints only the JSON tail.
-A SIGKILL'd run's log is readable too (lines are flushed as written and a
-torn trailing line is skipped by the reader).
+``--follow`` live-tails a run IN PROGRESS: a refreshing round table +
+active watch alerts, re-rendered as flushed lines land (the torn-tail
+buffering reader makes this safe on a live file — a partially written
+line is held until its newline arrives). ``--compare A B`` prints a
+span/metric delta table between two run logs (A/B legs). A SIGKILL'd
+run's log is readable too (lines are flushed as written and a torn
+trailing line is skipped by the reader).
+
+Events with an unknown ``ev`` kind (logs from a newer schema) are
+SKIPPED, never a crash — a report tool must read forward-compatible.
 """
 
 from __future__ import annotations
@@ -68,22 +81,47 @@ def _fin(x):
 
 
 def load_events(path: str) -> List[dict]:
-    """Accept either the jsonl file or a run dir containing one."""
+    """Accept either the jsonl file or a run dir containing one.
+    Records without an ``ev`` kind are dropped here — every consumer
+    below keys on it, and a malformed line must never crash a report."""
     if os.path.isdir(path):
         path = os.path.join(path, "telemetry.jsonl")
-    return list(read_events(path))
+    return [e for e in read_events(path)
+            if isinstance(e, dict) and "ev" in e]
+
+
+def _hist_summary(rounds: List[dict], prefix: str):
+    """Schema-v3 histogram digest over the drained rounds: per-bin mean
+    counts + the modal bin. Name-keyed off the metrics dicts, so v1/v2
+    logs (no hist fields) simply return None."""
+    names = sorted({k for e in rounds for k in (e.get("metrics") or {})
+                    if k.startswith(prefix)},
+                   key=lambda k: int(k.rsplit("_", 1)[1]))
+    if not names:
+        return None
+    means = []
+    for name in names:
+        vals = [e["metrics"][name] for e in rounds
+                if name in (e.get("metrics") or {})
+                and isinstance(e["metrics"][name], (int, float))
+                and math.isfinite(e["metrics"][name])]
+        means.append(round(sum(vals) / len(vals), 2) if vals else 0.0)
+    modal = max(range(len(means)), key=lambda i: means[i]) if means \
+        else None
+    return {"mean_counts": means, "modal_bin": modal,
+            "bins": len(names)}
 
 
 def summarize(events: List[dict]) -> Dict[str, Any]:
     """The machine-readable digest: everything the human report prints,
     as one dict (tests compare this against the live run's counters)."""
-    run_info = next((e for e in events if e["ev"] == "run_start"), {})
-    rounds = [e for e in events if e["ev"] == "round"]
-    trips = [e for e in events if e["ev"] == "guard_trip"]
-    rollbacks = [e for e in events if e["ev"] == "rollback"]
-    fatals = [e for e in events if e["ev"] == "guard_fatal"]
-    drains = [e for e in events if e["ev"] == "drain"]
-    run_end = next((e for e in events if e["ev"] == "run_end"), None)
+    run_info = next((e for e in events if e.get("ev") == "run_start"), {})
+    rounds = [e for e in events if e.get("ev") == "round"]
+    trips = [e for e in events if e.get("ev") == "guard_trip"]
+    rollbacks = [e for e in events if e.get("ev") == "rollback"]
+    fatals = [e for e in events if e.get("ev") == "guard_fatal"]
+    drains = [e for e in events if e.get("ev") == "drain"]
+    run_end = next((e for e in events if e.get("ev") == "run_end"), None)
 
     tripped_rounds = sorted(
         {e["round"] for e in trips}
@@ -135,7 +173,7 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
             retry_ladder[str(attempt)] = retry_ladder.get(str(attempt),
                                                           0) + 1
     expired = sum(e.get("count", 0) for e in events
-                  if e["ev"] == "straggler_expired")
+                  if e.get("ev") == "straggler_expired")
     participation = {
         "participation": run_info.get("participation"),
         "sampling": run_info.get("participation_sampling"),
@@ -194,10 +232,33 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
                  if "scatter_io_ms" in o], 0.5)),
         }
 
+    # Watch/alert plane (telemetry.WatchEngine, docs/observability.md):
+    # the alert history rebuilt from the immediate watch_alert events —
+    # count + worst rule (most fires) in the machine tail so CI can gate
+    # without parsing the report body.
+    alert_events = [e for e in events if e.get("ev") == "watch_alert"]
+    by_rule: Dict[str, int] = {}
+    for e in alert_events:
+        rule = str(e.get("rule"))
+        by_rule[rule] = by_rule.get(rule, 0) + 1
+    worst = max(by_rule, key=by_rule.get) if by_rule else None
+    alerts = {
+        "count": len(alert_events),
+        "worst_rule": worst,
+        "worst_rule_count": by_rule.get(worst, 0) if worst else 0,
+        "by_rule": by_rule,
+        "rounds": [e.get("round") for e in alert_events],
+        "rules": run_info.get("watch"),
+    }
+    trace_captures = [
+        {"round_start": e.get("round_start"),
+         "round_until": e.get("round_until"), "dir": e.get("dir")}
+        for e in events if e.get("ev") == "trace_captured"]
+
     return {
         "log_rounds": len(rounds),
         "partial_rounds": len([e for e in events
-                               if e["ev"] == "round_partial"]),
+                               if e.get("ev") == "round_partial"]),
         "run_complete": run_end is not None,
         "mode": run_info.get("mode"),
         "grad_size": run_info.get("grad_size"),
@@ -220,9 +281,9 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         "rollbacks": len(rollbacks),
         "rollback_rounds": [e["round"] for e in rollbacks],
         "fatal": len(fatals) > 0,
-        "checkpoints": len([e for e in events if e["ev"] == "checkpoint"]),
-        "resumes": len([e for e in events if e["ev"] == "resume"]),
-        "epochs": len([e for e in events if e["ev"] == "epoch"]),
+        "checkpoints": len([e for e in events if e.get("ev") == "checkpoint"]),
+        "resumes": len([e for e in events if e.get("ev") == "resume"]),
+        "epochs": len([e for e in events if e.get("ev") == "epoch"]),
         "mean_participants": _fin(_mean(
             [e["cohort"]["participants"] for e in rounds
              if "cohort" in e])),
@@ -252,6 +313,14 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         "participation": participation,
         "host_offload": host_offload,
         "ledger": ledger_totals,
+        # continuous-observability additions (schema v3 + watch plane)
+        "metric_schema_len": len(run_info.get("schema", []) or []) or None,
+        "alerts": alerts,
+        "trace_captures": trace_captures,
+        "histograms": {
+            "update": _hist_summary(rounds, "update_hist_"),
+            "error": _hist_summary(rounds, "error_hist_"),
+        },
     }
 
 
@@ -262,8 +331,8 @@ def render(events: List[dict], out=None) -> Dict[str, Any]:
     # by the time another test calls render)
     out = out if out is not None else sys.stdout
     s = summarize(events)
-    rounds = [e for e in events if e["ev"] == "round"]
-    run_info = next((e for e in events if e["ev"] == "run_start"), {})
+    rounds = [e for e in events if e.get("ev") == "round"]
+    run_info = next((e for e in events if e.get("ev") == "run_start"), {})
     p = lambda *a: print(*a, file=out)  # noqa: E731
 
     p("# Run summary")
@@ -322,6 +391,44 @@ def render(events: List[dict], out=None) -> Dict[str, Any]:
                 else "n/a (pre-dres schema log)")
         p(f"quantized-collective EF carries: mean qres (uplink) "
           f"{s['mean_qres_norm'] or 0:.3g}, mean dres (downlink) {dres}")
+    hists = s.get("histograms") or {}
+    if hists.get("update") or hists.get("error"):
+        p("\n## Update / error-carry magnitude histograms (schema v3)")
+        p("log10-magnitude bins (docs/observability.md: bin i spans "
+          "10^(-12+2i) .. 10^(-10+2i); last bin holds overflow + "
+          "non-finite), mean counts over drained rounds:")
+        for key, label in (("update", "emitted update"),
+                           ("error", "error carry")):
+            h = hists.get(key)
+            if h:
+                counts = " ".join(f"{v:g}" for v in h["mean_counts"])
+                p(f"- {label}: [{counts}]  (modal bin {h['modal_bin']})")
+
+    al = s.get("alerts") or {}
+    if al.get("count") or (al.get("rules") is not None):
+        p("\n## Watch / alert history (docs/observability.md "
+          "§watch plane)")
+        if al.get("rules") is not None:
+            p(f"{len(al['rules'])} rules armed")
+        if al.get("count"):
+            p(f"{al['count']} alert(s); worst rule: {al['worst_rule']} "
+              f"({al['worst_rule_count']} fires)")
+            for e in (x for x in events if x.get("ev") == "watch_alert"):
+                extra = ""
+                if e.get("action") == "trace":
+                    extra = (" -> trace requested"
+                             if e.get("trace_requested")
+                             else " -> trace (no tracer)")
+                elif e.get("action") == "checkpoint":
+                    extra = " -> checkpoint forced"
+                p(f"- ALERT at round {e.get('round')}: {e.get('rule')} "
+                  f"(value {e.get('value')}, bound {e.get('bound')})"
+                  f"{extra}")
+        else:
+            p("no alerts fired")
+    for cap in s.get("trace_captures") or []:
+        p(f"- trace captured: rounds {cap['round_start']}-"
+          f"{cap['round_until']} -> {cap['dir']}")
 
     part = s["participation"]
     if (part.get("client_fault") or part.get("cohort_target") is not None
@@ -387,22 +494,22 @@ def render(events: List[dict], out=None) -> Dict[str, Any]:
     p("\n## Guard / rollback history")
     if not s["guards"]:
         p("guards were OFF for this run")
-    trips = [e for e in events if e["ev"] == "guard_trip"]
+    trips = [e for e in events if e.get("ev") == "guard_trip"]
     if trips or s["tripped_rounds"]:
         for e in trips:
             p(f"- guard TRIP at round {e['round']} "
               f"(trip {e.get('trip')}, consecutive {e.get('consecutive')})")
-        for e in (x for x in events if x["ev"] == "rollback"):
+        for e in (x for x in events if x.get("ev") == "rollback"):
             p(f"- ROLLBACK to last-good snapshot at round {e['round']} "
               f"({e.get('consecutive')} consecutive trips)")
-        for e in (x for x in events if x["ev"] == "guard_fatal"):
+        for e in (x for x in events if x.get("ev") == "guard_fatal"):
             p(f"- FATAL guard escalation at round {e['round']}")
         p(f"tripped rounds (from trip events + drained verdicts): "
           f"{s['tripped_rounds']}")
     else:
         p("no guard trips recorded")
 
-    other = [e for e in events if e["ev"] in ("checkpoint", "resume",
+    other = [e for e in events if e.get("ev") in ("checkpoint", "resume",
                                               "epoch")]
     if other:
         p("\n## Lifecycle events")
@@ -412,14 +519,199 @@ def render(events: List[dict], out=None) -> Dict[str, Any]:
     return s
 
 
+class LiveReader:
+    """Incremental torn-tail-safe JSONL reader for a file being appended
+    to by a LIVE run. Unlike ``read_events`` (which STOPS at a torn
+    trailing line — correct for a dead run's log), this reader buffers an
+    incomplete trailing line and resumes the moment its newline lands, so
+    ``--follow`` never drops the round that was mid-write at poll time.
+    A COMPLETE line that still fails to parse (disk corruption) is
+    skipped, never fatal."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pos = 0
+        self._buf = ""
+
+    def poll(self) -> List[dict]:
+        events: List[dict] = []
+        try:
+            with open(self.path) as f:
+                f.seek(self._pos)
+                data = f.read()
+                self._pos = f.tell()
+        except OSError:
+            return events
+        self._buf += data
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "ev" in rec:
+                events.append(rec)
+        return events
+
+
+def _fmt(v, nd=3):
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return "-" if v is None else str(v)
+
+
+def follow(path: str, out=None, interval: float = 2.0,
+           tail_rounds: int = 12, max_iters: int = 0,
+           clear: bool | None = None) -> int:
+    """Live-tail a run's event log: a refreshing table of the most recent
+    drained rounds + active watch alerts, re-rendered as flushed lines
+    land. Exits when the run_end event arrives (prints the final machine
+    tail) or on Ctrl-C. ``max_iters`` bounds the poll loop for tests
+    (0 = until run_end/interrupt)."""
+    import time as _time
+
+    out = out if out is not None else sys.stdout
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.jsonl")
+    if clear is None:
+        clear = getattr(out, "isatty", lambda: False)()
+    reader = LiveReader(path)
+    events: List[dict] = []
+    iters = 0
+    ended = False
+    p = lambda *a: print(*a, file=out)  # noqa: E731
+    try:
+        while True:
+            fresh = reader.poll()
+            events.extend(fresh)
+            if fresh or iters == 0:
+                if clear:
+                    out.write("\x1b[2J\x1b[H")
+                run_info = next((e for e in events
+                                 if e.get("ev") == "run_start"), {})
+                rounds = [e for e in events if e.get("ev") == "round"]
+                alerts = [e for e in events
+                          if e.get("ev") == "watch_alert"]
+                p(f"# obs_report --follow {path}")
+                p(f"mode={run_info.get('mode')} "
+                  f"backend={run_info.get('backend')} "
+                  f"rounds drained: {len(rounds)}  alerts: {len(alerts)}")
+                p("| round | loss | guard | k | threshold | err norm | "
+                  "dispatch ms | occ |")
+                p("|---|---|---|---|---|---|---|---|")
+                for e in rounds[-tail_rounds:]:
+                    m = e.get("metrics") or {}
+                    guard = e.get("guard_ok")
+                    p(f"| {e.get('round')} | {_fmt(e.get('loss'))} | "
+                      f"{'ok' if guard in (True, None) else 'TRIP'} | "
+                      f"{_fmt(m.get('update_nnz'), 6)} | "
+                      f"{_fmt(m.get('topk_threshold'))} | "
+                      f"{_fmt(m.get('error_norm'))} | "
+                      f"{_fmt(e.get('dispatch_ms'))} | "
+                      f"{_fmt(e.get('occupancy'))} |")
+                recent = alerts[-6:]
+                if recent:
+                    p("active alerts:")
+                    for a in recent:
+                        p(f"- round {a.get('round')}: {a.get('rule')} "
+                          f"(value {a.get('value')})")
+                for e in fresh:
+                    if e.get("ev") == "trace_captured":
+                        p(f"trace captured: rounds {e.get('round_start')}"
+                          f"-{e.get('round_until')} -> {e.get('dir')}")
+                if hasattr(out, "flush"):
+                    out.flush()
+            if any(e.get("ev") == "run_end" for e in fresh):
+                ended = True
+                break
+            iters += 1
+            if max_iters and iters >= max_iters:
+                break
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+    if events:
+        p(json.dumps(summarize(events), allow_nan=False))
+    return 0 if (ended or events) else 2
+
+
+# the span/metric keys the A/B delta table compares (numeric, flat)
+_COMPARE_KEYS = (
+    "log_rounds", "rounds_per_sec", "dispatch_ms_p50", "compute_ms_p50",
+    "drain_fetch_ms_p50", "dispatch_to_drain_ms_p50", "occupancy_mean",
+    "mean_loss", "mean_update_nnz", "mean_topk_threshold",
+    "mean_error_norm", "wire_bytes_per_round", "guard_trips",
+)
+
+
+def compare(path_a: str, path_b: str, out=None) -> Dict[str, Any]:
+    """Span/metric delta table between two completed run logs (A/B legs:
+    e.g. a feature-flag bench pair). Deltas are B - A (and B/A - 1 where
+    A is nonzero); the machine tail carries both summaries + the
+    deltas."""
+    out = out if out is not None else sys.stdout
+    a, b = summarize(load_events(path_a)), summarize(load_events(path_b))
+    p = lambda *x: print(*x, file=out)  # noqa: E731
+    p(f"# Run comparison\nA: {path_a}\nB: {path_b}")
+    p("| metric | A | B | delta | B/A |")
+    p("|---|---|---|---|---|")
+    deltas: Dict[str, Any] = {}
+    rows = _COMPARE_KEYS + ("alerts",)
+    for key in rows:
+        va = a["alerts"]["count"] if key == "alerts" else a.get(key)
+        vb = b["alerts"]["count"] if key == "alerts" else b.get(key)
+        if not isinstance(va, (int, float)) \
+                and not isinstance(vb, (int, float)):
+            continue
+        delta = (vb - va) if isinstance(va, (int, float)) \
+            and isinstance(vb, (int, float)) else None
+        ratio = (vb / va if isinstance(delta, (int, float)) and va
+                 else None)
+        deltas[key] = delta
+        p(f"| {key} | {_fmt(va, 6)} | {_fmt(vb, 6)} | "
+          f"{_fmt(delta, 4)} | {_fmt(ratio, 4)} |")
+    return {"a": a, "b": b, "delta": deltas}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="telemetry.jsonl (or a run dir holding one)")
+    ap.add_argument("paths", nargs="+",
+                    help="telemetry.jsonl (or a run dir holding one); "
+                         "two paths with --compare")
     ap.add_argument("--json", action="store_true",
                     help="print only the machine-readable JSON summary")
+    ap.add_argument("--follow", action="store_true",
+                    help="live-tail a run in progress (refreshing round "
+                         "table + active alerts; exits at run_end)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--follow poll interval in seconds")
+    ap.add_argument("--compare", action="store_true",
+                    help="A/B span/metric delta table between two run "
+                         "logs (pass exactly two paths)")
     args = ap.parse_args(argv)
+    if args.compare:
+        if len(args.paths) != 2:
+            print("--compare needs exactly two run logs", file=sys.stderr)
+            return 2
+        try:
+            s = compare(args.paths[0], args.paths[1])
+        except OSError as e:
+            print(e, file=sys.stderr)
+            return 2
+        print(json.dumps(s, allow_nan=False))
+        return 0
+    if len(args.paths) != 1:
+        print("exactly one run log expected (two only with --compare)",
+              file=sys.stderr)
+        return 2
+    path = args.paths[0]
+    if args.follow:
+        return follow(path, interval=args.interval)
     try:
-        events = load_events(args.path)
+        events = load_events(path)
     except OSError as e:
         print(e, file=sys.stderr)
         return 2

@@ -76,9 +76,14 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # host_offload_scale prefetch A/B ride the same pending window as the
 # stream/fused/telemetry/downlink/straggler A/Bs — both reuse the
 # headline compile class (docs/host_offload.md).
+# NOTE (continuous-observability PR): the `watch` capture (telemetry +
+# schema-v3 histograms + watch plane) and the tpu_measure watch_ab A/B
+# ride the same pending window as the telemetry A/B — the gate is
+# <= 2% rounds/sec with histograms + watch enabled
+# (docs/observability.md).
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
-coalesce telemetry downlink straggler clients_sweep participation \
-host_offload_scale \
+coalesce telemetry watch downlink straggler clients_sweep participation \
+host_offload_scale watch_ab \
 compressed_collectives stream_sketch sketch_coalesce fused_epilogue \
 learning profile profile_fused profile_stream profile_coalesce \
 profile_gpt2 host_offload imagenet ops"}
@@ -109,7 +114,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|downlink|straggler|clients_sweep)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|watch|downlink|straggler|clients_sweep)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact.
       # `telemetry` is the telemetry-overhead A/B leg: headline geometry
@@ -165,6 +170,22 @@ for step in $STEPS; do
         mark_done profile_fused
       fi
       log "step $i rc=$rc (docs/measurements/tpu_profile_fused.md on success)"
+      ;;
+    watch_ab)
+      # continuous-observability overhead A/B (docs/observability.md):
+      # telemetry scalars (v2) vs scalars + the schema-v3 histogram block,
+      # plus the host-side watch-rule evaluation microbench — gate
+      # <= 2% rounds/sec with histograms + watch enabled
+      log "step $i: tpu_measure.py watch A/B (timeout 30m)"
+      timeout 1800 python scripts/tpu_measure.py watch \
+        >"$OUT/tpu_measure_watch.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_watch.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "histogram block cost" \
+            "$OUT/tpu_measure_watch.log"; then
+        mark_done watch_ab
+      fi
       ;;
     participation)
       # partial-cohort sweep (docs/fault_tolerance.md §client faults):

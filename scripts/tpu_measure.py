@@ -460,6 +460,53 @@ def participation_leg():
               f"(expected ~0 — static shapes)", flush=True)
 
 
+def watch_leg():
+    """Continuous-observability overhead A/B (docs/observability.md):
+    the headline sketched round with telemetry scalars only (schema v2)
+    vs scalars + the v3 histogram block (--telemetry_hist — the device
+    half of histograms + watch), plus the host half timed directly: a
+    WatchEngine with the default rule set evaluating one drained round
+    record. Gate: <= 2% rounds/sec with histograms + watch enabled (the
+    bench `watch` leg is the same A/B vs the no-telemetry headline)."""
+    rows = {}
+    for hist in (False, True):
+        steps, ps, ss, cs, batch = B.build(tiny=False, telemetry=True,
+                                           telemetry_hist=hist)
+        dt, rtt, _ = time_rounds(steps, (ps, ss, cs, {}), batch)
+        rows[hist] = dt
+        print(f"telemetry round ({'v3 hists' if hist else 'v2 scalars'}): "
+              f"{dt * 1e3:.2f} ms ({1 / dt:.1f} r/s), "
+              f"rtt {rtt * 1e3:.0f} ms", flush=True)
+    if len(rows) == 2:
+        delta = rows[True] - rows[False]
+        print(f"histogram block cost: {delta * 1e3:+.3f} ms/round "
+              f"({delta / rows[False] * 100:+.2f}% — gate <= 2%)",
+              flush=True)
+    # the host half: default watch rules over one drained round record
+    # (pure host arithmetic — meant to be negligible next to the round)
+    from commefficient_tpu.telemetry import (
+        DEFAULT_WATCH_RULES,
+        WatchEngine,
+        metric_schema,
+        parse_watch_rules,
+    )
+
+    w = WatchEngine(parse_watch_rules(",".join(DEFAULT_WATCH_RULES)))
+    rec0 = {"round": 0, "loss": 1.0, "occupancy": 2, "dispatch_ms": 1.0,
+            "t_dispatch": 0.0,
+            "metrics": {k: 1.0 for k in metric_schema(True)}}
+    n = 10_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        rec = dict(rec0)
+        rec["round"] = i
+        rec["t_dispatch"] = i * 0.01
+        w.observe(rec)
+    per = (time.perf_counter() - t0) / n
+    print(f"watch rule evaluation ({len(w.rules)} default rules): "
+          f"{per * 1e6:.1f} us/round on host", flush=True)
+
+
 def host_offload_scale_leg():
     """Host-offload data plane at population scale (docs/host_offload.md):
     the headline sketched round with disk-tier (sparse memmap) per-client
@@ -637,7 +684,7 @@ def main():
     known = {"matmul", "cifar", "ops", "gpt2", "imagenet", "topk_ab",
              "fused_epilogue", "stream_sketch", "sketch_coalesce",
              "compressed_collectives", "participation",
-             "host_offload_scale"}
+             "host_offload_scale", "watch"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -678,6 +725,8 @@ def main():
         leg("participation", participation_leg)
     if sel("host_offload_scale"):
         leg("host_offload_scale", host_offload_scale_leg)
+    if sel("watch"):
+        leg("watch", watch_leg)
 
 
 if __name__ == "__main__":

@@ -770,21 +770,25 @@ class TestPlanFedModel:
         """The new dres_norm slot (schema v2) lands nonzero for a
         compressed-downlink run and 0.0 for fp32 — per-round downlink
         drift visibility with zero new host syncs."""
-        from commefficient_tpu.telemetry import METRIC_FIELDS
+        from commefficient_tpu.telemetry import metric_schema
 
-        assert METRIC_FIELDS[-1] == "dres_norm"  # v2: appended LAST
+        # v2: dres_norm appended as the LAST scalar slot (the schema-v3
+        # histogram block appends after it — tests/test_watch.py); these
+        # args carry no telemetry_hist, so the vector is the v2 prefix
+        scalar_fields = metric_schema(False)
+        assert scalar_fields[-1] == "dres_norm"
         fm, opt, _ = self._fed_model(collective_plan="int8",
                                      telemetry=True)
         fm(self._fed_batch())
         opt.step()
         vec = np.asarray(fm._pending_telemetry)
-        assert vec.shape == (len(METRIC_FIELDS),)
-        fields = dict(zip(METRIC_FIELDS, vec))
+        assert vec.shape == (len(scalar_fields),)
+        fields = dict(zip(scalar_fields, vec))
         assert fields["dres_norm"] > 0 and fields["qres_norm"] > 0
 
         fm2, opt2, _ = self._fed_model(telemetry=True)
         fm2(self._fed_batch())
         opt2.step()
-        fields2 = dict(zip(METRIC_FIELDS,
+        fields2 = dict(zip(scalar_fields,
                            np.asarray(fm2._pending_telemetry)))
         assert fields2["dres_norm"] == 0.0 and fields2["qres_norm"] == 0.0

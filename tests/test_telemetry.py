@@ -56,8 +56,13 @@ from commefficient_tpu.telemetry import (
     METRIC_FIELDS,
     RunTelemetry,
     collective_ledger,
+    metric_schema,
     read_events,
 )
+
+# this suite pins the v2 SCALAR contracts (the schema-v3 histogram block
+# is tests/test_watch.py's); the steps here build with telemetry_hist off
+SCALAR_FIELDS = metric_schema(False)
 
 _SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "scripts")
@@ -138,7 +143,7 @@ def _run_trajectory(steps, ps, ss, cs, rounds=4, telemetry=False,
         traj.append(np.asarray(steps.layout.unchunk(state[0])))
         if telemetry:
             tel = out[5 + (1 if guards else 0)]
-            assert tel.shape == (len(METRIC_FIELDS),)
+            assert tel.shape == (len(SCALAR_FIELDS),)
             metrics.append(np.asarray(tel))
     return traj, metrics
 
@@ -162,7 +167,7 @@ class TestNonPerturbation:
         for rnd, (a, b) in enumerate(zip(runs[False], traj)):
             np.testing.assert_array_equal(a, b,
                                           err_msg=f"guarded round {rnd}")
-        fields = dict(zip(METRIC_FIELDS, ms[-1]))
+        fields = dict(zip(SCALAR_FIELDS, ms[-1]))
         assert fields["guard_ok"] == 1.0
         assert fields["update_nnz"] >= 1
         assert fields["ps_norm"] > 0
@@ -281,7 +286,7 @@ class TestSyncAudit:
         rounds = [e for e in events if e["ev"] == "round"]
         assert [e["round"] for e in rounds] == list(range(6))
         for e in rounds:
-            assert set(e["metrics"]) == set(METRIC_FIELDS)
+            assert set(e["metrics"]) == set(SCALAR_FIELDS)
             assert e["guard_ok"] is True
             assert e["metrics"]["guard_ok"] == 1.0
             assert "dispatch_ms" in e and "drain_fetch_ms" in e
@@ -319,7 +324,14 @@ class TestSyncAudit:
         err = capfd.readouterr().err
         lines = [ln for ln in err.splitlines()
                  if ln.startswith("HEARTBEAT")]
-        assert lines == [f"HEARTBEAT round={i}" for i in range(4)], lines
+        # the leading round=N field is the supervisor contract
+        # (crash_matrix parses it); the mean-loss extra appends after it
+        # (guard verdict absent — guards are off here) so a heartbeat
+        # tail is a minimal live monitor even with telemetry off
+        assert [ln.split()[1] for ln in lines] == \
+            [f"round={i}" for i in range(4)], lines
+        assert all(ln.split()[2].startswith("loss=") for ln in lines), \
+            lines
 
 
 class TestEventLog:
