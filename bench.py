@@ -898,6 +898,124 @@ def run_clients_sweep_measurement() -> None:
     print(json.dumps(out), flush=True)
 
 
+def run_io_faults_measurement() -> None:
+    """Child-process entry (--run-cfg io_faults): storage-fault-plane
+    overhead A/B (docs/fault_tolerance.md §storage faults).
+
+    Three legs over the disk-tier gather -> round -> scatter cycle at a
+    10^5-row population (the clients_sweep loop shape): (a) CLEAN — no
+    injection schedule compiled in; (b) IDLE — an all-zero
+    ``--inject_io_fault`` schedule, i.e. the injection seam + retry
+    ladder + watchdog armed but never firing (gate: <= 2% rounds/sec vs
+    clean — the shim must be free when healthy); (c) TRANSIENT — seeded
+    eio/short/torn/stall faults below the retry budget, whose retries
+    must leave the final row state BIT-identical to the clean leg
+    (``io_faults_bit_identical``) while the throughput delta prices what
+    a flaky disk actually costs."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.federated.host_state import (
+        CohortPrefetcher,
+        MemmapRowStore,
+        parse_io_fault,
+    )
+    from commefficient_tpu.federated.rounds import ClientStates
+    from commefficient_tpu.parallel.mesh import default_client_mesh
+
+    _check_pallas_kernel()
+    tiny = jax.default_backend() not in ("tpu", "axon")
+    _copy_rows = jax.jit(jnp.copy)
+    W = NUM_WORKERS
+    mesh = default_client_mesh(W)
+    n = 10_000 if tiny else 100_000
+    iters, reps = (10, 2) if tiny else (20, 3)
+    legs = (
+        ("clean", None),
+        ("idle", "eio=0,short=0,torn=0,stall=0,seed=0"),
+        ("transient",
+         "eio=0.02,short=0.01,torn=0.01,stall=0.01,stall_ms=2,seed=11"),
+    )
+    out = {
+        "io_faults_metric": (
+            "8-worker sketched disk-tier rounds/sec: clean vs injection-"
+            "idle (gate <= 2%) vs seeded transient faults below the "
+            "retry budget (bit-identical rows pinned; "
+            "docs/fault_tolerance.md §storage faults)"),
+        "io_faults_tiny": tiny,
+        "platform": jax.default_backend(),
+    }
+    finals = {}
+    for tag, spec in legs:
+        # per-leg rebuild: train_step donates the state buffers; the
+        # COMPILE is shared through the jit cache
+        steps, ps, server_state, client_states, batch = build(
+            tiny=tiny, error_type="local")
+        row_shape = tuple(int(x) for x in client_states.errors.shape[1:])
+        batch = dict(batch)
+        batch["client_ids"] = jnp.arange(W, dtype=jnp.int32)
+        store_dir = tempfile.mkdtemp(prefix=f"io_faults_{tag}_")
+        store = MemmapRowStore(
+            store_dir, n, {"errors": row_shape}, mesh=mesh,
+            inject=parse_io_fault(spec) if spec else None,
+            io_backoff_ms=0.5)
+        pf = CohortPrefetcher(store.gather_async)
+        rng = np.random.RandomState(7)
+        cohorts = [rng.choice(n, W, replace=False)
+                   for _ in range(iters + 2)]
+
+        def run_rounds(k, ps_, ss_, ms):
+            pf.prefetch(cohorts[0])
+            for i in range(k):
+                stream, _ = pf.take(cohorts[i])
+                old = ClientStates(None, _copy_rows(stream.proxy.errors),
+                                   None)
+                o = steps.train_step(ps_, ss_, stream.proxy, ms, batch,
+                                     0.1, jax.random.key(i))
+                ps_, ss_, new_proxy, ms = o[:4]
+                store.scatter(stream, old, new_proxy)
+                pf.prefetch(cohorts[i + 1])
+            store.drain()
+            jax.block_until_ready(ps_)
+            return ps_, ss_, ms
+
+        state = run_rounds(1, ps, server_state, {})  # compile + warm
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            state = run_rounds(iters, *state)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        rps = iters / best
+        counts = store.io_counters()
+        out[f"io_faults_rounds_per_sec_{tag}"] = round(rps, 4)
+        out[f"io_faults_retries_{tag}"] = counts["retries"]
+        # the final row state, for the bit-identity pin across legs (the
+        # same seeded cohorts + jitted round => identical trajectories)
+        finals[tag] = store.read_full("errors")
+        _log(f"io_faults {tag}: {rps:.2f} rounds/s "
+             f"({counts['retries']} retries, {counts['errors']} "
+             f"exhausted, {counts['quarantined']} quarantined)")
+        store.close()
+        shutil.rmtree(store_dir, ignore_errors=True)
+    clean_rps = out["io_faults_rounds_per_sec_clean"]
+    out["io_faults_idle_vs_clean"] = round(
+        out["io_faults_rounds_per_sec_idle"] / clean_rps, 4)
+    out["io_faults_transient_vs_clean"] = round(
+        out["io_faults_rounds_per_sec_transient"] / clean_rps, 4)
+    out["io_faults_bit_identical"] = bool(
+        np.array_equal(finals["clean"], finals["idle"])
+        and np.array_equal(finals["clean"], finals["transient"]))
+    assert out["io_faults_bit_identical"], (
+        "transient-fault rows diverged from the clean leg — the retry "
+        "ladder is NOT invisible to the trajectory")
+    print(json.dumps(out), flush=True)
+
+
 # --------------------------------------------------------------------------
 # parent orchestration
 # --------------------------------------------------------------------------
@@ -992,6 +1110,11 @@ _EXTRA_LEGS = {
     "clients_sweep": (["--run-cfg", "clients_sweep"],
                       "BENCH_CLIENTS_TIMEOUT", 1800,
                       "clients_sweep_rounds_per_sec_1e6"),
+    # storage-fault plane (docs/fault_tolerance.md §storage faults):
+    # disk-tier rounds/sec clean vs injection-idle (gate <= 2%) vs
+    # seeded transient faults (bit-identical rows pinned in-leg)
+    "io_faults": (["--run-cfg", "io_faults"], "BENCH_C12_TIMEOUT", 900,
+                  "io_faults_rounds_per_sec_idle"),
 }
 
 
@@ -1289,6 +1412,10 @@ if __name__ == "__main__":
             # gather->round->scatter cycle), not a CfgLeg timing
             run_clients_sweep_measurement()
             sys.exit(0)
+        if sel == "io_faults":
+            # storage-fault-plane overhead A/B (same custom round loop)
+            run_io_faults_measurement()
+            sys.exit(0)
         # the allowlist IS the leg table — a hand-maintained copy here
         # silently orphaned the coalesce/straggler captures (their
         # children exited "unknown config" while the parent reported a
@@ -1297,7 +1424,8 @@ if __name__ == "__main__":
             # a missing/typo'd operand must never fall through to the full
             # parent orchestration and claim the chip for a headline bench
             sys.exit(f"--run-cfg: unknown config {sel!r}; use "
-                     + "|".join(sorted(_CFG_LEGS)) + "|clients_sweep")
+                     + "|".join(sorted(_CFG_LEGS))
+                     + "|clients_sweep|io_faults")
         run_config_measurement(sel)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--capture":

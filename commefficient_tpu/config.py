@@ -477,6 +477,43 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
     parser.add_argument("--max_guard_trips", type=int, default=3,
                         help="Consecutive guard trips before aborting with "
                              "a fatal error (guards only).")
+    # Storage-fault tolerance (docs/fault_tolerance.md §storage faults):
+    # the disk-tier row store's I/O plane — seeded fault injection at the
+    # pread/pwrite seam, a bounded retry/backoff ladder, a per-op
+    # watchdog deadline, row-level quarantine, and a bounded work queue.
+    # Transient faults below the retry/deadline budget are invisible to
+    # the fp32 trajectory (retried I/O lands identical bytes).
+    parser.add_argument("--inject_io_fault", type=str, default="",
+                        help="Debug: seeded storage-fault schedule "
+                             "'eio=P,short=P,torn=P,stall=P,stall_ms=N,"
+                             "seed=N,persist_after=N' injected at the "
+                             "disk-tier row store's pread/pwrite seam — "
+                             "transient EIO / short reads / torn writes "
+                             "are retried (bit-invisible below the "
+                             "budget), stalls exercise the watchdog, and "
+                             "a row failing persist_after consecutive "
+                             "attempts is quarantined (re-initialized "
+                             "from its base row).")
+    parser.add_argument("--io_retries", type=int, default=3,
+                        help="Bounded retries per row-store I/O op "
+                             "(exponential backoff + jitter) before the "
+                             "ladder degrades to row quarantine.")
+    parser.add_argument("--io_backoff_ms", type=float, default=5.0,
+                        help="Base backoff between row-store I/O retries "
+                             "(doubles per attempt, jittered).")
+    parser.add_argument("--io_deadline_ms", type=float, default=30000.0,
+                        help="Per-op watchdog deadline for row-store I/O: "
+                             "a pread/pwrite in flight longer than this "
+                             "declares the store unusable with one "
+                             "actionable timeout error instead of "
+                             "wedging the worker silently (0 disables "
+                             "the watchdog).")
+    parser.add_argument("--io_queue_bound", type=int, default=0,
+                        help="Row-store work-queue bound (ops): a slow "
+                             "disk applies backpressure to the dispatch "
+                             "path instead of accumulating unbounded "
+                             "pending scatter deltas in host RAM. 0 = "
+                             "auto (max(8, 4 x --round_window)).")
     # Fault-injection debug hook (tests/test_fault_tolerance.py): poison
     # the aggregated transmit of the given dispatch round(s) so guard
     # detection/quarantine is testable end-to-end.
@@ -583,6 +620,18 @@ def validate_args(args):
         from commefficient_tpu.profiling import parse_trace_rounds
 
         parse_trace_rounds(args.trace_rounds)
+    # storage-fault plane (host_state.MemmapRowStore,
+    # docs/fault_tolerance.md §storage faults): fail fast on a malformed
+    # spec or a nonsensical ladder, not rounds into a run
+    io_spec = (getattr(args, "inject_io_fault", "") or "").strip()
+    if io_spec:
+        from commefficient_tpu.federated.host_state import parse_io_fault
+
+        parse_io_fault(io_spec)
+    assert args.io_retries >= 0, "--io_retries must be >= 0"
+    assert args.io_backoff_ms >= 0, "--io_backoff_ms must be >= 0"
+    assert args.io_deadline_ms >= 0, "--io_deadline_ms must be >= 0"
+    assert args.io_queue_bound >= 0, "--io_queue_bound must be >= 0"
     if args.inject_fault:
         parse_inject_fault(args.inject_fault)  # fail fast on a bad spec
         if not args.guards:

@@ -412,10 +412,16 @@ class FedModel:
         self._stream_round = None
         self._prefetcher = None
         self._pending_offload = None
+        # Storage-fault plane (--inject_io_fault + the retry/backoff/
+        # watchdog ladder, docs/fault_tolerance.md §storage faults):
+        # parsed up front so a bad spec fails before any state allocates;
+        # only the disk tier has an I/O seam to inject into.
+        io_spec = (getattr(args, "inject_io_fault", "") or "").strip()
         if self.memory_plan.placement == "disk" and has_state:
             from commefficient_tpu.federated.host_state import (
                 CohortPrefetcher,
                 MemmapRowStore,
+                parse_io_fault,
             )
 
             row_shapes = {}
@@ -433,12 +439,34 @@ class FedModel:
                 # stored as deltas off the init row — no O(clients * d)
                 # tiling write at startup (host_state.MemmapRowStore)
                 init_rows["weights"] = np.asarray(flat, np.float32)
+            # the work-queue bound scales with the engine's in-flight
+            # window (each round enqueues one gather + one scatter);
+            # --io_queue_bound overrides. A slow disk then BLOCKS the
+            # dispatch path (backpressure) instead of accumulating
+            # unbounded pending scatter deltas in host RAM.
+            queue_bound = int(getattr(args, "io_queue_bound", 0) or 0) \
+                or max(8, 4 * int(getattr(args, "round_window", 2)))
             self._row_store = MemmapRowStore(
                 self._state_dir(args), alloc_clients, row_shapes,
-                mesh=self.mesh, init_rows=init_rows)
+                mesh=self.mesh, init_rows=init_rows,
+                inject=parse_io_fault(io_spec) if io_spec else None,
+                io_retries=int(getattr(args, "io_retries", 3)),
+                io_backoff_ms=float(getattr(args, "io_backoff_ms", 5.0)),
+                io_deadline_ms=float(getattr(args, "io_deadline_ms",
+                                             30000.0)),
+                queue_bound=queue_bound)
+            # counter snapshot for the per-round offload-span deltas (the
+            # watch plane's io_retry/io_error rules observe per-round
+            # values, not run totals)
+            self._io_counts_last = self._row_store.io_counters()
             self._prefetcher = CohortPrefetcher(self._row_store.gather_async)
             self.client_states = ClientStates(None, None, None)
         else:
+            if io_spec:
+                print(f"NOTE: --inject_io_fault targets the disk-tier row "
+                      f"store; this run resolved the "
+                      f"{self.memory_plan.placement} tier, so the "
+                      f"schedule is inert")
             self.client_states = init_client_states(
                 alloc_clients, self.grad_size, wcfg, init_weights=flat,
                 sketch=self.sketch, sharding=state_sharding)
@@ -474,6 +502,20 @@ class FedModel:
                   + ("" if self._prefetcher.enabled else
                      " (cohort prefetch OFF: COMMEFFICIENT_COHORT_"
                      "PREFETCH=0)"))
+            if self._row_store is not None:
+                # the storage-fault plane's resolved config, in the
+                # startup print like the row geometry above (the same
+                # values land in the telemetry run_start event)
+                st = self._row_store
+                print(f"row-store I/O plane: queue bound {st.queue_bound} "
+                      f"ops (backpressure), retry ladder {st.io_retries} "
+                      f"retries x {st.io_backoff_ms:g} ms backoff, "
+                      f"watchdog deadline {st.io_deadline_ms:g} ms, row "
+                      f"quarantine after {st.quarantine_after} failed "
+                      f"attempts"
+                      + (f", fault injection "
+                         f"{st.inject.schedule.spec()}"
+                         if st.inject is not None else ""))
 
         self._round_ctx = None
         # --rng_impl: TPU-first extension (no reference equivalent). The
@@ -556,9 +598,30 @@ class FedModel:
     def finalize(self):
         """No worker processes to join (reference fed_aggregator.py:196-203)
         — but the disk-tier row store's I/O worker is real: drain and join
-        it so every scatter is durably in the backing files."""
+        it (bounded — ``MemmapRowStore.close`` reports a hung worker or a
+        surfaced error instead of abandoning a daemon thread mid-write)
+        so every scatter is durably in the backing files. Called by both
+        entrypoints on EVERY exit path, including the storage-fault
+        terminal rung (docs/fault_tolerance.md §storage faults).
+
+        An I/O error that first surfaces at this FINAL drain — the last
+        rounds' state may not be durable — must fail the run when
+        nothing else already is: close() itself never raises (it runs at
+        teardown), so the escalation lives here, suppressed only while
+        another exception is propagating through the caller's finally
+        block (that one already carries the failure; a raise here would
+        mask it)."""
+        import sys as _sys
+
         if self._row_store is not None:
-            self._row_store.close()
+            report = self._row_store.close()
+            if report.get("error") and _sys.exc_info()[0] is None:
+                raise RuntimeError(
+                    f"row store close surfaced an I/O error: "
+                    f"{report['error']} — the final rounds' client state "
+                    f"may not be durable; resume from the last checkpoint "
+                    f"with --resume auto (docs/fault_tolerance.md "
+                    f"§storage faults)")
 
     # -- host-offload data plane (docs/host_offload.md) --------------------
 
@@ -819,6 +882,29 @@ class FedModel:
                 # number above is only the wait, ~0 on a prefetch hit)
                 self._pending_offload["gather_io_ms"] = round(
                     self._row_store.last_gather_ms, 3)
+                # storage-fault plane: per-round COUNTER DELTAS + queue
+                # depth/age — the observables the watch plane's default
+                # io_retry / io_error / worker_queue_age rules read
+                # (docs/fault_tolerance.md §storage faults). Worker-side
+                # row_quarantined records surface as immediate telemetry
+                # events HERE, on the dispatch thread — the event log is
+                # not written from the I/O worker.
+                st = self._row_store
+                counts = st.io_counters()
+                last = self._io_counts_last
+                self._pending_offload.update({
+                    "io_retries": counts["retries"] - last["retries"],
+                    "io_errors": counts["errors"] - last["errors"],
+                    "io_quarantined": (counts["quarantined"]
+                                       - last["quarantined"]),
+                    "queue_depth": st.queue_depth(),
+                    "queue_age_ms": round(st.queue_age_ms(), 3),
+                })
+                self._io_counts_last = counts
+                for ev in st.pop_events():
+                    if self.telemetry is not None:
+                        self.telemetry.event("row_quarantined",
+                                             round=round_no, **ev)
         pre_model_state = self._model_state
         # round-scoped trace span (docs/observability.md §trace capture):
         # names the client phase's dispatch inside a profiler capture; a

@@ -306,6 +306,24 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
             shutil.rmtree(stem + ".rows")
         os.replace(tmp_rows, stem + ".rows")
         meta["client_store"] = store_meta
+        # storage-fault plane (--inject_io_fault, docs/fault_tolerance.md
+        # §storage faults): the seeded injector RNG + per-row consecutive-
+        # failure counts ride the checkpoint like the client-fault RNG's
+        # part/* keys, so a resumed drill continues the SAME deterministic
+        # schedule (the store is drained by save_snapshot above, so this
+        # state is quiescent)
+        if getattr(store, "inject", None) is not None:
+            _, io_keys, io_pos, io_gauss, io_cached = \
+                store.inject.rng.get_state()
+            arrays["io/rng_keys"] = io_keys
+            arrays["io/rng_meta"] = np.asarray([io_pos, io_gauss],
+                                               np.int64)
+            arrays["io/rng_cached"] = np.asarray([io_cached], np.float64)
+            meta["io_fault"] = {"spec": store.inject.schedule.spec(),
+                                "injected": dict(store.inject.injected)}
+        if getattr(store, "_row_fails", None):
+            arrays["io/row_fails"] = np.asarray(
+                sorted(store._row_fails.items()), np.int64).reshape(-1, 2)
     # content checksum (verified on load and by --resume auto discovery):
     # a torn write that survives the atomic-rename pattern — e.g. a torn
     # COPY of a checkpoint, or on-disk corruption — fails loudly. The
@@ -592,6 +610,31 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler,
                         f"checkpoint has client {name} but this config "
                         f"allocates none")
         fm.client_states = ClientStates(None, None, None)
+        # storage-fault plane: restore the seeded injector RNG + the
+        # per-row consecutive-failure ledger (absent in pre-I/O-fault
+        # checkpoints — the schedule then restarts from its seed, the
+        # EF-carry warn-path contract)
+        io_flat = {k: flat.pop(k) for k in list(flat)
+                   if k.startswith("io/")}
+        if meta.get("io_fault") is not None:
+            if getattr(store, "inject", None) is not None:
+                store.inject.rng.set_state(
+                    ("MT19937", io_flat["io/rng_keys"],
+                     int(io_flat["io/rng_meta"][0]),
+                     int(io_flat["io/rng_meta"][1]),
+                     float(io_flat["io/rng_cached"][0])))
+                store.inject.injected.update(
+                    {k: int(v) for k, v in
+                     meta["io_fault"].get("injected", {}).items()})
+            else:
+                import warnings
+
+                warnings.warn(
+                    "checkpoint carries --inject_io_fault state but this "
+                    "run has no injection schedule; ignoring it")
+        if "io/row_fails" in io_flat:
+            store._row_fails = {int(r): int(c)
+                                for r, c in io_flat["io/row_fails"]}
     else:
         if store_meta is not None:
             # disk-tier checkpoint into an hbm/host-tier run: lift each

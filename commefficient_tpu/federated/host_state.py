@@ -40,13 +40,16 @@ what it reads.  ``COMMEFFICIENT_COHORT_PREFETCH=0`` is the kill-switch.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import queue
+import sys
 import threading
 import time
 import zlib
 from contextlib import nullcontext
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -59,7 +62,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from commefficient_tpu.federated.rounds import ClientStates
 
 __all__ = ["RowStreamer", "StreamedRound", "MemmapRowStore",
-           "CohortPrefetcher", "prefetch_enabled", "read_snapshot_member"]
+           "CohortPrefetcher", "prefetch_enabled", "read_snapshot_member",
+           "IOFaultSchedule", "IOFaultInjector", "parse_io_fault",
+           "StoreFatalError"]
 
 
 class StreamedRound(NamedTuple):
@@ -336,24 +341,177 @@ def _file_crc(path: str) -> int:
     return crc
 
 
+# ---------------------------------------------------------------------------
+# Storage-fault tolerance: seeded I/O fault injection + the retry/backoff/
+# watchdog ladder (docs/fault_tolerance.md §storage faults)
+# ---------------------------------------------------------------------------
+
+
+class StoreFatalError(RuntimeError):
+    """The terminal rung of the storage-fault ladder: the whole row store
+    is unusable (a watchdog-declared hang, or a quarantine re-init that
+    itself failed persistently). Raised ONCE with an actionable message;
+    every later store operation re-raises it — recovery is a resume from
+    the last checkpoint, not a retry."""
+
+
+class _RowOpExhausted(Exception):
+    """One row op failed every attempt of its retry ladder (internal —
+    the caller degrades to row quarantine or escalates to fatal)."""
+
+    def __init__(self, last: BaseException):
+        super().__init__(str(last))
+        self.last = last
+
+
+@dataclass(frozen=True)
+class IOFaultSchedule:
+    """Seeded storage-fault schedule (``--inject_io_fault``) — the
+    disk-tier sibling of the client plane's ``FaultSchedule``
+    (federated/participation.py) and the device plane's
+    ``--inject_fault``.
+
+    Each raw row I/O operation on the store's ordered worker draws one
+    uniform; the thresholds partition [0, 1): u < eio → a transient
+    ``EIO``; u < eio+short → a short read (fewer bytes than requested);
+    u < eio+short+torn → a torn write (half the bytes land, then the op
+    errors — the retryable-visible form: a silently-succeeding torn
+    write would be undetectable without per-row checksums, documented in
+    docs/fault_tolerance.md); u < eio+short+torn+stall → the op stalls
+    ``stall_ms`` before proceeding (a stall below the watchdog deadline
+    is pure latency; above it, the watchdog declares the store hung).
+    ``persist_after`` is the row-quarantine threshold: a row accumulating
+    that many CONSECUTIVE failed attempts is re-initialized from the
+    ``init_rows`` base (mirroring the client plane's
+    ``quarantine_after``). ``seed`` makes the whole schedule
+    deterministic under rerun — ops execute in submission order on ONE
+    worker thread, so the draw sequence is a pure function of the
+    config. An all-zero schedule is legal on purpose: it is the
+    "injection compiled in but idle" overhead probe the bench leg
+    measures."""
+
+    eio: float = 0.0
+    short: float = 0.0
+    torn: float = 0.0
+    stall: float = 0.0
+    stall_ms: float = 50.0
+    seed: int = 0
+    persist_after: int = 3
+
+    @property
+    def active(self) -> bool:
+        return bool(self.eio or self.short or self.torn or self.stall)
+
+    def spec(self) -> str:
+        return (f"eio={self.eio:g},short={self.short:g},"
+                f"torn={self.torn:g},stall={self.stall:g},"
+                f"stall_ms={self.stall_ms:g},seed={self.seed},"
+                f"persist_after={self.persist_after}")
+
+
+def parse_io_fault(spec: str) -> IOFaultSchedule:
+    """``--inject_io_fault`` grammar → IOFaultSchedule.
+
+    ``'eio=P,short=P,torn=P,stall=P,stall_ms=N,seed=N,persist_after=N'``
+    — every key optional; probability mass must leave room for healthy
+    ops (sum < 1). Fails at parse time with the offending entry named,
+    like the sibling fault grammars."""
+    fields: Dict[str, Any] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            key, val = (x.strip() for x in part.split("="))
+        except ValueError:
+            raise ValueError(
+                f"--inject_io_fault: bad entry {part!r}; expected "
+                f"KEY=VALUE with KEY in eio|short|torn|stall|stall_ms|"
+                f"seed|persist_after") from None
+        if key in ("eio", "short", "torn", "stall"):
+            p = float(val)
+            assert 0.0 <= p <= 1.0, (
+                f"--inject_io_fault: {key}={val} must be in [0, 1]")
+            fields[key] = p
+        elif key == "stall_ms":
+            ms = float(val)
+            assert ms > 0, f"--inject_io_fault: stall_ms={val} must be > 0"
+            fields[key] = ms
+        elif key in ("seed", "persist_after"):
+            fields[key] = int(val)
+        else:
+            raise ValueError(
+                f"--inject_io_fault: unknown key {key!r}; use "
+                f"eio|short|torn|stall|stall_ms|seed|persist_after")
+    sched = IOFaultSchedule(**fields)
+    assert sched.eio + sched.short + sched.torn + sched.stall <= 1.0, (
+        "--inject_io_fault: eio+short+torn+stall must be <= 1")
+    assert sched.persist_after >= 1, (
+        "--inject_io_fault: persist_after must be >= 1")
+    return sched
+
+
+class IOFaultInjector:
+    """The seeded draw stream at the row-store I/O seam: ONE uniform per
+    raw row operation, consumed on the ordered worker thread — so the
+    injected schedule is deterministic for a fixed config and captured
+    by checkpoints (``save_run_state``'s ``io/*`` keys carry the
+    RandomState, like the client-fault RNG's ``part/*`` keys)."""
+
+    def __init__(self, schedule: IOFaultSchedule):
+        self.schedule = schedule
+        self.rng = np.random.RandomState(schedule.seed)
+        self.injected = {"eio": 0, "short": 0, "torn": 0, "stall": 0}
+
+    def draw(self) -> Optional[str]:
+        s = self.schedule
+        if not s.active:
+            # idle injection still pays the seam (the bench overhead
+            # probe) but not a draw per op — the RNG stream stays empty
+            # so enabling a real schedule later starts it at the seed
+            return None
+        u = float(self.rng.random_sample())
+        acc = 0.0
+        for kind in ("eio", "short", "torn", "stall"):
+            acc += getattr(s, kind)
+            if u < acc:
+                self.injected[kind] += 1
+                return kind
+        return None
+
+
 class _PendingStream:
     """A gather in flight on the store's worker thread. ``get()`` blocks
     the CALLING thread on a threading.Event — a thread join, not a device
     fetch, so it is invisible to ``host_sync_monitor`` (the device proxy
     upload happens inside the worker)."""
 
-    def __init__(self):
+    def __init__(self, store=None):
         self._done = threading.Event()
         self._value: Optional[StreamedRound] = None
         self._err: Optional[BaseException] = None
+        self._store = store  # fatal-flag source for the get() wait
         self.io_ms: float = 0.0  # worker-measured read+upload duration
 
     def _set(self, value=None, err=None):
+        # first writer wins: the watchdog may have already failed this
+        # handle while the worker was stuck — the late completion (or the
+        # worker's own error path) must not overwrite the surfaced timeout
+        if self._done.is_set():
+            return
         self._value, self._err = value, err
         self._done.set()
 
     def get(self) -> StreamedRound:
-        self._done.wait()
+        # audit the store's fatal flag while waiting: the watchdog fails
+        # the handle of the gather it can SEE (_cur_pending), but a hang
+        # inside a SCATTER — which has no handle — must still unblock a
+        # waiter queued behind it, or the dispatch thread wedges forever
+        # in take() with the store already declared dead
+        while not self._done.wait(0.1):
+            if self._store is not None \
+                    and self._store._fatal is not None:
+                raise self._store._fatal
         if self._err is not None:
             raise self._err
         return self._value
@@ -396,6 +554,27 @@ class MemmapRowStore:
     snapshots are sparse chunk copies of the backing files with logical-
     content CRCs recorded in the run-state's ``meta_json`` — see
     ``checkpoint.save_run_state``.
+
+    Storage-fault tolerance (docs/fault_tolerance.md §storage faults):
+    every row op runs a bounded retry ladder (``io_retries`` retries with
+    exponential backoff + jitter — retried transient faults are invisible
+    to the trajectory: the op's eventual bytes are identical); a watchdog
+    thread enforces a per-op deadline (``io_deadline_ms``) so a pread
+    hung on a wedged NFS/9p mount becomes an actionable timeout error
+    instead of a silent forever-wedge; a row accumulating
+    ``persist_after`` consecutive failed attempts is QUARANTINED —
+    re-initialized to the zero/base representation (sketches are linear,
+    so the lost EF carry is a counted, documented degradation, not a
+    crash) and surfaced through ``pop_events`` as a ``row_quarantined``
+    record. Only when the store is unusable (a watchdog-declared hang,
+    or a quarantine re-init that itself fails persistently) does the
+    ladder end in ``StoreFatalError`` — one actionable error naming the
+    recovery path. ``--inject_io_fault`` (``IOFaultSchedule``) injects
+    seeded transient EIO / short reads / torn writes / stalls at the raw
+    op seam to drill exactly this ladder. The work queue is BOUNDED
+    (``queue_bound``) so a slow disk applies backpressure to the
+    dispatch path instead of accumulating unbounded pending scatter
+    deltas in host RAM.
     """
 
     backend = "memmap"
@@ -403,7 +582,11 @@ class MemmapRowStore:
     def __init__(self, store_dir: str, num_rows: int,
                  row_shapes: Dict[str, Tuple[int, ...]],
                  mesh: Optional[Mesh] = None,
-                 init_rows: Optional[Dict[str, np.ndarray]] = None):
+                 init_rows: Optional[Dict[str, np.ndarray]] = None,
+                 inject: Optional[IOFaultSchedule] = None,
+                 io_retries: int = 3, io_backoff_ms: float = 5.0,
+                 io_deadline_ms: float = 30000.0,
+                 queue_bound: int = 16):
         assert row_shapes, "a row store with no members is a bug upstream"
         for name in row_shapes:
             assert name in _MEMBERS, f"unknown state member {name!r}"
@@ -439,12 +622,53 @@ class MemmapRowStore:
         self.last_scatter_ms: float = 0.0
         self.gathers = 0
         self.scatters = 0
-        # the ordered I/O worker
-        self._q: "queue.Queue" = queue.Queue()
+        # ---- storage-fault plane (docs/fault_tolerance.md) ----
+        self.inject = IOFaultInjector(inject) if inject is not None else None
+        self.io_retries = int(io_retries)
+        self.io_backoff_ms = float(io_backoff_ms)
+        self.io_deadline_ms = float(io_deadline_ms)
+        # row-quarantine threshold: the schedule's persist_after when a
+        # schedule is armed (mirroring the client plane, whose
+        # quarantine_after rides the fault spec), the same default
+        # otherwise — real storage faults walk the identical ladder
+        self.quarantine_after = (inject.persist_after
+                                 if inject is not None else 3)
+        self.io_retry_total = 0      # failed attempts that were retried
+        self.io_error_total = 0      # ops that exhausted the ladder
+        self.rows_quarantined = 0
+        self.read_ops = 0            # raw pread calls (coalescing metric)
+        self.coalesced_rows = 0      # rows served by multi-row preads
+        self._row_fails: Dict[int, int] = {}  # consecutive failed attempts
+        self._events: list = []      # row_quarantined records (pop_events)
+        self._ev_lock = threading.Lock()
+        # backoff jitter rides its OWN stream: the injector's draw
+        # sequence must stay one-per-op (deterministic schedule), and
+        # jitter only shapes latency, never data
+        self._jitter_rng = np.random.RandomState(0xC0FFEE)
+        self._coalesce = os.environ.get("COMMEFFICIENT_IO_COALESCE",
+                                        "1") != "0"
+        self._fatal: Optional[BaseException] = None
+        self._inflight = None        # (op, member, row, t0) under the raw op
+        self._cur_pending: Optional[_PendingStream] = None
+        self._busy_t_enq: Optional[float] = None
+        self.close_report: Optional[dict] = None
+        # the ordered I/O worker, behind a BOUNDED queue: a slow disk
+        # applies backpressure to the dispatch path instead of
+        # accumulating unbounded pending scatter deltas in host RAM
+        self.queue_bound = int(queue_bound)
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(self.queue_bound,
+                                                         0))
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="row-store-io")
         self._closed = False
         self._worker.start()
+        self._stop_watchdog = threading.Event()
+        self._watchdog = None
+        if self.io_deadline_ms > 0:
+            self._watchdog = threading.Thread(target=self._watchdog_loop,
+                                              daemon=True,
+                                              name="row-store-watchdog")
+            self._watchdog.start()
 
     def member_path(self, name: str) -> str:
         return os.path.join(self.store_dir, f"{name}.f32")
@@ -458,7 +682,18 @@ class MemmapRowStore:
             item = self._q.get()
             if item is None:
                 return
-            kind, payload = item
+            kind, t_enq, payload = item
+            self._busy_t_enq = t_enq
+            if self._fatal is not None:
+                # terminal rung reached: fail every queued op fast with
+                # the ONE actionable error (barriers still release so
+                # drain() can surface it instead of hanging)
+                if kind == "gather":
+                    payload[1]._set(err=self._fatal)
+                elif kind == "barrier":
+                    payload.set()
+                self._busy_t_enq = None
+                continue
             try:
                 with offpath_fetches():
                     self._run_one(kind, payload)
@@ -474,26 +709,269 @@ class MemmapRowStore:
                     self._err = e
                 else:
                     self._err = e
+            # never leave a completed gather's handle as the watchdog's
+            # unblock target — a later trip must not touch a dead handle
+            self._cur_pending = None
+            self._busy_t_enq = None
+
+    # -- the raw I/O seam (fault injection lives HERE) -----------------------
+
+    def _injected_stall(self):
+        """Sleep the schedule's stall_ms in small increments, aborting the
+        moment the watchdog declares the store dead — so a test-injected
+        hang unwedges the worker once the deadline has done its job (a
+        REAL hung syscall cannot be interrupted; there the worker stays
+        stuck and only the watchdog's error surfaces)."""
+        ms = self.inject.schedule.stall_ms
+        t0 = time.monotonic()
+        while (time.monotonic() - t0) * 1e3 < ms:
+            if self._fatal is not None:
+                raise self._fatal
+            time.sleep(min(0.01, ms / 1e3))
+
+    def _pread_block(self, name: str, row0: int, count: int) -> np.ndarray:
+        """One raw (possibly multi-row) positional read, with the fault
+        injector's per-op draw applied — THE read seam."""
+        kind = self.inject.draw() if self.inject is not None else None
+        if kind == "torn":
+            # a torn WRITE has no read equivalent; the nearest read-side
+            # fault is a partial transfer — remap instead of silently
+            # no-opping, so every drawn (and counted) fault is exercised
+            kind = "short"
+        if kind == "stall":
+            self._injected_stall()
+        elif kind == "eio":
+            raise OSError(errno.EIO,
+                          f"injected EIO (read {name} row {row0})")
+        nb = self._row_nbytes[name]
+        want = nb * count
+        self.read_ops += 1
+        buf = os.pread(self._fd[name], want, row0 * nb)
+        if kind == "short":
+            buf = buf[: want // 2]
+        if len(buf) != want:
+            raise OSError(errno.EIO,
+                          f"short read: {len(buf)}/{want} bytes "
+                          f"({name} row {row0})")
+        return np.frombuffer(buf, np.float32).reshape(
+            (count,) + self.row_shapes[name]).copy()
+
+    def _pwrite_row(self, name: str, row: int, values: np.ndarray) -> None:
+        """One raw positional row write, with the fault injector's per-op
+        draw applied — THE write seam."""
+        kind = self.inject.draw() if self.inject is not None else None
+        if kind == "short":
+            # a short READ has no write equivalent; the nearest write-
+            # side fault is the torn (partial) write — same remap
+            # rationale as _pread_block's torn->short
+            kind = "torn"
+        if kind == "stall":
+            self._injected_stall()
+        elif kind == "eio":
+            raise OSError(errno.EIO,
+                          f"injected EIO (write {name} row {row})")
+        nb = self._row_nbytes[name]
+        data = np.ascontiguousarray(values, np.float32).tobytes()
+        if kind == "torn":
+            # half the bytes land, then the op errors — the retryable-
+            # VISIBLE torn write (a silently-succeeding tear would be
+            # undetectable without per-row checksums; the retry's full
+            # rewrite repairs this one, docs/fault_tolerance.md)
+            os.pwrite(self._fd[name], data[: len(data) // 2], row * nb)
+            raise OSError(errno.EIO,
+                          f"injected torn write ({name} row {row})")
+        n = os.pwrite(self._fd[name], data, row * nb)
+        if n != len(data):
+            raise OSError(errno.EIO,
+                          f"short write: {n}/{len(data)} bytes "
+                          f"({name} row {row})")
+
+    # -- the retry/backoff/quarantine ladder ---------------------------------
+
+    def _laddered(self, op: str, name: str, row: Optional[int], fn):
+        """Run one raw row op through the bounded retry ladder:
+        ``io_retries`` retries with exponential backoff + jitter. The
+        in-flight marker around each attempt is what the watchdog
+        thread audits against ``io_deadline_ms``. Row-keyed ops track
+        CONSECUTIVE failed attempts; a row past ``quarantine_after``
+        (the schedule's persist_after) stops burning retries — the
+        caller quarantines it. Raises ``_RowOpExhausted`` after the
+        last attempt; re-raises ``StoreFatalError`` immediately (a
+        dead store is never retried)."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.io_retries + 1):
+            if self._fatal is not None:
+                raise self._fatal
+            self._inflight = (op, name, row, time.monotonic())
+            try:
+                out = fn()
+                self._inflight = None
+                if row is not None:
+                    self._row_fails.pop(row, None)
+                return out
+            except StoreFatalError:
+                self._inflight = None
+                raise
+            except Exception as e:  # noqa: BLE001 — transient I/O fault
+                self._inflight = None
+                last = e
+                if row is not None:
+                    fails = self._row_fails.get(row, 0) + 1
+                    self._row_fails[row] = fails
+                    if fails >= self.quarantine_after:
+                        break  # past the quarantine threshold: stop here
+                if attempt < self.io_retries:
+                    self.io_retry_total += 1
+                    delay = (self.io_backoff_ms * (2 ** attempt)
+                             * (0.5 + float(
+                                 self._jitter_rng.random_sample())))
+                    time.sleep(delay / 1e3)
+        self.io_error_total += 1
+        raise _RowOpExhausted(last)
+
+    def _fatal_now(self, msg: str,
+                   cause: Optional[BaseException] = None) -> StoreFatalError:
+        err = StoreFatalError(
+            f"row-store I/O failed persistently: {msg} "
+            f"(store {self.store_dir}; {self.io_retry_total} retried "
+            f"attempt(s), {self.io_error_total} exhausted op(s), "
+            f"{self.rows_quarantined} row quarantine(s) this run). The "
+            f"backing storage is unusable — fix it (or point --state_dir "
+            f"at healthy storage) and resume from the last checkpoint "
+            f"with --resume auto (docs/fault_tolerance.md §storage "
+            f"faults).")
+        if cause is not None:
+            err.__cause__ = cause
+        self._fatal = err
+        self._err = err
+        return err
+
+    def _quarantine_row(self, row: int, op: str, cause: str) -> None:
+        """Row-level graceful degradation, mirroring client quarantine
+        (docs/fault_tolerance.md): re-initialize the failing row to the
+        zero/base representation across ALL members (rows are only ever
+        read as base + stored delta, so this is exactly ``init_rows``;
+        the lost EF carry is a counted degradation — sketches are
+        linear, training continues). Recorded for the dispatch thread to
+        surface as a ``row_quarantined`` telemetry event. A re-init that
+        ITSELF fails persistently is the terminal rung: the store is
+        declared unusable with one actionable error."""
+        for name in self._fd:
+            zero = np.zeros(self.row_shapes[name], np.float32)
+            try:
+                self._laddered("quarantine-reinit", name, None,
+                               lambda n=name: self._pwrite_row(n, row,
+                                                               zero))
+            except _RowOpExhausted as e:
+                raise self._fatal_now(
+                    f"quarantining row {row} failed — the re-init write "
+                    f"of member {name!r} errored every attempt "
+                    f"({e.last})", cause=e.last)
+        self.rows_quarantined += 1
+        self._row_fails.pop(row, None)
+        with self._ev_lock:
+            self._events.append({"row": int(row), "op": op,
+                                 "cause": str(cause)[:200]})
+        print(f"ROW STORE: quarantined row {row} after repeated {op} "
+              f"failures ({cause}); re-initialized from the base row — "
+              f"the row's EF carry is lost (counted degradation, "
+              f"docs/fault_tolerance.md)", file=sys.stderr, flush=True)
 
     def _read_row(self, name: str, row: int) -> np.ndarray:
-        nb = self._row_nbytes[name]
-        buf = os.pread(self._fd[name], nb, row * nb)
-        return np.frombuffer(buf, np.float32).reshape(
-            self.row_shapes[name]).copy()
+        """One row through the full ladder: retries, then quarantine
+        (the re-initialized row reads as zeros = the base
+        representation)."""
+        try:
+            return self._laddered(
+                "read", name, row,
+                lambda: self._pread_block(name, row, 1))[0]
+        except _RowOpExhausted as e:
+            self._quarantine_row(row, "read", str(e.last))
+            return np.zeros(self.row_shapes[name], np.float32)
 
     def _write_row(self, name: str, row: int, values: np.ndarray) -> None:
-        nb = self._row_nbytes[name]
-        os.pwrite(self._fd[name], np.ascontiguousarray(
-            values, np.float32).tobytes(), row * nb)
+        """One row write through the full ladder. On quarantine the row
+        was just reset to base — the in-flight value (pre-quarantine
+        content + delta) is deliberately discarded with the rest of the
+        row's EF state (the documented degradation)."""
+        try:
+            self._laddered("write", name, row,
+                           lambda: self._pwrite_row(name, row, values))
+        except _RowOpExhausted as e:
+            self._quarantine_row(row, "write", str(e.last))
+
+    def _gather_member(self, name: str, ids: np.ndarray) -> np.ndarray:
+        """All of one member's cohort rows, with CONTIGUOUS id runs
+        coalesced into single multi-row preads (the common contiguous-
+        cohort case pays one syscall per run instead of one per row —
+        bit-identical to the per-row path: the same bytes land at the
+        same slots; COMMEFFICIENT_IO_COALESCE=0 restores per-row). A
+        coalesced read that exhausts its retries degrades to the
+        per-row path, which owns the row-level quarantine ladder."""
+        out = np.empty((len(ids),) + self.row_shapes[name], np.float32)
+        i, n = 0, len(ids)
+        while i < n:
+            j = i + 1
+            if self._coalesce:
+                while j < n and int(ids[j]) == int(ids[j - 1]) + 1:
+                    j += 1
+            if j - i == 1:
+                out[i] = self._read_row(name, int(ids[i]))
+            else:
+                row0, count = int(ids[i]), j - i
+                try:
+                    out[i:j] = self._laddered(
+                        "read", name, None,
+                        lambda: self._pread_block(name, row0, count))
+                    self.coalesced_rows += count
+                except _RowOpExhausted:
+                    for k in range(i, j):
+                        out[k] = self._read_row(name, int(ids[k]))
+            i = j
+        return out
+
+    # -- the watchdog --------------------------------------------------------
+
+    def _watchdog_loop(self):
+        """Audit the worker's in-flight raw op against the per-op
+        deadline. A hung syscall cannot be cancelled from Python; what
+        CAN be done — and what this does — is turn the silent forever-
+        wedge into an observable failure: declare the store dead, fail
+        the blocked gather handle so ``take()``/``drain()`` unblock with
+        one actionable timeout error, and leave the stuck daemon worker
+        behind (docs/fault_tolerance.md §storage faults)."""
+        poll = min(max(self.io_deadline_ms / 4e3, 0.05), 1.0)
+        while not self._stop_watchdog.wait(poll):
+            if self._fatal is not None:
+                continue
+            info = self._inflight
+            if info is None:
+                continue
+            op, name, row, t0 = info
+            age_ms = (time.monotonic() - t0) * 1e3
+            if age_ms <= self.io_deadline_ms:
+                continue
+            where = f"row {row}" if row is not None else "row block"
+            err = self._fatal_now(
+                f"watchdog deadline exceeded — {op} of {name!r} "
+                f"{where} has been in flight {age_ms:.0f} ms "
+                f"(--io_deadline_ms {self.io_deadline_ms:g}; queue "
+                f"depth {self._q.qsize()}) — the filesystem under the "
+                f"store is stalled or hung")
+            pending = self._cur_pending
+            if pending is not None:
+                pending._set(err=err)
+            print(f"ROW STORE WATCHDOG: {err}", file=sys.stderr,
+                  flush=True)
 
     def _run_one(self, kind, payload):
         if kind == "gather":
             ids, pending = payload
+            self._cur_pending = pending
             t0 = time.perf_counter()
             proxy = {}
             for name in self._fd:
-                rows = np.stack([self._read_row(name, int(i))
-                                 for i in ids])
+                rows = self._gather_member(name, ids)
                 base = self.init_rows.get(name)
                 if base is not None:
                     rows = rows + base
@@ -503,6 +981,7 @@ class MemmapRowStore:
                 proxy[name] = dev
             self.last_gather_ms = (time.perf_counter() - t0) * 1e3
             self.gathers += 1
+            self._cur_pending = None
             pending._set(StreamedRound(
                 ids=ids,
                 proxy=ClientStates(**{m: proxy.get(m) for m in _MEMBERS})))
@@ -527,15 +1006,78 @@ class MemmapRowStore:
 
     _err: Optional[BaseException] = None
 
+    # -- storage-fault observability (docs/observability.md) -----------------
+
+    @property
+    def fatal_error(self) -> Optional[BaseException]:
+        """The terminal rung's error, once declared (None while the store
+        is usable)."""
+        return self._fatal
+
+    def io_counters(self) -> Dict[str, Any]:
+        """Cumulative storage-fault counters — the aggregator deltas
+        these into the per-round offload span, which is what the watch
+        plane's ``io_retry``/``io_error`` rules observe."""
+        return {"retries": self.io_retry_total,
+                "errors": self.io_error_total,
+                "quarantined": self.rows_quarantined,
+                "read_ops": self.read_ops,
+                "coalesced_rows": self.coalesced_rows,
+                "injected": (dict(self.inject.injected)
+                             if self.inject is not None else None)}
+
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def queue_age_ms(self) -> float:
+        """Age of the operation the worker is currently serving (enqueue
+        to now) — the observable 'how far behind is the disk' signal the
+        ``worker_queue_age`` watch rule reads; 0 when idle."""
+        t = self._busy_t_enq
+        return 0.0 if t is None else (time.monotonic() - t) * 1e3
+
+    def pop_events(self) -> list:
+        """Drain the worker-side ``row_quarantined`` records (the
+        dispatch thread turns them into telemetry events — the event log
+        write must not happen on the I/O worker)."""
+        with self._ev_lock:
+            events, self._events = self._events, []
+        return events
+
+    def _check_fatal(self) -> None:
+        if self._fatal is not None:
+            raise self._fatal
+
+    def _put(self, item, timeout: Optional[float] = None) -> None:
+        """Bounded enqueue: blocks (backpressure) while the queue is
+        full, but keeps auditing the fatal flag so a caller never waits
+        forever behind a store already declared dead."""
+        t0 = time.monotonic()
+        while True:
+            self._check_fatal()
+            try:
+                self._q.put(item, timeout=0.2)
+                return
+            except queue.Full:
+                if timeout is not None \
+                        and time.monotonic() - t0 > timeout:
+                    raise TimeoutError(
+                        f"row-store queue full ({self._q.qsize()} ops) "
+                        f"for {timeout:g}s — the I/O worker is not "
+                        f"making progress") from None
+
     # -- the gather/scatter contract ---------------------------------------
 
     def gather_async(self, ids) -> _PendingStream:
         """Enqueue a W-row read; returns a handle whose ``get()`` yields
-        the ``StreamedRound`` (row-sharded device proxy, original ids)."""
+        the ``StreamedRound`` (row-sharded device proxy, original ids).
+        Raises the store's terminal error immediately once the ladder
+        has declared the store unusable."""
         assert not self._closed, "gather on a closed row store"
+        self._check_fatal()
         ids = np.asarray(ids, np.int64)
-        pending = _PendingStream()
-        self._q.put(("gather", (ids, pending)))
+        pending = _PendingStream(store=self)
+        self._put(("gather", time.monotonic(), (ids, pending)))
         return pending
 
     def gather(self, ids) -> StreamedRound:
@@ -546,8 +1088,11 @@ class MemmapRowStore:
         """Enqueue the round's delta write-back: ``rows[ids] += new - old``
         per member (duplicate slot ids accumulate in slot order, matching
         the device tier's ``.at[ids].add``). The subtraction is dispatched
-        on device HERE (async); the worker materializes and writes."""
+        on device HERE (async); the worker materializes and writes. A
+        full work queue BLOCKS here (bounded backpressure) instead of
+        growing an unbounded host-RAM backlog of pending deltas."""
         assert not self._closed, "scatter on a closed row store"
+        self._check_fatal()
         deltas = {}
         for name in self._fd:
             old = getattr(old_proxy, name)
@@ -555,28 +1100,76 @@ class MemmapRowStore:
             if old is None or new is None:
                 continue
             deltas[name] = _proxy_delta(new, old)
-        self._q.put(("scatter", (np.asarray(stream.ids, np.int64), deltas)))
+        self._put(("scatter", time.monotonic(),
+                   (np.asarray(stream.ids, np.int64), deltas)))
 
-    def drain(self) -> None:
+    def drain(self, timeout: Optional[float] = None) -> None:
         """Barrier: wait for every enqueued gather/scatter to complete
         (checkpoint save points and run teardown). Re-raises a worker-side
-        scatter failure instead of letting it vanish with the thread."""
+        failure instead of letting it vanish with the thread; once the
+        watchdog (or the quarantine ladder) has declared the store dead,
+        the wait aborts with that one actionable error instead of
+        blocking forever behind a hung worker. ``timeout`` bounds the
+        wait (the shutdown path) — exceeded, it raises TimeoutError with
+        the stuck queue depth."""
         done = threading.Event()
-        self._q.put(("barrier", done))
-        done.wait()
+        self._put(("barrier", time.monotonic(), done), timeout=timeout)
+        t0 = time.monotonic()
+        while not done.wait(0.1):
+            if self._fatal is not None:
+                raise self._fatal
+            if timeout is not None and time.monotonic() - t0 > timeout:
+                raise TimeoutError(
+                    f"row-store drain timed out after {timeout:g}s with "
+                    f"{self._q.qsize()} queued op(s) (current op age "
+                    f"{self.queue_age_ms():.0f} ms)")
         if self._err is not None:
             err, self._err = self._err, None
             raise err
 
-    def close(self) -> None:
+    def close(self, timeout: float = 10.0) -> dict:
+        """Shutdown hygiene: drain with a bounded wait, join the worker
+        with a timeout, and REPORT any still-pending queue items or
+        surfaced error instead of silently abandoning a daemon thread
+        mid-write. Never raises — close runs on every exit path,
+        including teardown after the terminal rung already surfaced its
+        error (the report carries it for the caller's log). Returns the
+        report dict (also kept as ``close_report``)."""
         if self._closed:
-            return
-        self.drain()
+            return self.close_report or {"joined": True, "pending": 0,
+                                         "error": None}
+        report: Dict[str, Any] = {"joined": True, "pending": 0,
+                                  "error": None}
+        try:
+            self.drain(timeout=timeout)
+        except BaseException as e:  # noqa: BLE001 — reported, not raised
+            report["error"] = str(e)
         self._closed = True
-        self._q.put(None)
-        self._worker.join()
-        for fd in self._fd.values():
-            os.close(fd)
+        self._stop_watchdog.set()
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+        self._worker.join(timeout)
+        if self._worker.is_alive():
+            report["joined"] = False
+            report["pending"] = self._q.qsize()
+            print(f"row store close: I/O worker did not exit within "
+                  f"{timeout:g}s — abandoning it with "
+                  f"{report['pending']} queued op(s)"
+                  + (f" (surfaced error: {report['error']})"
+                     if report["error"] else ""),
+                  file=sys.stderr, flush=True)
+        else:
+            for fd in self._fd.values():
+                os.close(fd)
+            self._fd.clear()
+            if report["error"]:
+                print(f"row store close: worker joined with a surfaced "
+                      f"error: {report['error']}",
+                      file=sys.stderr, flush=True)
+        self.close_report = report
+        return report
 
     # -- whole-array access (cross-tier checkpoint restore) -----------------
 

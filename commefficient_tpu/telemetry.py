@@ -405,7 +405,12 @@ WATCH_TRACE_ROUNDS = 3    # default trace-reaction window length
 # what-tripped transmit blowup, EF-carry blowup (error/qres/dres),
 # resolved-k (threshold) collapse, in-flight occupancy drop, prefetch
 # miss storms, and host rounds/sec regression. Absolute budgets (e.g. a
-# leg_budgets.json rounds/sec floor) go in --watch_rules.
+# leg_budgets.json rounds/sec floor) go in --watch_rules. The io_* /
+# worker_queue_age rules are the storage-fault ladder's watch rungs
+# (docs/fault_tolerance.md §storage faults): a retry storm logs, an
+# exhausted op (= a row quarantine or the terminal rung approaching)
+# forces the drain-first resumable checkpoint, a queue-age blowup traces
+# the rounds where the disk fell behind.
 DEFAULT_WATCH_RULES = (
     "loss>ewma*4@2->trace",
     "transmit_norm>ewma*10->trace",
@@ -416,17 +421,27 @@ DEFAULT_WATCH_RULES = (
     "occupancy<ewma*0.5@4",
     "prefetch_miss>0.5@8",
     "rounds_per_sec<ewma*0.5@4",
+    "io_retry>ewma*8@3",
+    "io_error>0.5->checkpoint",
+    "worker_queue_age>ewma*8@4->trace",
 )
 
 
 # every name a watch rule may observe: the full v3 metric schema, the
 # round-record span keys, and the derived stream quantities — enumerable
 # at parse time, so a typo'd metric fails AT STARTUP instead of silently
-# never firing for the whole run
+# never firing for the whole run. The io_retry/io_error/worker_queue_age
+# trio reads the offload span's storage-fault counters (per-round deltas
+# attached by the aggregator, docs/fault_tolerance.md §storage faults).
 WATCH_METRIC_NAMES = frozenset(METRIC_FIELDS) | {
     "loss", "occupancy", "dispatch_ms", "compute_ms", "drain_fetch_ms",
     "dispatch_to_drain_ms", "rounds_per_sec", "prefetch_miss",
+    "io_retry", "io_error", "worker_queue_age",
 }
+
+# watch-rule name -> the offload-span key carrying its per-round value
+_IO_WATCH_KEYS = {"io_retry": "io_retries", "io_error": "io_errors",
+                  "worker_queue_age": "queue_age_ms"}
 
 
 def parse_watch_rules(spec: str) -> List[WatchRule]:
@@ -538,6 +553,11 @@ class WatchEngine:
             if not off or "prefetch" not in off:
                 return None
             return 1.0 if off["prefetch"] == "miss" else 0.0
+        if name in _IO_WATCH_KEYS:
+            off = rec.get("offload")
+            if not off:
+                return None
+            return off.get(_IO_WATCH_KEYS[name])
         if name == "rounds_per_sec":
             return rec.get("_rounds_per_sec")
         return None
@@ -855,6 +875,22 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
         run_info["state_rows_per_round"] = int(args.num_workers)
     elif mem_plan is not None and mem_plan.total_bytes:
         run_info["state_placement"] = mem_plan.placement
+    # Storage-fault plane (docs/fault_tolerance.md §storage faults): the
+    # disk tier's resolved I/O config — queue bound, retry ladder,
+    # watchdog deadline, and any seeded injection schedule — so a logged
+    # run's storage-fault story (and the injected drill that produced
+    # it) reproduces from the header alone, like the client-fault config
+    store = getattr(fed_model, "_row_store", None)
+    if store is not None:
+        run_info["state_io"] = {
+            "queue_bound": int(store.queue_bound),
+            "retries": int(store.io_retries),
+            "backoff_ms": float(store.io_backoff_ms),
+            "deadline_ms": float(store.io_deadline_ms),
+            "quarantine_after": int(store.quarantine_after),
+            "inject": (store.inject.schedule.spec()
+                       if store.inject is not None else None),
+        }
     if plan is not None:
         run_info["collective_plan"] = plan.spec()
     if getattr(fed_model, "plan_report", None):

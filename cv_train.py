@@ -483,9 +483,20 @@ def main(argv=None):
             cap = tracer.close()
             if cap is not None and rt is not None:
                 rt.event("trace_captured", **cap)
+        store = getattr(fed_model, "_row_store", None)
+        if store is not None and rt is not None \
+                and store.fatal_error is not None:
+            # the storage-fault terminal rung (docs/fault_tolerance.md
+            # §storage faults): the one actionable error, recorded so
+            # the whole ladder reproduces from the JSONL log alone
+            rt.event("io_fatal", error=str(store.fatal_error))
         if rt is not None:
             rt.close()
-    fed_model.finalize()
+        # EVERY exit path — including the storage-fault terminal rung —
+        # drains and joins the row store's I/O worker (bounded;
+        # MemmapRowStore.close reports instead of abandoning a daemon
+        # thread mid-write)
+        fed_model.finalize()
     if args.do_checkpoint:
         os.makedirs(args.checkpoint_path, exist_ok=True)
         save_checkpoint(os.path.join(args.checkpoint_path, args.model),

@@ -492,9 +492,20 @@ def train(argv=None):
                 cap = tracer.close()
                 if cap is not None and rt is not None:
                     rt.event("trace_captured", **cap)
+            store = getattr(fed_model, "_row_store", None)
+            if store is not None and rt is not None \
+                    and store.fatal_error is not None:
+                # the storage-fault terminal rung: the one actionable
+                # error, recorded so the ladder reproduces from the log
+                # alone (docs/fault_tolerance.md §storage faults)
+                rt.event("io_fatal", error=str(store.fatal_error))
             if rt is not None:
                 rt.close()
-    fed_model.finalize()
+            # EVERY exit path — including the storage-fault terminal
+            # rung — drains and joins the row store's I/O worker
+            fed_model.finalize()
+    if args.do_finetune:
+        fed_model.finalize()
     return stats
 
 

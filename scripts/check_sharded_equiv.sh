@@ -56,7 +56,17 @@
 #     finish_round(t) structural overlap assert under the strict
 #     zero-host-sync audit, disk-tier mid-epoch crash->resume
 #     bit-exactness, and the 10^6-client RSS bound
-#     (tests/test_host_offload.py — non-slow tier).
+#     (tests/test_host_offload.py — non-slow tier);
+#   - the storage-fault-tolerant offload data plane
+#     (docs/fault_tolerance.md §storage faults): seeded transient
+#     eio/short/torn/stall injection BIT-invisible below the retry
+#     budget (store-level AND e2e through cv_train on the forced disk
+#     tier), the watchdog deadline turning a hung op into one actionable
+#     error, row quarantine's counted degradation, the full persistent-
+#     fault ladder (retries -> quarantine -> watch-forced checkpoint ->
+#     terminal error) reproduced from the JSONL log alone, coalesced-
+#     vs-per-row gather bit-identity, bounded-queue + close-report
+#     shutdown hygiene (tests/test_io_faults.py).
 # Any extra args are passed through to pytest (e.g. -k bit_identical).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -67,4 +77,5 @@ exec env JAX_PLATFORMS=cpu \
     tests/test_telemetry.py tests/test_watch.py \
     tests/test_compressed_collectives.py \
     tests/test_participation.py tests/test_host_offload.py \
+    tests/test_io_faults.py \
     -q -m "not slow" -p no:cacheprovider "$@"

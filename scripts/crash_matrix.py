@@ -21,9 +21,22 @@ The sketched fp32 trajectory is bit-identical between the replicated and
 ``--server_shard`` planes (tests/test_sharded_server.py), so one baseline
 serves both planes' kill/resume legs.
 
+The DISK leg (docs/fault_tolerance.md §storage faults) additionally
+covers the host-offload data plane: a forced disk-tier run (per-client
+error rows in a sparse ``host_state.MemmapRowStore``) is SIGKILLed
+mid-epoch — i.e. mid-scatter, the worker writes rows continuously — and
+its backing file is then deliberately TORN (bytes flipped) before the
+resume, emulating a half-landed pwrite at the kill instant. ``--resume
+auto`` must recover from the checkpoint's CRC'd ``.rows`` snapshot (the
+fresh store truncates the torn backing file before the snapshot copies
+back), bit-identical to an uninterrupted disk-tier baseline. The disk
+trajectory is near-exact but NOT bitwise vs the direct-state planes
+(the documented delta-roundtrip caveat), so the leg carries its own
+baseline.
+
 Usage:
     python scripts/crash_matrix.py [--trials N] [--seed S] [--workdir DIR]
-                                   [--planes replicated,shard]
+                                   [--planes replicated,shard,disk]
 """
 
 from __future__ import annotations
@@ -52,7 +65,14 @@ ROUNDS_PER_EPOCH = 10
 EPOCHS = 2
 
 
-def child_env() -> dict:
+# the disk leg's forced placement: 1-byte budgets push the memory plan
+# past the hbm and host tiers onto the MemmapRowStore (the
+# tests/test_host_offload.py idiom)
+DISK_ENV = {"COMMEFFICIENT_STATE_HBM_BUDGET": "1",
+            "COMMEFFICIENT_STATE_HOST_BUDGET": "1"}
+
+
+def child_env(extra: dict | None = None) -> dict:
     env = dict(os.environ)
     # The persistent XLA compile cache (tests/conftest.py exports
     # JAX_COMPILATION_CACHE_DIR into pytest's environment) is OFF for the
@@ -81,18 +101,27 @@ def child_env() -> dict:
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                             + " --xla_force_host_platform_device_count=8"
                             ).strip()
+    if extra:
+        env.update(extra)
     return env
 
 
-def train_argv(dataset_dir: str, ckpt_dir: str, shard: bool) -> list:
+def train_argv(dataset_dir: str, ckpt_dir: str, shard: bool,
+               disk: bool = False) -> list:
+    # the disk leg needs PER-CLIENT state for a row store to exist:
+    # local error feedback (client-side momentum, so virtual momentum 0
+    # per the ServerConfig contract); the direct-state legs keep the
+    # original virtual-EF config
+    error_type = "local" if disk else "virtual"
+    lmom, vmom = ("0.9", "0") if disk else ("0", "0.9")
     argv = [
         sys.executable, os.path.join(_REPO, "cv_train.py"),
         "--dataset_name", "CIFAR10", "--dataset_dir", dataset_dir,
         "--num_epochs", str(EPOCHS), "--num_workers", "2",
         "--local_batch_size", "4", "--valid_batch_size", "8",
         "--iid", "--num_clients", "4",
-        "--mode", "sketch", "--error_type", "virtual",
-        "--local_momentum", "0", "--virtual_momentum", "0.9",
+        "--mode", "sketch", "--error_type", error_type,
+        "--local_momentum", lmom, "--virtual_momentum", vmom,
         "--k", "200", "--num_cols", "1024", "--num_rows", "3",
         "--num_blocks", "2",
         "--lr_scale", "0.01", "--pivot_epoch", "0.5", "--seed", "0",
@@ -105,11 +134,32 @@ def train_argv(dataset_dir: str, ckpt_dir: str, shard: bool) -> list:
     ]
     if shard:
         argv += ["--server_shard", "--num_devices", "2"]
+    if disk:
+        argv += ["--state_dir", os.path.join(ckpt_dir, "state")]
     return argv
 
 
-def run_to_completion(argv, timeout=900) -> None:
-    proc = subprocess.run(argv, env=child_env(), cwd=_REPO,
+def tear_backing_file(state_dir: str) -> None:
+    """Emulate the torn pwrite a SIGKILL mid-scatter can leave behind:
+    flip bytes at the head of every backing row file. The resume must
+    not read any of this — the fresh store truncates the files and
+    ``restore_snapshot`` copies the checkpoint's CRC'd ``.rows``
+    snapshot back — which is exactly what this drill pins."""
+    for name in os.listdir(state_dir):
+        if not name.endswith(".f32"):
+            continue
+        path = os.path.join(state_dir, name)
+        with open(path, "r+b") as f:
+            head = f.read(64)
+            if not head:
+                continue
+            f.seek(0)
+            f.write(bytes(b ^ 0xFF for b in head))  # guaranteed change
+    print(f"[crash_matrix] tore backing files under {state_dir}")
+
+
+def run_to_completion(argv, timeout=900, env_extra=None) -> None:
+    proc = subprocess.run(argv, env=child_env(env_extra), cwd=_REPO,
                           stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                           text=True, timeout=timeout)
     if proc.returncode != 0:
@@ -117,7 +167,8 @@ def run_to_completion(argv, timeout=900) -> None:
                            + proc.stdout[-3000:])
 
 
-def run_and_kill(argv, kill_after_round: int, timeout=900) -> int:
+def run_and_kill(argv, kill_after_round: int, timeout=900,
+                 env_extra=None) -> int:
     """Start the training child and SIGKILL it the moment its
     ``kill_after_round``-th round's heartbeat lands. The heartbeat is
     emitted by the round engine and carries the telemetry round index —
@@ -126,7 +177,7 @@ def run_and_kill(argv, kill_after_round: int, timeout=900) -> int:
     instead of the old per-epoch line counting. Returns the 1-based count
     at the kill; the child may race a round further before the signal
     lands — that is the point, preemption is not polite."""
-    proc = subprocess.Popen(argv, env=child_env(), cwd=_REPO,
+    proc = subprocess.Popen(argv, env=child_env(env_extra), cwd=_REPO,
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.PIPE, text=True)
     seen = 0
@@ -182,32 +233,53 @@ def assert_identical(a: dict, b: dict, what: str) -> None:
 
 
 def run_matrix(workdir: str, trials: int = 1, seed: int = 0,
-               planes=("replicated", "shard")) -> None:
+               planes=("replicated", "shard", "disk")) -> None:
     rng = random.Random(seed)
     data = os.path.join(workdir, "data")
     base_ckpt = os.path.join(workdir, "baseline")
 
-    print(f"[crash_matrix] baseline run ({EPOCHS} epochs x "
-          f"{ROUNDS_PER_EPOCH} rounds)")
-    run_to_completion(train_argv(data, base_ckpt, shard=False))
-    want = final_weights(base_ckpt)
+    want = want_disk = None
+    if any(p != "disk" for p in planes):
+        print(f"[crash_matrix] baseline run ({EPOCHS} epochs x "
+              f"{ROUNDS_PER_EPOCH} rounds)")
+        run_to_completion(train_argv(data, base_ckpt, shard=False))
+        want = final_weights(base_ckpt)
+    if "disk" in planes:
+        # the disk tier's trajectory is near-exact but not bitwise vs the
+        # direct-state planes (delta-roundtrip caveat) — its own baseline
+        disk_base = os.path.join(workdir, "baseline_disk")
+        print("[crash_matrix] disk-tier baseline run")
+        run_to_completion(train_argv(data, disk_base, shard=False,
+                                     disk=True), env_extra=DISK_ENV)
+        want_disk = final_weights(disk_base)
 
     total_rounds = EPOCHS * ROUNDS_PER_EPOCH
     for plane in planes:
         shard = plane == "shard"
+        disk = plane == "disk"
+        env_extra = DISK_ENV if disk else None
         for trial in range(trials):
             # randomized mid-epoch kill point, away from the very last
             # rounds so the resume leg has real work left to replay
             kill_round = rng.randint(2, total_rounds - 3)
             ckpt = os.path.join(workdir, f"{plane}_t{trial}")
-            argv = train_argv(data, ckpt, shard=shard)
+            argv = train_argv(data, ckpt, shard=shard, disk=disk)
             print(f"[crash_matrix] {plane} trial {trial}: SIGKILL at "
                   f"round {kill_round}")
-            killed_at = run_and_kill(argv, kill_round)
+            killed_at = run_and_kill(argv, kill_round,
+                                     env_extra=env_extra)
+            if disk:
+                # the storage half of the drill: a kill mid-scatter can
+                # leave a half-landed pwrite — make it CERTAIN by tearing
+                # the backing files; recovery must come from the CRC'd
+                # .rows snapshot, never these bytes
+                tear_backing_file(os.path.join(ckpt, "state"))
             print(f"[crash_matrix] killed at round {killed_at}; resuming "
                   f"with --resume auto")
-            run_to_completion(argv + ["--resume", "auto"])
-            assert_identical(want, final_weights(ckpt),
+            run_to_completion(argv + ["--resume", "auto"],
+                              env_extra=env_extra)
+            assert_identical(want_disk if disk else want,
+                             final_weights(ckpt),
                              f"{plane} trial {trial} (killed at round "
                              f"{killed_at})")
             print(f"[crash_matrix] {plane} trial {trial}: fp32 trajectory "
@@ -220,7 +292,7 @@ def main(argv=None) -> int:
     ap.add_argument("--trials", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--workdir", default=None)
-    ap.add_argument("--planes", default="replicated,shard")
+    ap.add_argument("--planes", default="replicated,shard,disk")
     args = ap.parse_args(argv)
     planes = tuple(p for p in args.planes.split(",") if p)
     workdir = args.workdir or tempfile.mkdtemp(prefix="crash_matrix_")

@@ -204,6 +204,15 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
     # (tests/test_host_offload.py compares these against the live
     # prefetcher's counters).
     offloads = [e["offload"] for e in rounds if "offload" in e]
+    # storage-fault ladder events (docs/fault_tolerance.md §storage
+    # faults): worker-side row quarantines surfaced as immediate events,
+    # plus the terminal rung's one actionable error — the acceptance
+    # drill is that the WHOLE ladder (retries → quarantines →
+    # watch-forced checkpoint → fatal) reproduces from the log alone
+    quarantine_events = [e for e in events
+                         if e.get("ev") == "row_quarantined"]
+    io_fatal = next((e.get("error") for e in reversed(events)
+                     if e.get("ev") == "io_fatal"), None)
     host_offload = None
     if offloads or run_info.get("state_placement") in ("host", "disk"):
         host_offload = {
@@ -230,6 +239,22 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
             "scatter_io_ms_p50": _fin(_pct(
                 [o["scatter_io_ms"] for o in offloads
                  if "scatter_io_ms" in o], 0.5)),
+            # storage-fault ladder (per-round offload-span deltas summed
+            # back to run totals — matched against the live store's
+            # io_counters in tests/test_io_faults.py)
+            "io_retries": sum(o.get("io_retries", 0) for o in offloads),
+            "io_errors": sum(o.get("io_errors", 0) for o in offloads),
+            "rows_quarantined": len(quarantine_events),
+            "quarantine_rounds": [e.get("round")
+                                  for e in quarantine_events],
+            "queue_depth_max": max(
+                (o["queue_depth"] for o in offloads
+                 if "queue_depth" in o), default=None),
+            "queue_age_ms_p50": _fin(_pct(
+                [o["queue_age_ms"] for o in offloads
+                 if "queue_age_ms" in o], 0.5)),
+            "io_fatal": io_fatal,
+            "io_config": run_info.get("state_io"),
         }
 
     # Watch/alert plane (telemetry.WatchEngine, docs/observability.md):
@@ -490,6 +515,30 @@ def render(events: List[dict], out=None) -> Dict[str, Any]:
                   "overlapped with the next round's compute)"
                   if ho.get("scatter_io_ms_p50") is not None else "")
             p(f"scatter dispatch p50 {ho['scatter_ms_p50']} ms{io}")
+        cfg = ho.get("io_config")
+        if cfg:
+            inj = (f", injection {cfg['inject']}" if cfg.get("inject")
+                   else "")
+            p(f"I/O plane: queue bound {cfg.get('queue_bound')} ops, "
+              f"{cfg.get('retries')} retries x "
+              f"{cfg.get('backoff_ms')} ms backoff, watchdog deadline "
+              f"{cfg.get('deadline_ms')} ms, row quarantine after "
+              f"{cfg.get('quarantine_after')} failed attempts{inj}")
+        if (ho.get("io_retries") or ho.get("io_errors")
+                or ho.get("rows_quarantined") or ho.get("io_fatal")):
+            p("\n### Storage-fault ladder "
+              "(docs/fault_tolerance.md §storage faults)")
+            p(f"{ho.get('io_retries', 0)} retried attempt(s), "
+              f"{ho.get('io_errors', 0)} exhausted op(s), "
+              f"{ho.get('rows_quarantined', 0)} row(s) quarantined"
+              + (f" at rounds {ho['quarantine_rounds']}"
+                 if ho.get("quarantine_rounds") else ""))
+            for e in (x for x in events
+                      if x.get("ev") == "row_quarantined"):
+                p(f"- row {e.get('row')} quarantined at round "
+                  f"{e.get('round')} ({e.get('op')}: {e.get('cause')})")
+            if ho.get("io_fatal"):
+                p(f"- TERMINAL: {ho['io_fatal']}")
 
     p("\n## Guard / rollback history")
     if not s["guards"]:

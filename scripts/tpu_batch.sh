@@ -81,9 +81,14 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # ride the same pending window as the telemetry A/B — the gate is
 # <= 2% rounds/sec with histograms + watch enabled
 # (docs/observability.md).
+# NOTE (storage-fault PR): the io_faults capture + io_faults_ab A/B
+# (clean vs injection-idle vs transient disk-tier rounds, gate <= 2%
+# idle — docs/fault_tolerance.md §storage faults) ride the same pending
+# window as the clients_sweep/host_offload_scale legs (same compile
+# class).
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
-coalesce telemetry watch downlink straggler clients_sweep participation \
-host_offload_scale watch_ab \
+coalesce telemetry watch downlink straggler clients_sweep io_faults \
+participation host_offload_scale watch_ab io_faults_ab \
 compressed_collectives stream_sketch sketch_coalesce fused_epilogue \
 learning profile profile_fused profile_stream profile_coalesce \
 profile_gpt2 host_offload imagenet ops"}
@@ -114,7 +119,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|watch|downlink|straggler|clients_sweep)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|watch|downlink|straggler|clients_sweep|io_faults)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact.
       # `telemetry` is the telemetry-overhead A/B leg: headline geometry
@@ -216,6 +221,21 @@ for step in $STEPS; do
           && grep -q "host_offload_scale A/B" \
             "$OUT/tpu_measure_host_offload_scale.log"; then
         mark_done host_offload_scale
+      fi
+      ;;
+    io_faults_ab)
+      # storage-fault-plane A/B (docs/fault_tolerance.md §storage
+      # faults): disk-tier rounds clean vs injection-idle (gate <= 2%)
+      # vs seeded transient faults, final rows pinned bit-identical
+      log "step $i: tpu_measure.py io_faults A/B (timeout 30m)"
+      timeout 1800 python scripts/tpu_measure.py io_faults \
+        >"$OUT/tpu_measure_io_faults.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_io_faults.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "io_faults A/B" "$OUT/tpu_measure_io_faults.log"
+      then
+        mark_done io_faults_ab
       fi
       ;;
     compressed_collectives)

@@ -591,6 +591,99 @@ def host_offload_scale_leg():
               f"({off / on:.2f}x serial gather cost hidden)", flush=True)
 
 
+def io_faults_leg():
+    """Storage-fault-plane A/B (docs/fault_tolerance.md §storage faults):
+    the disk-tier gather -> headline sketched round -> scatter cycle,
+    clean vs injection-idle (the armed-but-silent seam + retry ladder +
+    watchdog — gate <= 2% rounds/sec) vs seeded transient faults below
+    the retry budget (the retries' cost priced, the final rows pinned
+    BIT-identical to the clean leg — retried I/O lands the same
+    bytes)."""
+    import shutil
+    import tempfile
+
+    from commefficient_tpu.federated.host_state import (
+        CohortPrefetcher,
+        MemmapRowStore,
+        parse_io_fault,
+    )
+    from commefficient_tpu.federated.rounds import ClientStates
+    from commefficient_tpu.parallel.mesh import default_client_mesh
+
+    _copy_rows = jax.jit(jnp.copy)
+    n = int(os.environ.get("IO_FAULTS_CLIENTS", "100000"))
+    iters = 20
+    rows = []
+    finals = {}
+    W = mesh = None
+    for tag, spec in (
+            ("clean", None),
+            ("idle", "eio=0,short=0,torn=0,stall=0,seed=0"),
+            ("transient", "eio=0.02,short=0.01,torn=0.01,stall=0.01,"
+                          "stall_ms=2,seed=11")):
+        steps, ps, ss, cs, batch = B.build(tiny=False, error_type="local")
+        if W is None:
+            W = int(np.asarray(batch["worker_mask"]).shape[0])
+            mesh = default_client_mesh(W)
+        row_shape = tuple(int(x) for x in cs.errors.shape[1:])
+        batch = dict(batch)
+        batch["client_ids"] = jnp.arange(W, dtype=jnp.int32)
+        store_dir = tempfile.mkdtemp(prefix=f"io_faults_{tag}_")
+        store = MemmapRowStore(store_dir, n, {"errors": row_shape},
+                               mesh=mesh,
+                               inject=parse_io_fault(spec) if spec
+                               else None,
+                               io_backoff_ms=0.5)
+        pf = CohortPrefetcher(store.gather_async)
+        rng = np.random.RandomState(11)
+        cohorts = [rng.choice(n, W, replace=False)
+                   for _ in range(iters + 2)]
+
+        def run_rounds(k, ps_, ss_, ms):
+            pf.prefetch(cohorts[0])
+            for i in range(k):
+                stream, _ = pf.take(cohorts[i])
+                old = ClientStates(None, _copy_rows(stream.proxy.errors),
+                                   None)
+                o = steps.train_step(ps_, ss_, stream.proxy, ms, batch,
+                                     0.1, jax.random.key(i))
+                ps_, ss_, new_proxy, ms = o[:4]
+                store.scatter(stream, old, new_proxy)
+                pf.prefetch(cohorts[i + 1])
+            store.drain()
+            return ps_, ss_, ms
+
+        state = run_rounds(1, ps, ss, {})  # compile + touch rows
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            state = run_rounds(iters, *state)
+            drain(state[0])
+            best = min(best, (time.perf_counter() - t0) / iters)
+        counts = store.io_counters()
+        rows.append((tag, best))
+        finals[tag] = store.read_full("errors")
+        print(f"io_faults {tag}: {best * 1e3:.2f} ms/round "
+              f"({1 / best:.1f} r/s; {counts['retries']} retries, "
+              f"{counts['errors']} exhausted, "
+              f"{counts['quarantined']} quarantined)", flush=True)
+        store.close()
+        shutil.rmtree(store_dir, ignore_errors=True)
+    if len(rows) == 3:
+        clean, idle, transient = (dt for _, dt in rows)
+        print(f"io_faults A/B: idle injection costs "
+              f"{(idle - clean) * 1e3:+.3f} ms/round "
+              f"({(idle / clean - 1) * 100:+.2f}% — gate <= 2%), "
+              f"transient faults cost "
+              f"{(transient - clean) * 1e3:+.3f} ms/round", flush=True)
+        same = (np.array_equal(finals["clean"], finals["idle"])
+                and np.array_equal(finals["clean"], finals["transient"]))
+        print(f"io_faults rows bit-identical across legs: {same}",
+              flush=True)
+        assert same, ("transient-fault rows diverged from the clean leg "
+                      "— retries are NOT invisible to the trajectory")
+
+
 def gpt2_leg(bf16):
     steps, ps, ss, cs, batch, tokens = B.build_gpt2(bf16=bf16)
     # train_step donates ps/client_states: after this call the local
@@ -684,7 +777,7 @@ def main():
     known = {"matmul", "cifar", "ops", "gpt2", "imagenet", "topk_ab",
              "fused_epilogue", "stream_sketch", "sketch_coalesce",
              "compressed_collectives", "participation",
-             "host_offload_scale", "watch"}
+             "host_offload_scale", "watch", "io_faults"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -727,6 +820,8 @@ def main():
         leg("host_offload_scale", host_offload_scale_leg)
     if sel("watch"):
         leg("watch", watch_leg)
+    if sel("io_faults"):
+        leg("io_faults", io_faults_leg)
 
 
 if __name__ == "__main__":
