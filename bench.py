@@ -1016,6 +1016,122 @@ def run_io_faults_measurement() -> None:
     print(json.dumps(out), flush=True)
 
 
+def run_integrity_measurement() -> None:
+    """Child-process entry (--run-cfg integrity): integrity-plane
+    overhead A/B (docs/fault_tolerance.md §silent corruption).
+
+    Three legs over the disk-tier gather -> round -> scatter cycle at a
+    10^5-row population (the io_faults loop shape), no injection: (a)
+    OFF — per-row checksums disabled; (b) ON-IDLE — checksums verified
+    on every row read/write (gate: <= 2% rounds/sec vs off — one CRC32
+    pass per row against MB-scale row I/O); (c) SCRUB — checksums plus
+    a 32-row background scrub per round on the ordered worker
+    (overlapped; prices the full audit cadence). Verification only
+    reads, so the final rows are pinned BIT-identical across all three
+    legs (``integrity_bit_identical``)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.federated.host_state import (
+        CohortPrefetcher,
+        MemmapRowStore,
+    )
+    from commefficient_tpu.federated.rounds import ClientStates
+    from commefficient_tpu.parallel.mesh import default_client_mesh
+
+    _check_pallas_kernel()
+    tiny = jax.default_backend() not in ("tpu", "axon")
+    _copy_rows = jax.jit(jnp.copy)
+    W = NUM_WORKERS
+    mesh = default_client_mesh(W)
+    n = 10_000 if tiny else 100_000
+    iters, reps = (10, 2) if tiny else (20, 3)
+    legs = (
+        ("off", False, 0),
+        ("on_idle", True, 0),
+        ("scrub", True, 32),
+    )
+    out = {
+        "integrity_metric": (
+            "8-worker sketched disk-tier rounds/sec: per-row checksums "
+            "off vs on-idle (gate <= 2%) vs on + 32-row/round background "
+            "scrub (rows pinned bit-identical across legs; "
+            "docs/fault_tolerance.md §silent corruption)"),
+        "integrity_tiny": tiny,
+        "platform": jax.default_backend(),
+    }
+    finals = {}
+    for tag, checksums, scrub in legs:
+        # per-leg rebuild: train_step donates the state buffers; the
+        # COMPILE is shared through the jit cache
+        steps, ps, server_state, client_states, batch = build(
+            tiny=tiny, error_type="local")
+        row_shape = tuple(int(x) for x in client_states.errors.shape[1:])
+        batch = dict(batch)
+        batch["client_ids"] = jnp.arange(W, dtype=jnp.int32)
+        store_dir = tempfile.mkdtemp(prefix=f"integrity_{tag}_")
+        store = MemmapRowStore(store_dir, n, {"errors": row_shape},
+                               mesh=mesh, checksums=checksums,
+                               scrub_rows=scrub)
+        pf = CohortPrefetcher(store.gather_async)
+        rng = np.random.RandomState(7)
+        cohorts = [rng.choice(n, W, replace=False)
+                   for _ in range(iters + 2)]
+
+        def run_rounds(k, ps_, ss_, ms):
+            pf.prefetch(cohorts[0])
+            for i in range(k):
+                stream, _ = pf.take(cohorts[i])
+                old = ClientStates(None, _copy_rows(stream.proxy.errors),
+                                   None)
+                o = steps.train_step(ps_, ss_, stream.proxy, ms, batch,
+                                     0.1, jax.random.key(i))
+                ps_, ss_, new_proxy, ms = o[:4]
+                store.scatter(stream, old, new_proxy)
+                store.scrub_async()
+                pf.prefetch(cohorts[i + 1])
+            store.drain()
+            jax.block_until_ready(ps_)
+            return ps_, ss_, ms
+
+        state = run_rounds(1, ps, server_state, {})  # compile + warm
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            state = run_rounds(iters, *state)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        rps = iters / best
+        counts = store.io_counters()
+        out[f"integrity_rounds_per_sec_{tag}"] = round(rps, 4)
+        out[f"integrity_scrub_checked_{tag}"] = counts["scrub_checked"]
+        assert counts["corrupt"] == 0, (
+            f"integrity {tag}: clean leg detected corruption — the "
+            f"sidecar bookkeeping is wrong")
+        finals[tag] = store.read_full("errors")
+        _log(f"integrity {tag}: {rps:.2f} rounds/s "
+             f"({counts['scrub_checked']} rows scrubbed, "
+             f"{counts['corrupt']} corrupt)")
+        store.close()
+        shutil.rmtree(store_dir, ignore_errors=True)
+    off_rps = out["integrity_rounds_per_sec_off"]
+    out["integrity_on_idle_vs_off"] = round(
+        out["integrity_rounds_per_sec_on_idle"] / off_rps, 4)
+    out["integrity_scrub_vs_off"] = round(
+        out["integrity_rounds_per_sec_scrub"] / off_rps, 4)
+    out["integrity_bit_identical"] = bool(
+        np.array_equal(finals["off"], finals["on_idle"])
+        and np.array_equal(finals["off"], finals["scrub"]))
+    assert out["integrity_bit_identical"], (
+        "checksum-on rows diverged from the checksums-off leg — "
+        "verification must only READ")
+    print(json.dumps(out), flush=True)
+
+
 # --------------------------------------------------------------------------
 # parent orchestration
 # --------------------------------------------------------------------------
@@ -1115,6 +1231,11 @@ _EXTRA_LEGS = {
     # seeded transient faults (bit-identical rows pinned in-leg)
     "io_faults": (["--run-cfg", "io_faults"], "BENCH_C12_TIMEOUT", 900,
                   "io_faults_rounds_per_sec_idle"),
+    # integrity plane (docs/fault_tolerance.md §silent corruption):
+    # disk-tier rounds/sec checksums-off vs on-idle (gate <= 2%) vs
+    # on + background scrub (bit-identical rows pinned in-leg)
+    "integrity": (["--run-cfg", "integrity"], "BENCH_C12_TIMEOUT", 900,
+                  "integrity_rounds_per_sec_on_idle"),
 }
 
 
@@ -1416,6 +1537,11 @@ if __name__ == "__main__":
             # storage-fault-plane overhead A/B (same custom round loop)
             run_io_faults_measurement()
             sys.exit(0)
+        if sel == "integrity":
+            # integrity-plane overhead A/B: checksums off / on-idle /
+            # scrub-active (same custom round loop)
+            run_integrity_measurement()
+            sys.exit(0)
         # the allowlist IS the leg table — a hand-maintained copy here
         # silently orphaned the coalesce/straggler captures (their
         # children exited "unknown config" while the parent reported a
@@ -1425,7 +1551,7 @@ if __name__ == "__main__":
             # parent orchestration and claim the chip for a headline bench
             sys.exit(f"--run-cfg: unknown config {sel!r}; use "
                      + "|".join(sorted(_CFG_LEGS))
-                     + "|clients_sweep|io_faults")
+                     + "|clients_sweep|io_faults|integrity")
         run_config_measurement(sel)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--capture":

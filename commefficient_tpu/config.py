@@ -514,6 +514,38 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                              "path instead of accumulating unbounded "
                              "pending scatter deltas in host RAM. 0 = "
                              "auto (max(8, 4 x --round_window)).")
+    # Integrity plane (docs/fault_tolerance.md §silent corruption): one
+    # CRC32 per (member, row) in a sidecar array, recorded on every row
+    # write and verified on every row read — the fault class the retry
+    # ladder cannot see (corruption that never errors: bit rot, a
+    # silently-lying torn write, --inject_io_fault flip/storn) becomes a
+    # detected, counted, repaired-or-quarantined event. Verification
+    # only reads, so the clean-path fp32 trajectory is bit-identical
+    # checksums on/off (tests/test_integrity.py); overhead gate <= 2%
+    # rounds/sec (bench.py --run-cfg integrity).
+    parser.add_argument("--io_checksums", action="store_true",
+                        dest="io_checksums", default=True,
+                        help="Per-row CRC32 verification of the disk-"
+                             "tier row store: every row read checks a "
+                             "write-time sidecar checksum; mismatches "
+                             "repair from the CRC'd .rows snapshot or "
+                             "quarantine (the default for the disk "
+                             "tier).")
+    parser.add_argument("--no_io_checksums", action="store_false",
+                        dest="io_checksums",
+                        help="Disable per-row checksums (bit-identical "
+                             "trajectories on the clean path either "
+                             "way; COMMEFFICIENT_IO_CHECKSUMS=0 is the "
+                             "no-restart kill-switch).")
+    parser.add_argument("--io_scrub_rows", type=int, default=0,
+                        help="Background scrub budget: verify this many "
+                             "cold rows per round against the checksum "
+                             "sidecar on the store's ordered I/O worker "
+                             "(rolling cursor over the population), so "
+                             "corruption in rows no cohort touches is "
+                             "found and repaired before the next "
+                             "snapshot inherits it (0 = off; requires "
+                             "--io_checksums).")
     # Fault-injection debug hook (tests/test_fault_tolerance.py): poison
     # the aggregated transmit of the given dispatch round(s) so guard
     # detection/quarantine is testable end-to-end.
@@ -632,6 +664,11 @@ def validate_args(args):
     assert args.io_backoff_ms >= 0, "--io_backoff_ms must be >= 0"
     assert args.io_deadline_ms >= 0, "--io_deadline_ms must be >= 0"
     assert args.io_queue_bound >= 0, "--io_queue_bound must be >= 0"
+    assert args.io_scrub_rows >= 0, "--io_scrub_rows must be >= 0"
+    if args.io_scrub_rows and not args.io_checksums:
+        print("NOTE: --io_scrub_rows verifies rows against the per-row "
+              "checksum sidecar; with --no_io_checksums there is nothing "
+              "to verify and the scrub is inert")
     if args.inject_fault:
         parse_inject_fault(args.inject_fault)  # fail fast on a bad spec
         if not args.guards:

@@ -454,7 +454,9 @@ class FedModel:
                 io_backoff_ms=float(getattr(args, "io_backoff_ms", 5.0)),
                 io_deadline_ms=float(getattr(args, "io_deadline_ms",
                                              30000.0)),
-                queue_bound=queue_bound)
+                queue_bound=queue_bound,
+                checksums=bool(getattr(args, "io_checksums", True)),
+                scrub_rows=int(getattr(args, "io_scrub_rows", 0) or 0))
             # counter snapshot for the per-round offload-span deltas (the
             # watch plane's io_retry/io_error rules observe per-round
             # values, not run totals)
@@ -512,7 +514,11 @@ class FedModel:
                       f"retries x {st.io_backoff_ms:g} ms backoff, "
                       f"watchdog deadline {st.io_deadline_ms:g} ms, row "
                       f"quarantine after {st.quarantine_after} failed "
-                      f"attempts"
+                      f"attempts, per-row checksums "
+                      + ("ON" if st.checksums else
+                         "OFF (--no_io_checksums)")
+                      + (f" + scrub {st.scrub_rows} rows/round"
+                         if st.scrub_rows else "")
                       + (f", fault injection "
                          f"{st.inject.schedule.spec()}"
                          if st.inject is not None else ""))
@@ -897,14 +903,28 @@ class FedModel:
                     "io_errors": counts["errors"] - last["errors"],
                     "io_quarantined": (counts["quarantined"]
                                        - last["quarantined"]),
+                    # integrity plane (docs/fault_tolerance.md §silent
+                    # corruption): detection/repair/scrub deltas — the
+                    # observables the watch plane's io_corrupt /
+                    # scrub_mismatch rules read
+                    "io_corrupt": counts["corrupt"] - last["corrupt"],
+                    "io_repaired": counts["repaired"] - last["repaired"],
+                    "scrub_rows": (counts["scrub_checked"]
+                                   - last["scrub_checked"]),
+                    "scrub_mismatch": (counts["scrub_mismatch"]
+                                       - last["scrub_mismatch"]),
                     "queue_depth": st.queue_depth(),
                     "queue_age_ms": round(st.queue_age_ms(), 3),
                 })
                 self._io_counts_last = counts
                 for ev in st.pop_events():
+                    # worker-side ladder records (row_quarantined /
+                    # row_corrupt / row_repaired) become immediate
+                    # telemetry events HERE, on the dispatch thread —
+                    # the event log is never written from the I/O worker
                     if self.telemetry is not None:
-                        self.telemetry.event("row_quarantined",
-                                             round=round_no, **ev)
+                        kind = ev.pop("kind", "row_quarantined")
+                        self.telemetry.event(kind, round=round_no, **ev)
         pre_model_state = self._model_state
         # round-scoped trace span (docs/observability.md §trace capture):
         # names the client phase's dispatch inside a profiler capture; a
@@ -1169,6 +1189,11 @@ class FedModel:
                 # and the file write happen on the store's ordered I/O
                 # worker, overlapped with the next round's compute
                 self._row_store.scatter(stream, old, new_proxy)
+                # background integrity scrub rides the same ordered
+                # worker AFTER the scatter: --io_scrub_rows cold rows
+                # verified per round, overlapped like the scatter itself
+                # (no-op with scrubbing or checksums off)
+                self._row_store.scrub_async()
             else:
                 self.client_states = self._row_stream.scatter(
                     self.client_states, stream, old, new_proxy)

@@ -305,6 +305,11 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
         if os.path.isdir(stem + ".rows"):
             shutil.rmtree(stem + ".rows")
         os.replace(tmp_rows, stem + ".rows")
+        # the snapshot is the store's silent-corruption REPAIR source
+        # (host_state._snapshot_row) — re-point it at the renamed final
+        # directory, not the tmp name that no longer exists
+        if hasattr(store, "snapshot_moved"):
+            store.snapshot_moved(stem + ".rows")
         meta["client_store"] = store_meta
         # storage-fault plane (--inject_io_fault, docs/fault_tolerance.md
         # §storage faults): the seeded injector RNG + per-row consecutive-
@@ -469,27 +474,52 @@ def _verify_row_snapshot(path: str, meta: dict) -> None:
 
 
 def find_resume_checkpoint(checkpoint_path: str,
-                           return_contents: bool = False):
+                           return_contents: bool = False,
+                           exclude=()):
     """``--resume auto`` discovery: the newest run-state checkpoint under
     ``checkpoint_path`` that reads AND checksums clean — including, for
     disk-tier checkpoints, the sibling ``.rows`` row snapshot. Corrupt or
     truncated candidates (e.g. a file torn by the very preemption being
     recovered from) are reported and skipped, falling back to the next
     newest; returns None when nothing valid exists (callers start fresh).
+    Every skipped candidate logs WHY it was rejected — corrupt npz / bad
+    ``.rows`` snapshot / excluded — so an unattended supervisor's log
+    tells the whole discovery story.
+
+    ``exclude`` (paths), plus the ``os.pathsep``-joined
+    ``COMMEFFICIENT_RESUME_EXCLUDE`` environment variable, names
+    candidates to skip regardless of validity — the self-healing
+    supervisor's poison-checkpoint seam (``scripts/supervise.py``): a
+    checkpoint that reads clean but fails resume repeatedly (bad
+    semantic content the CRC cannot see) is excluded so the relaunch
+    falls back to the next-newest instead of crash-looping forever.
 
     Validation requires a full read + CRC pass; ``return_contents=True``
     returns ``(path, (flat, meta))`` so the caller can hand the validated
     contents straight to ``load_run_state(preloaded=...)`` instead of
     re-reading a run state that is GBs at GPT-2 scale."""
+    excluded = {os.path.abspath(p) for p in exclude}
+    env = os.environ.get("COMMEFFICIENT_RESUME_EXCLUDE", "")
+    excluded |= {os.path.abspath(p) for p in env.split(os.pathsep) if p}
     for path in _run_state_files(checkpoint_path):
+        if os.path.abspath(path) in excluded:
+            print(f"--resume auto: skipping {path}: excluded "
+                  f"(poison-checkpoint list)")
+            continue
         try:
             flat = _read_npz(path)
             meta = json.loads(bytes(flat.pop("meta_json")).decode())
             _verify_checksum(flat, meta, path)
-            _verify_row_snapshot(path, meta)
-            return (path, (flat, meta)) if return_contents else path
         except Exception as e:  # corrupt candidate — fall back to older
-            print(f"--resume auto: skipping {path}: {e}")
+            print(f"--resume auto: skipping {path}: corrupt npz ({e})")
+            continue
+        try:
+            _verify_row_snapshot(path, meta)
+        except Exception as e:
+            print(f"--resume auto: skipping {path}: bad .rows snapshot "
+                  f"({e})")
+            continue
+        return (path, (flat, meta)) if return_contents else path
     return None
 
 

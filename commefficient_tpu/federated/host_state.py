@@ -375,36 +375,47 @@ class IOFaultSchedule:
     uniform; the thresholds partition [0, 1): u < eio → a transient
     ``EIO``; u < eio+short → a short read (fewer bytes than requested);
     u < eio+short+torn → a torn write (half the bytes land, then the op
-    errors — the retryable-visible form: a silently-succeeding torn
-    write would be undetectable without per-row checksums, documented in
-    docs/fault_tolerance.md); u < eio+short+torn+stall → the op stalls
-    ``stall_ms`` before proceeding (a stall below the watchdog deadline
-    is pure latency; above it, the watchdog declares the store hung).
-    ``persist_after`` is the row-quarantine threshold: a row accumulating
-    that many CONSECUTIVE failed attempts is re-initialized from the
-    ``init_rows`` base (mirroring the client plane's
-    ``quarantine_after``). ``seed`` makes the whole schedule
-    deterministic under rerun — ops execute in submission order on ONE
-    worker thread, so the draw sequence is a pure function of the
-    config. An all-zero schedule is legal on purpose: it is the
-    "injection compiled in but idle" overhead probe the bench leg
-    measures."""
+    errors — the retryable-visible form); the next two kinds are the
+    SILENT faults PR 14 could not represent, the ones only per-row
+    checksums can see (docs/fault_tolerance.md §silent corruption):
+    ``flip`` corrupts one byte of the op's payload and the op SUCCEEDS
+    (on writes the corruption lands on disk; on reads it lands in the
+    returned buffer — the bit-rot vs bad-transfer pair), and ``storn``
+    is the silently-torn write (half the bytes land and the op reports
+    success; remapped to flip on reads, which have no silent-partial
+    form). Then u < …+stall → the op stalls ``stall_ms`` before
+    proceeding (a stall below the watchdog deadline is pure latency;
+    above it, the watchdog declares the store hung). ``persist_after``
+    is the row-quarantine threshold: a row accumulating that many
+    CONSECUTIVE failed attempts is re-initialized from the ``init_rows``
+    base (mirroring the client plane's ``quarantine_after``). ``seed``
+    makes the whole schedule deterministic under rerun — ops execute in
+    submission order on ONE worker thread, so the draw sequence is a
+    pure function of the config (the byte a flip corrupts derives from
+    the flip count + row index, NOT an extra RNG draw, so the one-draw-
+    per-op stream is untouched). An all-zero schedule is legal on
+    purpose: it is the "injection compiled in but idle" overhead probe
+    the bench leg measures."""
 
     eio: float = 0.0
     short: float = 0.0
     torn: float = 0.0
     stall: float = 0.0
+    flip: float = 0.0
+    storn: float = 0.0
     stall_ms: float = 50.0
     seed: int = 0
     persist_after: int = 3
 
     @property
     def active(self) -> bool:
-        return bool(self.eio or self.short or self.torn or self.stall)
+        return bool(self.eio or self.short or self.torn or self.stall
+                    or self.flip or self.storn)
 
     def spec(self) -> str:
         return (f"eio={self.eio:g},short={self.short:g},"
                 f"torn={self.torn:g},stall={self.stall:g},"
+                f"flip={self.flip:g},storn={self.storn:g},"
                 f"stall_ms={self.stall_ms:g},seed={self.seed},"
                 f"persist_after={self.persist_after}")
 
@@ -412,10 +423,10 @@ class IOFaultSchedule:
 def parse_io_fault(spec: str) -> IOFaultSchedule:
     """``--inject_io_fault`` grammar → IOFaultSchedule.
 
-    ``'eio=P,short=P,torn=P,stall=P,stall_ms=N,seed=N,persist_after=N'``
-    — every key optional; probability mass must leave room for healthy
-    ops (sum < 1). Fails at parse time with the offending entry named,
-    like the sibling fault grammars."""
+    ``'eio=P,short=P,torn=P,stall=P,flip=P,storn=P,stall_ms=N,seed=N,
+    persist_after=N'`` — every key optional; probability mass must leave
+    room for healthy ops (sum < 1). Fails at parse time with the
+    offending entry named, like the sibling fault grammars."""
     fields: Dict[str, Any] = {}
     for part in spec.split(","):
         part = part.strip()
@@ -426,9 +437,9 @@ def parse_io_fault(spec: str) -> IOFaultSchedule:
         except ValueError:
             raise ValueError(
                 f"--inject_io_fault: bad entry {part!r}; expected "
-                f"KEY=VALUE with KEY in eio|short|torn|stall|stall_ms|"
-                f"seed|persist_after") from None
-        if key in ("eio", "short", "torn", "stall"):
+                f"KEY=VALUE with KEY in eio|short|torn|stall|flip|storn|"
+                f"stall_ms|seed|persist_after") from None
+        if key in ("eio", "short", "torn", "stall", "flip", "storn"):
             p = float(val)
             assert 0.0 <= p <= 1.0, (
                 f"--inject_io_fault: {key}={val} must be in [0, 1]")
@@ -442,10 +453,12 @@ def parse_io_fault(spec: str) -> IOFaultSchedule:
         else:
             raise ValueError(
                 f"--inject_io_fault: unknown key {key!r}; use "
-                f"eio|short|torn|stall|stall_ms|seed|persist_after")
+                f"eio|short|torn|stall|flip|storn|stall_ms|seed|"
+                f"persist_after")
     sched = IOFaultSchedule(**fields)
-    assert sched.eio + sched.short + sched.torn + sched.stall <= 1.0, (
-        "--inject_io_fault: eio+short+torn+stall must be <= 1")
+    assert (sched.eio + sched.short + sched.torn + sched.stall
+            + sched.flip + sched.storn) <= 1.0, (
+        "--inject_io_fault: eio+short+torn+stall+flip+storn must be <= 1")
     assert sched.persist_after >= 1, (
         "--inject_io_fault: persist_after must be >= 1")
     return sched
@@ -461,7 +474,8 @@ class IOFaultInjector:
     def __init__(self, schedule: IOFaultSchedule):
         self.schedule = schedule
         self.rng = np.random.RandomState(schedule.seed)
-        self.injected = {"eio": 0, "short": 0, "torn": 0, "stall": 0}
+        self.injected = {"eio": 0, "short": 0, "torn": 0, "stall": 0,
+                         "flip": 0, "storn": 0}
 
     def draw(self) -> Optional[str]:
         s = self.schedule
@@ -472,12 +486,21 @@ class IOFaultInjector:
             return None
         u = float(self.rng.random_sample())
         acc = 0.0
-        for kind in ("eio", "short", "torn", "stall"):
+        for kind in ("eio", "short", "torn", "stall", "flip", "storn"):
             acc += getattr(s, kind)
             if u < acc:
                 self.injected[kind] += 1
                 return kind
         return None
+
+    def flip_pos(self, row: int, nbytes: int) -> int:
+        """The byte offset a drawn flip corrupts: a pure function of the
+        flip count + row index (Knuth multiplicative hash), NOT an extra
+        RNG draw — the one-draw-per-op stream stays a pure function of
+        the schedule, and the checkpointed RNG state alone replays the
+        corruption pattern."""
+        return (int(row) * 2654435761 + self.injected["flip"] * 131) \
+            % max(nbytes, 1)
 
 
 class _PendingStream:
@@ -575,6 +598,22 @@ class MemmapRowStore:
     (``queue_bound``) so a slow disk applies backpressure to the
     dispatch path instead of accumulating unbounded pending scatter
     deltas in host RAM.
+
+    Integrity plane (docs/fault_tolerance.md §silent corruption): with
+    ``checksums`` on (the disk-tier default; ``--no_io_checksums`` /
+    COMMEFFICIENT_IO_CHECKSUMS=0 disable), a per-(member, row) CRC32
+    sidecar records every row write's INTENDED bytes and every row read
+    (gather — incl. each row of a coalesced block — scatter RMW, scrub)
+    verifies against it, so the one fault class the retry ladder cannot
+    see — corruption that never errors (``flip``/``storn`` injection,
+    real bit rot, a silently-lying tear) — becomes a DETECTED, counted
+    event. Detection enters the repair ladder (``_handle_corrupt``):
+    verifying re-read → bit-exact repair from the last CRC'd ``.rows``
+    snapshot (clean rows only) → the existing quarantine rung. The
+    verification path only reads, so checksums-on is bit-identical to
+    checksums-off on a clean store. ``scrub_rows`` > 0 additionally
+    verifies that many rows per round on the ordered worker (rolling
+    cursor), so cold rows no cohort touches are audited too.
     """
 
     backend = "memmap"
@@ -586,7 +625,8 @@ class MemmapRowStore:
                  inject: Optional[IOFaultSchedule] = None,
                  io_retries: int = 3, io_backoff_ms: float = 5.0,
                  io_deadline_ms: float = 30000.0,
-                 queue_bound: int = 16):
+                 queue_bound: int = 16,
+                 checksums: bool = True, scrub_rows: int = 0):
         assert row_shapes, "a row store with no members is a bug upstream"
         for name in row_shapes:
             assert name in _MEMBERS, f"unknown state member {name!r}"
@@ -638,6 +678,40 @@ class MemmapRowStore:
         self.rows_quarantined = 0
         self.read_ops = 0            # raw pread calls (coalescing metric)
         self.coalesced_rows = 0      # rows served by multi-row preads
+        # ---- integrity plane (docs/fault_tolerance.md §silent
+        # corruption): one CRC32 per (member, row) in a sidecar array,
+        # recorded over the INTENDED bytes of every row write and
+        # verified on every row read (gather, scatter read-modify-write,
+        # scrub) — a mismatch is a DETECTED silent fault. Rows start as
+        # holes, so the sidecar initializes to the closed-form CRC of a
+        # zero row. COMMEFFICIENT_IO_CHECKSUMS=0 is the no-restart
+        # kill-switch beside the --no_io_checksums flag.
+        self.checksums = bool(checksums) and os.environ.get(
+            "COMMEFFICIENT_IO_CHECKSUMS", "1") != "0"
+        self.scrub_rows = int(scrub_rows)
+        self._zero_crc = {name: _crc32_zeros(0, nb)
+                          for name, nb in self._row_nbytes.items()}
+        self._crc: Optional[Dict[str, np.ndarray]] = (
+            {name: np.full(self.num_rows, self._zero_crc[name], np.uint32)
+             for name in self.row_shapes}
+            if self.checksums else None)
+        # the last CRC'd snapshot covering this store's rows, if any:
+        # (dir, {member: per-row CRCs at snapshot time}) — the repair
+        # source for corrupt rows NOT written since ("clean" rows repair
+        # BIT-exactly from it; dirty or uncovered rows fall to the
+        # quarantine rung). Set by save_snapshot/restore_snapshot. The
+        # dirty ledger is one bool per (member, row) — a numpy array,
+        # not a tuple set: at the 10^6-row population this is 1 MB per
+        # member instead of ~100 MB of boxed tuples.
+        self._snap: Optional[Tuple[str, Dict[str, np.ndarray]]] = None
+        self._dirty: Dict[str, np.ndarray] = {
+            name: np.zeros(self.num_rows, bool)
+            for name in self.row_shapes}
+        self.rows_corrupt = 0        # detected checksum mismatches
+        self.rows_repaired = 0       # … repaired (reread or snapshot)
+        self.scrub_checked = 0       # rows the background scrub verified
+        self.scrub_mismatch = 0      # … that failed verification
+        self._scrub_cursor = 0
         self._row_fails: Dict[int, int] = {}  # consecutive failed attempts
         self._events: list = []      # row_quarantined records (pop_events)
         self._ev_lock = threading.Lock()
@@ -738,6 +812,12 @@ class MemmapRowStore:
             # fault is a partial transfer — remap instead of silently
             # no-opping, so every drawn (and counted) fault is exercised
             kind = "short"
+        elif kind == "storn":
+            # the silently-torn write has no silent-partial read form (a
+            # short read is length-checked below, i.e. loud) — the read-
+            # side silent equivalent is buffer corruption, same remap
+            # rationale as torn->short
+            kind = "flip"
         if kind == "stall":
             self._injected_stall()
         elif kind == "eio":
@@ -753,12 +833,24 @@ class MemmapRowStore:
             raise OSError(errno.EIO,
                           f"short read: {len(buf)}/{want} bytes "
                           f"({name} row {row0})")
-        return np.frombuffer(buf, np.float32).reshape(
+        if kind == "flip":
+            # SILENT read-side corruption (a bad transfer, not bad
+            # media): one byte of the returned buffer flips and the op
+            # reports success — only the per-row checksum can see it;
+            # the handler's verifying re-read heals this form
+            buf = bytearray(buf)
+            buf[self.inject.flip_pos(row0, want)] ^= 0xA5
+        return np.frombuffer(bytes(buf) if isinstance(buf, bytearray)
+                             else buf, np.float32).reshape(
             (count,) + self.row_shapes[name]).copy()
 
     def _pwrite_row(self, name: str, row: int, values: np.ndarray) -> None:
         """One raw positional row write, with the fault injector's per-op
-        draw applied — THE write seam."""
+        draw applied — THE write seam. On every SUCCESSFUL write the
+        per-row checksum sidecar records the CRC of the INTENDED bytes
+        (computed before any injected corruption — that asymmetry is the
+        whole detection mechanism: a flip/storn write leaves the medium
+        disagreeing with the sidecar, exactly like real bit rot)."""
         kind = self.inject.draw() if self.inject is not None else None
         if kind == "short":
             # a short READ has no write equivalent; the nearest write-
@@ -772,19 +864,42 @@ class MemmapRowStore:
                           f"injected EIO (write {name} row {row})")
         nb = self._row_nbytes[name]
         data = np.ascontiguousarray(values, np.float32).tobytes()
+        crc = zlib.crc32(data)
         if kind == "torn":
             # half the bytes land, then the op errors — the retryable-
-            # VISIBLE torn write (a silently-succeeding tear would be
-            # undetectable without per-row checksums; the retry's full
-            # rewrite repairs this one, docs/fault_tolerance.md)
+            # VISIBLE torn write (the retry's full rewrite repairs this
+            # one, docs/fault_tolerance.md)
             os.pwrite(self._fd[name], data[: len(data) // 2], row * nb)
             raise OSError(errno.EIO,
                           f"injected torn write ({name} row {row})")
+        if kind == "storn":
+            # the SILENT tear: half the bytes land and the op reports
+            # success — the fault class PR 14 explicitly could not
+            # represent; only the checksum mismatch on the next read
+            # (or scrub) can see it
+            os.pwrite(self._fd[name], data[: len(data) // 2], row * nb)
+            self._note_write(name, row, crc)
+            return
+        if kind == "flip":
+            # SILENT media corruption: one byte flips on its way to disk
+            # and the op reports success (seeded bit rot)
+            data = bytearray(data)
+            data[self.inject.flip_pos(row, len(data))] ^= 0xA5
+            data = bytes(data)
         n = os.pwrite(self._fd[name], data, row * nb)
         if n != len(data):
             raise OSError(errno.EIO,
                           f"short write: {n}/{len(data)} bytes "
                           f"({name} row {row})")
+        self._note_write(name, row, crc)
+
+    def _note_write(self, name: str, row: int, crc: int) -> None:
+        """Record a successful row write in the checksum sidecar and the
+        dirty-since-snapshot ledger (a dirty row can no longer repair
+        from the snapshot — its true content has moved past it)."""
+        if self._crc is not None:
+            self._crc[name][int(row)] = crc
+            self._dirty[name][int(row)] = True
 
     # -- the retry/backoff/quarantine ladder ---------------------------------
 
@@ -870,24 +985,139 @@ class MemmapRowStore:
         self.rows_quarantined += 1
         self._row_fails.pop(row, None)
         with self._ev_lock:
-            self._events.append({"row": int(row), "op": op,
+            self._events.append({"kind": "row_quarantined",
+                                 "row": int(row), "op": op,
                                  "cause": str(cause)[:200]})
         print(f"ROW STORE: quarantined row {row} after repeated {op} "
               f"failures ({cause}); re-initialized from the base row — "
               f"the row's EF carry is lost (counted degradation, "
               f"docs/fault_tolerance.md)", file=sys.stderr, flush=True)
 
-    def _read_row(self, name: str, row: int) -> np.ndarray:
+    # -- the integrity plane: verify-on-read + repair ------------------------
+
+    def _snapshot_row(self, name: str, row: int) -> Optional[np.ndarray]:
+        """The row's BIT-exact content from the last CRC'd snapshot, or
+        None when no snapshot covers it: none taken/restored yet, the row
+        was written since (its true content moved past the snapshot), or
+        the snapshot's own bytes fail their recorded CRC (the corruption
+        predates the snapshot — it inherited the bad bytes)."""
+        if self._snap is None or self._dirty[name][row]:
+            return None
+        snap_dir, crcs = self._snap
+        if name not in crcs:
+            return None
+        nb = self._row_nbytes[name]
+        try:
+            with open(os.path.join(snap_dir, f"{name}.f32"), "rb") as f:
+                f.seek(row * nb)
+                buf = f.read(nb)
+        except OSError:
+            return None
+        if len(buf) != nb or zlib.crc32(buf) != int(crcs[name][row]):
+            return None
+        return np.frombuffer(buf, np.float32).reshape(
+            self.row_shapes[name]).copy()
+
+    def _handle_corrupt(self, name: str, row: int, want: int,
+                        where: str) -> np.ndarray:
+        """A row read did not match its sidecar CRC — a DETECTED silent
+        fault (docs/fault_tolerance.md §silent corruption). The repair
+        ladder, least-lossy rung first:
+
+        1. one verifying RE-READ — transfer corruption (a flipped buffer,
+           not flipped media) heals itself: the bytes on disk were right
+           all along;
+        2. snapshot repair — a row NOT written since the last CRC'd
+           ``.rows`` snapshot restores BIT-exactly from it (the write
+           goes back through the laddered seam, re-recording the CRC);
+        3. the existing quarantine rung owns unrepairable rows: base-row
+           re-init, the counted EF-carry degradation.
+
+        Every detection and its resolution surface as counted
+        ``row_corrupt`` / ``row_repaired`` (or ``row_quarantined``)
+        events popped to the dispatch thread."""
+        self.rows_corrupt += 1
+        cause = f"checksum mismatch ({where}: member {name!r} row {row})"
+        with self._ev_lock:
+            self._events.append({"kind": "row_corrupt", "row": int(row),
+                                 "member": name, "where": where})
+        print(f"ROW STORE: {cause} — silent corruption detected "
+              f"(docs/fault_tolerance.md §silent corruption)",
+              file=sys.stderr, flush=True)
+        try:
+            again = self._laddered(
+                "reread", name, None,
+                lambda: self._pread_block(name, row, 1))[0]
+        except _RowOpExhausted:
+            again = None
+        if again is not None \
+                and zlib.crc32(np.ascontiguousarray(again)) == want:
+            self.rows_repaired += 1
+            with self._ev_lock:
+                self._events.append({"kind": "row_repaired",
+                                     "row": int(row), "member": name,
+                                     "source": "reread"})
+            return again
+        rep = self._snapshot_row(name, row)
+        if rep is not None \
+                and zlib.crc32(np.ascontiguousarray(rep)) == want:
+            try:
+                # the repair write runs the ladder DIRECTLY (not
+                # _write_row, which swallows exhaustion into its own
+                # quarantine): a repair is only a repair if its bytes
+                # actually landed — otherwise fall through to the one
+                # quarantine rung below, never count both
+                self._laddered("write", name, row,
+                               lambda: self._pwrite_row(name, row, rep))
+            except _RowOpExhausted as e:
+                self._quarantine_row(
+                    row, where,
+                    f"{cause}; snapshot repair write failed ({e.last})")
+                return np.zeros(self.row_shapes[name], np.float32)
+            # the repair restored exactly the snapshot's content — undo
+            # the dirty marker the write just set, so a LATER corruption
+            # of this row can still repair from the same snapshot
+            self._dirty[name][row] = False
+            self.rows_repaired += 1
+            with self._ev_lock:
+                self._events.append({"kind": "row_repaired",
+                                     "row": int(row), "member": name,
+                                     "source": "snapshot"})
+            print(f"ROW STORE: row {row} member {name!r} repaired "
+                  f"bit-exactly from the .rows snapshot",
+                  file=sys.stderr, flush=True)
+            return rep
+        self._quarantine_row(row, where, cause)
+        return np.zeros(self.row_shapes[name], np.float32)
+
+    def _verify_row(self, name: str, row: int, values: np.ndarray,
+                    where: str) -> np.ndarray:
+        """Check one freshly read row against the sidecar; on mismatch,
+        return whatever the repair ladder recovers instead."""
+        if self._crc is None:
+            return values
+        row = int(row)
+        want = int(self._crc[name][row])
+        if zlib.crc32(np.ascontiguousarray(values)) == want:
+            return values
+        if where == "scrub":
+            self.scrub_mismatch += 1
+        return self._handle_corrupt(name, row, want, where)
+
+    def _read_row(self, name: str, row: int,
+                  where: str = "gather") -> np.ndarray:
         """One row through the full ladder: retries, then quarantine
         (the re-initialized row reads as zeros = the base
-        representation)."""
+        representation), then — checksums on — CRC verification with
+        the repair ladder behind it."""
         try:
-            return self._laddered(
+            vals = self._laddered(
                 "read", name, row,
                 lambda: self._pread_block(name, row, 1))[0]
         except _RowOpExhausted as e:
-            self._quarantine_row(row, "read", str(e.last))
+            self._quarantine_row(row, where, str(e.last))
             return np.zeros(self.row_shapes[name], np.float32)
+        return self._verify_row(name, row, vals, where)
 
     def _write_row(self, name: str, row: int, values: np.ndarray) -> None:
         """One row write through the full ladder. On quarantine the row
@@ -907,7 +1137,10 @@ class MemmapRowStore:
         bit-identical to the per-row path: the same bytes land at the
         same slots; COMMEFFICIENT_IO_COALESCE=0 restores per-row). A
         coalesced read that exhausts its retries degrades to the
-        per-row path, which owns the row-level quarantine ladder."""
+        per-row path, which owns the row-level quarantine ladder. Every
+        row of a coalesced block is CRC-verified individually, so a
+        corrupt row inside a block repairs without re-reading its
+        healthy neighbors."""
         out = np.empty((len(ids),) + self.row_shapes[name], np.float32)
         i, n = 0, len(ids)
         while i < n:
@@ -924,11 +1157,45 @@ class MemmapRowStore:
                         "read", name, None,
                         lambda: self._pread_block(name, row0, count))
                     self.coalesced_rows += count
+                    if self._crc is not None:
+                        for k in range(i, j):
+                            out[k] = self._verify_row(
+                                name, int(ids[k]), out[k], "gather")
                 except _RowOpExhausted:
                     for k in range(i, j):
                         out[k] = self._read_row(name, int(ids[k]))
             i = j
         return out
+
+    # -- the background scrubber --------------------------------------------
+
+    def scrub_async(self) -> None:
+        """Enqueue one scrub pass: the ordered worker verifies the next
+        ``scrub_rows`` rows (rolling cursor over the whole population)
+        against the checksum sidecar, so corruption in rows no cohort
+        ever touches is still found — and repaired — before the next
+        snapshot can inherit it. A no-op with scrubbing off, checksums
+        off, or the store already dead (the scrub must never block a
+        dying run's teardown)."""
+        if (self.scrub_rows <= 0 or self._crc is None or self._closed
+                or self._fatal is not None):
+            return
+        try:
+            self._q.put_nowait(("scrub", time.monotonic(),
+                                self.scrub_rows))
+        except queue.Full:
+            # a full queue means the disk is already behind — skipping a
+            # scrub pass under backpressure is the right trade (the
+            # cursor resumes where it left off next round)
+            pass
+
+    def _run_scrub(self, budget: int) -> None:
+        for _ in range(min(int(budget), self.num_rows)):
+            row = self._scrub_cursor
+            self._scrub_cursor = (self._scrub_cursor + 1) % self.num_rows
+            for name in self._fd:
+                self._read_row(name, row, where="scrub")
+            self.scrub_checked += 1
 
     # -- the watchdog --------------------------------------------------------
 
@@ -995,12 +1262,17 @@ class MemmapRowStore:
                 d = np.asarray(delta)
                 # per-slot read-modify-write IN SLOT ORDER: duplicate ids
                 # accumulate sequentially, replaying `.at[ids].add`
+                # (the read is CRC-verified too — a delta must never be
+                # applied on top of silently corrupt bytes)
                 for slot, row in enumerate(ids):
                     row = int(row)
-                    self._write_row(name, row,
-                                    self._read_row(name, row) + d[slot])
+                    self._write_row(
+                        name, row,
+                        self._read_row(name, row, "scatter") + d[slot])
             self.last_scatter_ms = (time.perf_counter() - t0) * 1e3
             self.scatters += 1
+        elif kind == "scrub":
+            self._run_scrub(payload)
         else:  # "barrier"
             payload.set()
 
@@ -1023,6 +1295,10 @@ class MemmapRowStore:
                 "quarantined": self.rows_quarantined,
                 "read_ops": self.read_ops,
                 "coalesced_rows": self.coalesced_rows,
+                "corrupt": self.rows_corrupt,
+                "repaired": self.rows_repaired,
+                "scrub_checked": self.scrub_checked,
+                "scrub_mismatch": self.scrub_mismatch,
                 "injected": (dict(self.inject.injected)
                              if self.inject is not None else None)}
 
@@ -1180,6 +1456,14 @@ class MemmapRowStore:
         self.drain()
         base = self.init_rows.get(name)
         nb = self._row_nbytes[name]
+        # a full rewrite invalidates any snapshot coverage: every row's
+        # true content just moved past it (the checksum sidecar restarts
+        # from the zero-row CRC and re-records per written row below)
+        self._snap = None
+        for d in self._dirty.values():
+            d[:] = False
+        if self._crc is not None:
+            self._crc[name][:] = self._zero_crc[name]
         # truncate-and-reextend first so the file is all holes, then skip
         # all-zero chunks: a mostly-zero restore (never-sampled clients'
         # rows, or topk-down weights that equal the base) stays sparse
@@ -1192,13 +1476,20 @@ class MemmapRowStore:
             if base is not None:
                 chunk = chunk - base
             if chunk.any():
-                os.pwrite(self._fd[name], chunk.tobytes(), lo * nb)
+                raw = chunk.tobytes()
+                os.pwrite(self._fd[name], raw, lo * nb)
+                if self._crc is not None:
+                    for k in range(chunk.shape[0]):
+                        self._crc[name][lo + k] = zlib.crc32(
+                            raw[k * nb:(k + 1) * nb])
 
     def read_full(self, name: str) -> np.ndarray:
         """One member as a full in-memory array (restoring a disk-tier
         checkpoint into an hbm/host-tier run — caller's RAM must hold it;
         the clear failure there is the allocator's, not a silent wrong
-        restore)."""
+        restore). Deliberately NOT CRC-verified: this is the raw-bytes
+        view the bench bit-identity pins and the snapshot path use;
+        verified access is the gather/scrub path."""
         self.drain()
         base = self.init_rows.get(name)
         nb = self._row_nbytes[name]
@@ -1241,7 +1532,44 @@ class MemmapRowStore:
                 "members": members}
         with open(os.path.join(snap_dir, "store.json"), "w") as f:
             json.dump(meta, f)
+        if self._crc is not None:
+            # the per-row checksum sidecar rides the snapshot: it is the
+            # restore's sidecar AND this process's repair source — a
+            # corrupt row not written since this snapshot repairs
+            # bit-exactly from these files (the caller renames the dir
+            # into place and reports the final name via snapshot_moved)
+            crcs = {}
+            for name in self._fd:
+                np.save(os.path.join(snap_dir, f"{name}.crc.npy"),
+                        self._crc[name])
+                crcs[name] = self._crc[name].copy()
+            self._snap = (snap_dir, crcs)
+            for d in self._dirty.values():
+                d[:] = False
         return meta
+
+    def snapshot_moved(self, new_dir: str) -> None:
+        """The checkpoint layer renamed the snapshot directory into its
+        final ``.rows`` name (the tmp-dir + rename atomicity pattern) —
+        re-point the repair source at the surviving path."""
+        if self._snap is not None:
+            self._snap = (new_dir, self._snap[1])
+
+    def _recompute_crcs(self, name: str) -> np.ndarray:
+        """Rebuild one member's per-row CRC sidecar from its backing
+        file, touching only DATA extents (hole rows keep the closed-form
+        zero-row CRC) — the fallback for restoring a pre-checksum
+        snapshot that carries no ``.crc.npy`` sidecar."""
+        nb = self._row_nbytes[name]
+        out = np.full(self.num_rows, self._zero_crc[name], np.uint32)
+        fd = self._fd[name]
+        size = self.num_rows * nb
+        for lo, hi in _data_extents(fd, size):
+            r0 = lo // nb
+            r1 = min(-(-hi // nb), self.num_rows)
+            for row in range(r0, r1):
+                out[row] = zlib.crc32(os.pread(fd, nb, row * nb))
+        return out
 
     def restore_snapshot(self, snap_dir: str, meta: dict) -> None:
         """Copy a snapshot back over the live files, verifying each file's
@@ -1291,6 +1619,21 @@ class MemmapRowStore:
                     os.path.join(snap_dir, f"init_{name}.npy"))
             # _copy_sparse truncate-rewrote the file IN PLACE (same
             # inode), so the held fd keeps addressing the restored bytes
+        if self._crc is not None:
+            # rebuild the checksum sidecar from the snapshot's own (or,
+            # for a pre-checksum snapshot, from the restored bytes) and
+            # arm the snapshot as this process's repair source
+            crcs = {}
+            for name in self._fd:
+                side = os.path.join(snap_dir, f"{name}.crc.npy")
+                if os.path.exists(side):
+                    self._crc[name] = np.load(side).astype(np.uint32)
+                else:
+                    self._crc[name] = self._recompute_crcs(name)
+                crcs[name] = self._crc[name].copy()
+            self._snap = (snap_dir, crcs)
+            for d in self._dirty.values():
+                d[:] = False
 
 
 def read_snapshot_member(snap_dir: str, meta: dict,

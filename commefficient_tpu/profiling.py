@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import re
 import sys
 import threading
 
@@ -38,7 +39,40 @@ import jax
 
 __all__ = ["StepProfiler", "annotate", "SyncCounter", "host_sync_monitor",
            "materialize", "offpath_fetches", "Heartbeat", "RoundTracer",
-           "parse_trace_rounds"]
+           "parse_trace_rounds", "HEARTBEAT_RE", "parse_heartbeat"]
+
+
+# THE heartbeat line format, one producer (Heartbeat.round) and one parser
+# (parse_heartbeat) — the crash harness (scripts/crash_matrix.py) and the
+# self-healing supervisor (scripts/supervise.py) both key liveness on it,
+# so the format lives next to its emitter instead of as private regexes
+# drifting per consumer. Supervisors key on the leading ``round=N``; the
+# optional extras (epoch / loss / guard verdict) append after it.
+HEARTBEAT_RE = re.compile(
+    r"HEARTBEAT round=(\d+)"
+    r"(?: epoch=(\d+))?"
+    r"(?: loss=(\S+))?"
+    r"(?: guard=(ok|TRIP))?")
+
+
+def parse_heartbeat(line: str):
+    """Parse one ``Heartbeat.round`` stderr line; None for non-heartbeat
+    lines. Returns ``{"round": int}`` plus whichever optional fields the
+    line carried (``epoch`` int, ``loss`` float, ``guard_ok`` bool)."""
+    m = HEARTBEAT_RE.match(line.strip())
+    if m is None:
+        return None
+    out = {"round": int(m.group(1))}
+    if m.group(2) is not None:
+        out["epoch"] = int(m.group(2))
+    if m.group(3) is not None:
+        try:
+            out["loss"] = float(m.group(3))
+        except ValueError:
+            pass
+    if m.group(4) is not None:
+        out["guard_ok"] = m.group(4) == "ok"
+    return out
 
 
 class Heartbeat:
@@ -58,8 +92,9 @@ class Heartbeat:
     round still holds an exact trail of how far training got. The engine
     also passes the drained round's mean loss and (with ``--guards``) the
     guard verdict, so a ``COMMEFFICIENT_HEARTBEAT=1`` stderr tail is a
-    minimal live monitor even with telemetry off. Supervisors key on the
-    leading ``round=N`` field; the extras append after it. Disabled (the
+    minimal live monitor even with telemetry off. Supervisors consume
+    lines through ``parse_heartbeat`` (the one parser of this format);
+    the extras append after the leading ``round=N``. Disabled (the
     default) it is a no-op on the hot path."""
 
     def __init__(self, enabled: bool | None = None):

@@ -424,6 +424,14 @@ DEFAULT_WATCH_RULES = (
     "io_retry>ewma*8@3",
     "io_error>0.5->checkpoint",
     "worker_queue_age>ewma*8@4->trace",
+    # integrity-plane rungs (docs/fault_tolerance.md §silent corruption):
+    # a gather-detected checksum mismatch was already repaired-or-
+    # quarantined in line — log it; a SCRUB-found mismatch means
+    # corruption is accumulating in cold rows, so force the drain-first
+    # resumable checkpoint — the next snapshot must be taken from
+    # repaired state, never inherit the rot
+    "io_corrupt>0.5",
+    "scrub_mismatch>0.5->checkpoint",
 )
 
 
@@ -437,11 +445,14 @@ WATCH_METRIC_NAMES = frozenset(METRIC_FIELDS) | {
     "loss", "occupancy", "dispatch_ms", "compute_ms", "drain_fetch_ms",
     "dispatch_to_drain_ms", "rounds_per_sec", "prefetch_miss",
     "io_retry", "io_error", "worker_queue_age",
+    "io_corrupt", "scrub_mismatch",
 }
 
 # watch-rule name -> the offload-span key carrying its per-round value
 _IO_WATCH_KEYS = {"io_retry": "io_retries", "io_error": "io_errors",
-                  "worker_queue_age": "queue_age_ms"}
+                  "worker_queue_age": "queue_age_ms",
+                  "io_corrupt": "io_corrupt",
+                  "scrub_mismatch": "scrub_mismatch"}
 
 
 def parse_watch_rules(spec: str) -> List[WatchRule]:
@@ -888,6 +899,12 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
             "backoff_ms": float(store.io_backoff_ms),
             "deadline_ms": float(store.io_deadline_ms),
             "quarantine_after": int(store.quarantine_after),
+            # integrity plane (docs/fault_tolerance.md §silent
+            # corruption): resolved checksum state + scrub budget, so a
+            # logged run's detection/repair story is auditable from the
+            # header like the injection schedule
+            "checksums": bool(getattr(store, "checksums", False)),
+            "scrub_rows": int(getattr(store, "scrub_rows", 0)),
             "inject": (store.inject.schedule.spec()
                        if store.inject is not None else None),
         }

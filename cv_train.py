@@ -484,12 +484,17 @@ def main(argv=None):
             if cap is not None and rt is not None:
                 rt.event("trace_captured", **cap)
         store = getattr(fed_model, "_row_store", None)
-        if store is not None and rt is not None \
-                and store.fatal_error is not None:
-            # the storage-fault terminal rung (docs/fault_tolerance.md
-            # §storage faults): the one actionable error, recorded so
-            # the whole ladder reproduces from the JSONL log alone
-            rt.event("io_fatal", error=str(store.fatal_error))
+        if store is not None and rt is not None:
+            if store.fatal_error is not None:
+                # the storage-fault terminal rung
+                # (docs/fault_tolerance.md §storage faults): the one
+                # actionable error, recorded so the whole ladder
+                # reproduces from the JSONL log alone
+                rt.event("io_fatal", error=str(store.fatal_error))
+            # run-total I/O + integrity counters (incl. the realized
+            # injected-fault counts) — the last word the log needs for
+            # the detected-vs-injected silent-corruption audit
+            rt.event("io_counters", **store.io_counters())
         if rt is not None:
             rt.close()
         # EVERY exit path — including the storage-fault terminal rung —

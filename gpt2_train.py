@@ -493,12 +493,17 @@ def train(argv=None):
                 if cap is not None and rt is not None:
                     rt.event("trace_captured", **cap)
             store = getattr(fed_model, "_row_store", None)
-            if store is not None and rt is not None \
-                    and store.fatal_error is not None:
-                # the storage-fault terminal rung: the one actionable
-                # error, recorded so the ladder reproduces from the log
-                # alone (docs/fault_tolerance.md §storage faults)
-                rt.event("io_fatal", error=str(store.fatal_error))
+            if store is not None and rt is not None:
+                if store.fatal_error is not None:
+                    # the storage-fault terminal rung: the one
+                    # actionable error, recorded so the ladder
+                    # reproduces from the log alone
+                    # (docs/fault_tolerance.md §storage faults)
+                    rt.event("io_fatal", error=str(store.fatal_error))
+                # run-total I/O + integrity counters (incl. realized
+                # injected-fault counts) for the detected-vs-injected
+                # silent-corruption audit from the JSONL alone
+                rt.event("io_counters", **store.io_counters())
             if rt is not None:
                 rt.close()
             # EVERY exit path — including the storage-fault terminal

@@ -66,7 +66,25 @@
 #     fault ladder (retries -> quarantine -> watch-forced checkpoint ->
 #     terminal error) reproduced from the JSONL log alone, coalesced-
 #     vs-per-row gather bit-identity, bounded-queue + close-report
-#     shutdown hygiene (tests/test_io_faults.py).
+#     shutdown hygiene (tests/test_io_faults.py);
+#   - the end-to-end integrity plane (docs/fault_tolerance.md §silent
+#     corruption): per-row checksum round trips (holes, coalesced
+#     blocks, scatter RMW), checksums-on BIT-identical to checksums-off
+#     on the clean path (store-level AND e2e), seeded silent flip/storn
+#     injection detected on every verified read with the repair ladder
+#     behind it (verifying re-read -> bit-exact .rows-snapshot repair ->
+#     quarantine), the bounded background scrubber finding cold-row
+#     corruption before a snapshot inherits it, and the flip e2e's
+#     detection story reproduced from the JSONL alone
+#     (tests/test_integrity.py);
+#   - the self-healing supervisor (docs/fault_tolerance.md
+#     §self-healing supervisor): crash + hang (heartbeat deadline)
+#     detection and relaunch with --resume auto, bounded restart budget
+#     + exponential backoff, poison-checkpoint exclusion through the
+#     find_resume_checkpoint exclude seam (skip reasons logged), the
+#     shared profiling.parse_heartbeat format, supervisor JSONL rendered
+#     by obs_report (tests/test_supervise.py — the real SIGKILL/SIGSTOP/
+#     silent-corruption recovery drill is its @slow crash-matrix leg).
 # Any extra args are passed through to pytest (e.g. -k bit_identical).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -77,5 +95,6 @@ exec env JAX_PLATFORMS=cpu \
     tests/test_telemetry.py tests/test_watch.py \
     tests/test_compressed_collectives.py \
     tests/test_participation.py tests/test_host_offload.py \
-    tests/test_io_faults.py \
+    tests/test_io_faults.py tests/test_integrity.py \
+    tests/test_supervise.py \
     -q -m "not slow" -p no:cacheprovider "$@"

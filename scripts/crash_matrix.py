@@ -34,14 +34,26 @@ trajectory is near-exact but NOT bitwise vs the direct-state planes
 (the documented delta-roundtrip caveat), so the leg carries its own
 baseline.
 
+The SUPERVISE leg (docs/fault_tolerance.md §self-healing supervisor,
+opt-in via ``--planes ...,supervise``; driven by
+tests/test_supervise.py) runs the child UNDER ``scripts/supervise.py``
+and proves three failure classes recover with no human in the loop:
+an external SIGKILL (crash) and an external SIGSTOP (hang — only the
+supervisor's heartbeat deadline can see it) both relaunch with
+``--resume auto`` to final weights bit-identical to the uninterrupted
+baseline, and a forced disk-tier run with seeded silent row corruption
+(``--inject_io_fault flip=P`` + per-row checksums + scrub) completes
+unattended with every detected corruption repaired or quarantined.
+
 Usage:
     python scripts/crash_matrix.py [--trials N] [--seed S] [--workdir DIR]
-                                   [--planes replicated,shard,disk]
+                                   [--planes replicated,shard,disk[,supervise]]
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import random
 import re
@@ -177,6 +189,8 @@ def run_and_kill(argv, kill_after_round: int, timeout=900,
     instead of the old per-epoch line counting. Returns the 1-based count
     at the kill; the child may race a round further before the signal
     lands — that is the point, preemption is not polite."""
+    from commefficient_tpu.profiling import parse_heartbeat
+
     proc = subprocess.Popen(argv, env=child_env(env_extra), cwd=_REPO,
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.PIPE, text=True)
@@ -187,9 +201,9 @@ def run_and_kill(argv, kill_after_round: int, timeout=900,
         for line in proc.stderr:
             if time.monotonic() > deadline:
                 break
-            m = re.match(r"HEARTBEAT round=(\d+)", line)
-            if m:
-                seen = int(m.group(1)) + 1
+            hb = parse_heartbeat(line)
+            if hb is not None:
+                seen = hb["round"] + 1
                 if seen >= kill_after_round:
                     proc.send_signal(signal.SIGKILL)
                     killed = True
@@ -232,6 +246,149 @@ def assert_identical(a: dict, b: dict, what: str) -> None:
             a[key], b[key], err_msg=f"{what}: {key} diverged")
 
 
+def run_supervised(argv, events_path: str, kill_round=None,
+                   kill_signal=None, timeout=1800, env_extra=None,
+                   cwd=None):
+    """Run the training child UNDER scripts/supervise.py (the
+    self-healing supervisor), optionally injecting one external fault:
+    once attempt 1's heartbeat reaches ``kill_round``, send
+    ``kill_signal`` to the CHILD pid (SIGKILL = crash; SIGSTOP = hang —
+    heartbeats cease and the supervisor's deadline must fire). Returns
+    ``(supervisor_rc, fault_sent)``. The supervisor's merged output is
+    scanned for its ``[supervise] launch attempt=N pid=P`` lines and the
+    teed child heartbeats (profiling.parse_heartbeat — the shared
+    format)."""
+    from commefficient_tpu.profiling import parse_heartbeat
+
+    sup_argv = [
+        sys.executable, os.path.join(_REPO, "scripts", "supervise.py"),
+        "--heartbeat-timeout", "60", "--startup-grace", "600",
+        "--max-restarts", "3", "--backoff", "1",
+        "--events", events_path, "--",
+    ] + argv
+    proc = subprocess.Popen(sup_argv, env=child_env(env_extra),
+                            cwd=cwd or _REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    child_pid = attempt = None
+    sent = False
+    deadline = time.monotonic() + timeout
+    try:
+        for line in proc.stdout:
+            if time.monotonic() > deadline:
+                proc.kill()
+                break
+            m = re.search(r"\[supervise\] launch attempt=(\d+) "
+                          r"pid=(\d+)", line)
+            if m:
+                attempt, child_pid = int(m.group(1)), int(m.group(2))
+                continue
+            hb = parse_heartbeat(line)
+            if (hb is not None and not sent and kill_round is not None
+                    and attempt == 1 and child_pid is not None
+                    and hb["round"] + 1 >= kill_round):
+                os.kill(child_pid, kill_signal)
+                sent = True
+                print(f"[crash_matrix] sent signal {int(kill_signal)} "
+                      f"to supervised child {child_pid} at round "
+                      f"{hb['round']}")
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+    return rc, sent
+
+
+def _count_events(path: str, kind: str) -> int:
+    n = 0
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    if json.loads(line).get("ev") == kind:
+                        n += 1
+                except json.JSONDecodeError:
+                    pass
+    except OSError:
+        pass
+    return n
+
+
+def _newest_run_log(cwd: str) -> str:
+    runs = sorted(os.path.join(cwd, "runs", d)
+                  for d in os.listdir(os.path.join(cwd, "runs")))
+    assert runs, f"no run dir under {cwd}"
+    return os.path.join(runs[-1], "telemetry.jsonl")
+
+
+def run_supervise_plane(workdir: str, data: str, want, rng,
+                        trial: int) -> None:
+    """The supervisor leg (docs/fault_tolerance.md §self-healing
+    supervisor): three unattended-recovery drills.
+
+    1. **SIGKILL** (crash): the supervisor detects the child's death,
+       relaunches with ``--resume auto``, and the final fp32 weights are
+       BIT-identical to the uninterrupted baseline;
+    2. **SIGSTOP** (hang): heartbeats cease without an exit — only the
+       heartbeat deadline can see it; the supervisor SIGKILLs and
+       resumes, same bit-identity bar;
+    3. **silent row corruption**: a forced disk-tier run with seeded
+       ``flip=P`` injection + checksums + scrub completes UNATTENDED,
+       every detected corruption repaired or quarantined (counted in
+       its telemetry JSONL — the trajectory legitimately differs when a
+       quarantine drops an EF carry, so the bar here is detection +
+       completion, not bitwise equality)."""
+    total_rounds = EPOCHS * ROUNDS_PER_EPOCH
+    kill_round = rng.randint(3, total_rounds - 3)
+    for tag, sig in (("kill", signal.SIGKILL), ("hang", signal.SIGSTOP)):
+        ckpt = os.path.join(workdir, f"supervise_{tag}_t{trial}")
+        events = os.path.join(workdir, f"supervise_{tag}_t{trial}.jsonl")
+        print(f"[crash_matrix] supervise/{tag} trial {trial}: "
+              f"{'SIGKILL' if tag == 'kill' else 'SIGSTOP'} at round "
+              f"{kill_round}")
+        rc, sent = run_supervised(
+            train_argv(data, ckpt, shard=False), events,
+            kill_round=kill_round, kill_signal=sig)
+        assert sent, (f"supervise/{tag}: child finished before the "
+                      f"fault round {kill_round} — shrink the window")
+        assert rc == 0, f"supervise/{tag}: supervisor exited rc={rc}"
+        assert _count_events(events, "supervisor_launch") >= 2, \
+            f"supervise/{tag}: no relaunch recorded"
+        if tag == "hang":
+            assert _count_events(events, "supervisor_timeout") >= 1, \
+                "supervise/hang: the heartbeat deadline never fired"
+        assert_identical(want, final_weights(ckpt),
+                         f"supervise/{tag} trial {trial}")
+        print(f"[crash_matrix] supervise/{tag}: recovered unattended, "
+              f"fp32 trajectory bit-identical")
+    # silent-corruption drill: flip injection + checksums + full-coverage
+    # scrub on the forced disk tier, no external fault needed
+    ckpt = os.path.join(workdir, f"supervise_flip_t{trial}")
+    events = os.path.join(workdir, f"supervise_flip_t{trial}.jsonl")
+    cwd = os.path.join(workdir, f"supervise_flip_cwd_t{trial}")
+    os.makedirs(cwd, exist_ok=True)
+    print(f"[crash_matrix] supervise/flip trial {trial}: seeded silent "
+          f"corruption, checksums + scrub on")
+    rc, _ = run_supervised(
+        train_argv(data, ckpt, shard=False, disk=True)
+        + ["--inject_io_fault", "flip=0.03,seed=5",
+           "--io_scrub_rows", "8"],
+        events, env_extra=DISK_ENV, cwd=cwd)
+    assert rc == 0, f"supervise/flip: supervisor exited rc={rc}"
+    log = _newest_run_log(cwd)
+    corrupt = _count_events(log, "row_corrupt")
+    repaired = _count_events(log, "row_repaired")
+    quarantined = _count_events(log, "row_quarantined")
+    assert corrupt > 0, \
+        "supervise/flip: the seeded schedule injected nothing detected"
+    assert corrupt == repaired + quarantined, (
+        f"supervise/flip: {corrupt} detected corruptions but only "
+        f"{repaired} repairs + {quarantined} quarantines")
+    print(f"[crash_matrix] supervise/flip: completed unattended — "
+          f"{corrupt} silent corruptions detected, {repaired} repaired, "
+          f"{quarantined} quarantined")
+
+
 def run_matrix(workdir: str, trials: int = 1, seed: int = 0,
                planes=("replicated", "shard", "disk")) -> None:
     rng = random.Random(seed)
@@ -255,6 +412,13 @@ def run_matrix(workdir: str, trials: int = 1, seed: int = 0,
 
     total_rounds = EPOCHS * ROUNDS_PER_EPOCH
     for plane in planes:
+        if plane == "supervise":
+            # the self-healing-supervisor leg: SIGKILL / injected hang /
+            # injected silent corruption, all recovered UNATTENDED
+            # (docs/fault_tolerance.md §self-healing supervisor)
+            for trial in range(trials):
+                run_supervise_plane(workdir, data, want, rng, trial)
+            continue
         shard = plane == "shard"
         disk = plane == "disk"
         env_extra = DISK_ENV if disk else None

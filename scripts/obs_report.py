@@ -213,6 +213,14 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
                          if e.get("ev") == "row_quarantined"]
     io_fatal = next((e.get("error") for e in reversed(events)
                      if e.get("ev") == "io_fatal"), None)
+    # integrity plane (docs/fault_tolerance.md §silent corruption):
+    # detection + repair events, the scrub's span totals, and the final
+    # io_counters event (run totals incl. REALIZED injected-fault
+    # counts — the detected-vs-injected audit's other half)
+    corrupt_events = [e for e in events if e.get("ev") == "row_corrupt"]
+    repair_events = [e for e in events if e.get("ev") == "row_repaired"]
+    io_totals = next((e for e in reversed(events)
+                      if e.get("ev") == "io_counters"), None)
     host_offload = None
     if offloads or run_info.get("state_placement") in ("host", "disk"):
         host_offload = {
@@ -247,6 +255,21 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
             "rows_quarantined": len(quarantine_events),
             "quarantine_rounds": [e.get("round")
                                   for e in quarantine_events],
+            # integrity plane (§silent corruption): every detection and
+            # its resolution, plus scrub coverage — matched against the
+            # live store's counters in tests/test_integrity.py
+            "rows_corrupt": len(corrupt_events),
+            "corrupt_rounds": [e.get("round") for e in corrupt_events],
+            "rows_repaired": len(repair_events),
+            "repair_sources": {
+                src: len([e for e in repair_events
+                          if e.get("source") == src])
+                for src in sorted({e.get("source")
+                                   for e in repair_events})},
+            "scrub_rows": sum(o.get("scrub_rows", 0) for o in offloads),
+            "scrub_mismatch": sum(o.get("scrub_mismatch", 0)
+                                  for o in offloads),
+            "injected": (io_totals or {}).get("injected"),
             "queue_depth_max": max(
                 (o["queue_depth"] for o in offloads
                  if "queue_depth" in o), default=None),
@@ -279,6 +302,33 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         {"round_start": e.get("round_start"),
          "round_until": e.get("round_until"), "dir": e.get("dir")}
         for e in events if e.get("ev") == "trace_captured"]
+
+    # Self-healing supervisor (scripts/supervise.py,
+    # docs/fault_tolerance.md §self-healing supervisor): its own JSONL
+    # carries supervisor_* events — an unattended night's crash/hang/
+    # restart/poison story reconstructs from the log alone.
+    sup_events = [e for e in events
+                  if str(e.get("ev", "")).startswith("supervisor_")]
+    supervisor = None
+    if sup_events:
+        def _n(kind):
+            return len([e for e in sup_events if e.get("ev") == kind])
+
+        exits = [e for e in sup_events
+                 if e.get("ev") == "supervisor_child_exit"]
+        supervisor = {
+            "launches": _n("supervisor_launch"),
+            "restarts": _n("supervisor_restart"),
+            "crashes": len([e for e in exits
+                            if not e.get("hang") and e.get("rc") != 0]),
+            "hangs": _n("supervisor_timeout"),
+            "poisoned": [e.get("path") for e in sup_events
+                         if e.get("ev") == "supervisor_poison"],
+            "gave_up": _n("supervisor_giveup") > 0,
+            "completed": _n("supervisor_done") > 0,
+            "last_round": max((e.get("last_round", -1) for e in exits),
+                              default=None),
+        }
 
     return {
         "log_rounds": len(rounds),
@@ -342,6 +392,7 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         "metric_schema_len": len(run_info.get("schema", []) or []) or None,
         "alerts": alerts,
         "trace_captures": trace_captures,
+        "supervisor": supervisor,
         "histograms": {
             "update": _hist_summary(rounds, "update_hist_"),
             "error": _hist_summary(rounds, "error_hist_"),
@@ -519,13 +570,18 @@ def render(events: List[dict], out=None) -> Dict[str, Any]:
         if cfg:
             inj = (f", injection {cfg['inject']}" if cfg.get("inject")
                    else "")
+            cks = (", checksums ON"
+                   + (f" + scrub {cfg.get('scrub_rows')} rows/round"
+                      if cfg.get("scrub_rows") else "")
+                   if cfg.get("checksums") else ", checksums OFF")
             p(f"I/O plane: queue bound {cfg.get('queue_bound')} ops, "
               f"{cfg.get('retries')} retries x "
               f"{cfg.get('backoff_ms')} ms backoff, watchdog deadline "
               f"{cfg.get('deadline_ms')} ms, row quarantine after "
-              f"{cfg.get('quarantine_after')} failed attempts{inj}")
+              f"{cfg.get('quarantine_after')} failed attempts{cks}{inj}")
         if (ho.get("io_retries") or ho.get("io_errors")
-                or ho.get("rows_quarantined") or ho.get("io_fatal")):
+                or ho.get("rows_quarantined") or ho.get("io_fatal")
+                or ho.get("rows_corrupt")):
             p("\n### Storage-fault ladder "
               "(docs/fault_tolerance.md §storage faults)")
             p(f"{ho.get('io_retries', 0)} retried attempt(s), "
@@ -533,12 +589,60 @@ def render(events: List[dict], out=None) -> Dict[str, Any]:
               f"{ho.get('rows_quarantined', 0)} row(s) quarantined"
               + (f" at rounds {ho['quarantine_rounds']}"
                  if ho.get("quarantine_rounds") else ""))
+            if ho.get("rows_corrupt") or ho.get("scrub_rows"):
+                srcs = ", ".join(
+                    f"{n} via {s}" for s, n in
+                    (ho.get("repair_sources") or {}).items())
+                inj = (ho.get("injected") or {})
+                inj_txt = ""
+                if inj.get("flip") or inj.get("storn"):
+                    inj_txt = (f"; injected silent faults: "
+                               f"{inj.get('flip', 0)} flip / "
+                               f"{inj.get('storn', 0)} silent-torn")
+                p(f"silent corruption (§silent corruption): "
+                  f"{ho.get('rows_corrupt', 0)} detected, "
+                  f"{ho.get('rows_repaired', 0)} repaired"
+                  + (f" ({srcs})" if srcs else "")
+                  + f"; scrub verified {ho.get('scrub_rows', 0)} "
+                    f"row-reads, {ho.get('scrub_mismatch', 0)} "
+                    f"mismatch(es){inj_txt}")
+            for e in (x for x in events
+                      if x.get("ev") == "row_corrupt"):
+                p(f"- row {e.get('row')} member {e.get('member')} "
+                  f"CORRUPT at round {e.get('round')} "
+                  f"(detected on {e.get('where')})")
+            for e in (x for x in events
+                      if x.get("ev") == "row_repaired"):
+                p(f"- row {e.get('row')} member {e.get('member')} "
+                  f"repaired at round {e.get('round')} "
+                  f"(source: {e.get('source')})")
             for e in (x for x in events
                       if x.get("ev") == "row_quarantined"):
                 p(f"- row {e.get('row')} quarantined at round "
                   f"{e.get('round')} ({e.get('op')}: {e.get('cause')})")
             if ho.get("io_fatal"):
                 p(f"- TERMINAL: {ho['io_fatal']}")
+
+    sup = s.get("supervisor")
+    if sup:
+        p("\n## Supervisor (scripts/supervise.py, "
+          "docs/fault_tolerance.md §self-healing supervisor)")
+        fate = ("run completed" if sup.get("completed")
+                else "GAVE UP (restart budget exhausted)"
+                if sup.get("gave_up") else "still running / killed")
+        p(f"{sup['launches']} launch(es), {sup['restarts']} restart(s) "
+          f"({sup['crashes']} crash(es), {sup['hangs']} hang(s)) — "
+          f"{fate}; last heartbeat round {sup.get('last_round')}")
+        for e in (x for x in events
+                  if x.get("ev") == "supervisor_timeout"):
+            p(f"- HANG: no heartbeat for {e.get('silent_s')}s "
+              f"(last round {e.get('last_round')}) -> SIGKILL")
+        for e in (x for x in events
+                  if x.get("ev") == "supervisor_restart"):
+            p(f"- restart ({e.get('reason')}) after "
+              f"{e.get('backoff_s')}s backoff")
+        for path in sup.get("poisoned") or []:
+            p(f"- POISON checkpoint excluded: {path}")
 
     p("\n## Guard / rollback history")
     if not s["guards"]:

@@ -1,0 +1,294 @@
+"""Self-healing supervisor: crash/hang-watch + relaunch with --resume auto.
+
+The fault ladders make the training process *detect* every failure class —
+device NaNs quarantine (--guards), client faults degrade gracefully
+(--inject_client_fault), erroring/hung disk I/O retries/quarantines/halts
+with one actionable error (--inject_io_fault), silent row corruption is
+checksum-detected and repaired (--io_checksums) — but every TERMINAL rung
+still ended at a human re-typing ``--resume auto``. This wrapper closes
+that last gap (docs/fault_tolerance.md §self-healing supervisor): it runs
+either entrypoint as a child, watches the engine's ``Heartbeat`` lines
+(``COMMEFFICIENT_HEARTBEAT=1``, parsed by THE shared
+``profiling.parse_heartbeat`` — same parser as scripts/crash_matrix.py)
+for both **crash** (child exit) and **hang** (no heartbeat within a
+deadline → SIGKILL; a SIGSTOP'd or wedged child cannot dodge SIGKILL),
+and relaunches with ``--resume auto`` under exponential backoff and a
+bounded restart budget.
+
+Poison-checkpoint exclusion: a checkpoint can read + CRC clean yet still
+fail resume deterministically (bad semantic content the checksum cannot
+see). The supervisor tracks which checkpoint each relaunch resumed from
+(the child's ``resumed run state from PATH`` line); a candidate whose
+resume dies twice without a single heartbeat is added to the exclusion
+list, passed to ``checkpoint.find_resume_checkpoint`` through the
+``COMMEFFICIENT_RESUME_EXCLUDE`` environment seam — the next relaunch
+falls back to the next-newest checkpoint instead of crash-looping on the
+poisoned one forever.
+
+Every decision lands in the supervisor's own flushed JSONL event log
+(``--events``, telemetry-style ``{"ev": ..., "t": ...}`` lines) that
+``scripts/obs_report.py`` renders as a Supervisor section, so an
+unattended night's restarts reconstruct from the log alone.
+
+Usage:
+    python scripts/supervise.py [--heartbeat-timeout S] [--startup-grace S]
+        [--max-restarts N] [--backoff S] [--backoff-max S] [--events PATH]
+        -- cv_train.py --args...
+
+The child argv follows ``--``; a leading ``*.py`` gets ``sys.executable``
+prepended. The FIRST launch runs the argv verbatim; relaunches append
+``--resume auto`` unless the argv already carries ``--resume``.
+Acceptance drill: ``scripts/crash_matrix.py --planes supervise`` proves
+SIGKILL, an injected hang (SIGSTOP), and injected silent row corruption
+(``flip=P`` + scrub) all recover unattended, the kill/hang legs with
+final fp32 weights bit-identical to an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # standalone invocation from anywhere
+    sys.path.insert(0, _REPO)
+
+from commefficient_tpu.profiling import parse_heartbeat  # noqa: E402
+
+# the one resume-report line resume_run prints (federated/checkpoint.py)
+RESUME_RE = re.compile(r"resumed run state from (\S+)")
+
+
+class EventLog:
+    """Flushed JSONL event sink, telemetry-line-shaped so obs_report's
+    reader consumes it unchanged."""
+
+    def __init__(self, path: str):
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a")
+
+    def event(self, ev: str, **fields) -> None:
+        rec = {"ev": ev, "t": time.time()}
+        rec.update(fields)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class _ChildWatch:
+    """Shared liveness state the reader thread updates per child line."""
+
+    def __init__(self):
+        self.last_beat: float = 0.0   # monotonic stamp of the last beat
+        self.beats: int = 0
+        self.last_round: int = -1
+        self.resumed_from: str = ""
+
+
+def _read_child(proc, watch: _ChildWatch, out) -> None:
+    """Tee the child's merged stdout+stderr through ``out`` while parsing
+    heartbeats (liveness) and the resume-report line (poison
+    bookkeeping). Runs on a daemon thread; ends at child EOF."""
+    try:
+        for line in proc.stdout:
+            try:
+                out.write(line)
+                out.flush()
+            except (OSError, ValueError):
+                pass
+            hb = parse_heartbeat(line)
+            if hb is not None:
+                watch.last_beat = time.monotonic()
+                watch.beats += 1
+                watch.last_round = hb["round"]
+                continue
+            m = RESUME_RE.search(line)
+            if m:
+                watch.resumed_from = m.group(1)
+    except (OSError, ValueError):
+        pass
+
+
+def supervise(child_argv, heartbeat_timeout: float = 120.0,
+              startup_grace: float = 900.0, max_restarts: int = 5,
+              backoff: float = 2.0, backoff_max: float = 60.0,
+              events_path: str = "supervise_events.jsonl",
+              out=None) -> int:
+    """Run ``child_argv`` to successful completion, restarting on crash
+    or heartbeat-silence with ``--resume auto``; returns the final child
+    return code (0 on recovered success). See the module docstring for
+    the full ladder."""
+    out = out if out is not None else sys.stdout
+    log = EventLog(events_path)
+    log.event("supervisor_start", argv=list(child_argv),
+              heartbeat_timeout=heartbeat_timeout,
+              startup_grace=startup_grace, max_restarts=max_restarts,
+              backoff=backoff)
+    excluded: list = []
+    strikes: dict = {}
+    restarts = 0
+    attempt = 0
+    consec_no_progress = 0
+    try:
+        while True:
+            attempt += 1
+            argv = list(child_argv)
+            resume = attempt > 1 and "--resume" not in argv
+            if resume:
+                argv += ["--resume", "auto"]
+            env = dict(os.environ)
+            env["COMMEFFICIENT_HEARTBEAT"] = "1"
+            # the child's stdout is a pipe: without this the resume-
+            # report line sits in a block buffer until (possibly after)
+            # the crash the supervisor needs it to diagnose
+            env["PYTHONUNBUFFERED"] = "1"
+            if excluded:
+                env["COMMEFFICIENT_RESUME_EXCLUDE"] = \
+                    os.pathsep.join(excluded)
+            proc = subprocess.Popen(argv, env=env,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT, text=True)
+            print(f"[supervise] launch attempt={attempt} pid={proc.pid}"
+                  + (" (--resume auto)" if resume else ""),
+                  file=out, flush=True)
+            log.event("supervisor_launch", attempt=attempt, pid=proc.pid,
+                      resume=resume, excluded=list(excluded))
+            watch = _ChildWatch()
+            t_launch = time.monotonic()
+            reader = threading.Thread(target=_read_child,
+                                      args=(proc, watch, out),
+                                      daemon=True)
+            reader.start()
+            hang = False
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    break
+                now = time.monotonic()
+                if watch.beats:
+                    silent = now - watch.last_beat
+                    deadline = heartbeat_timeout
+                else:
+                    # pre-first-heartbeat: compile + init legitimately
+                    # take a while — a separate (longer) grace applies
+                    silent = now - t_launch
+                    deadline = max(heartbeat_timeout, startup_grace)
+                if silent > deadline:
+                    hang = True
+                    log.event("supervisor_timeout", attempt=attempt,
+                              silent_s=round(silent, 1),
+                              last_round=watch.last_round)
+                    print(f"[supervise] no heartbeat for {silent:.0f}s "
+                          f"(deadline {deadline:g}s; last round "
+                          f"{watch.last_round}) — SIGKILL pid "
+                          f"{proc.pid}", file=out, flush=True)
+                    proc.kill()  # SIGKILL: lands on SIGSTOP'd children too
+                    try:
+                        proc.wait(30)
+                    except subprocess.TimeoutExpired:
+                        pass
+                    rc = proc.returncode
+                    break
+                time.sleep(0.25)
+            reader.join(5)
+            log.event("supervisor_child_exit", attempt=attempt, rc=rc,
+                      hang=hang, rounds_seen=watch.beats,
+                      last_round=watch.last_round,
+                      resumed_from=watch.resumed_from or None)
+            if rc == 0 and not hang:
+                log.event("supervisor_done", attempts=attempt,
+                          restarts=restarts)
+                print(f"[supervise] child completed (attempt {attempt}, "
+                      f"{restarts} restart(s))", file=out, flush=True)
+                return 0
+            # poison-checkpoint bookkeeping: a resume that died before a
+            # SINGLE heartbeat never got past restore/round 1 — two such
+            # strikes exclude the candidate (find_resume_checkpoint's
+            # exclude seam) so the next relaunch falls back to an older
+            # checkpoint instead of crash-looping on this one
+            if watch.resumed_from and watch.beats == 0:
+                s = strikes.get(watch.resumed_from, 0) + 1
+                strikes[watch.resumed_from] = s
+                if s >= 2 and watch.resumed_from not in excluded:
+                    excluded.append(watch.resumed_from)
+                    log.event("supervisor_poison",
+                              path=watch.resumed_from, strikes=s)
+                    print(f"[supervise] poison checkpoint excluded "
+                          f"after {s} failed resumes: "
+                          f"{watch.resumed_from}", file=out, flush=True)
+            restarts += 1
+            if restarts > max_restarts:
+                log.event("supervisor_giveup", restarts=restarts - 1,
+                          rc=rc)
+                print(f"[supervise] restart budget exhausted "
+                      f"({max_restarts}) — giving up (last rc {rc})",
+                      file=out, flush=True)
+                return rc if isinstance(rc, int) and rc != 0 else 1
+            # exponential backoff over CONSECUTIVE no-progress failures
+            # (an attempt that heartbeat at all resets the exponent —
+            # it was making progress before dying, relaunch promptly)
+            consec_no_progress = (consec_no_progress + 1
+                                  if watch.beats == 0 else 1)
+            delay = min(backoff * (2 ** (consec_no_progress - 1)),
+                        backoff_max)
+            log.event("supervisor_restart", attempt=attempt,
+                      backoff_s=round(delay, 3),
+                      reason="hang" if hang else "crash")
+            print(f"[supervise] restarting in {delay:g}s "
+                  f"({'hang' if hang else f'crash rc={rc}'}; restart "
+                  f"{restarts}/{max_restarts})", file=out, flush=True)
+            time.sleep(delay)
+    finally:
+        log.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        usage="supervise.py [options] -- PROG [ARGS...]")
+    ap.add_argument("--heartbeat-timeout", type=float, default=120.0,
+                    help="seconds of heartbeat silence (after the first "
+                         "beat) before the child is declared hung and "
+                         "SIGKILLed")
+    ap.add_argument("--startup-grace", type=float, default=900.0,
+                    help="seconds allowed before the FIRST heartbeat "
+                         "(compile + init)")
+    ap.add_argument("--max-restarts", type=int, default=5,
+                    help="total relaunch budget before giving up")
+    ap.add_argument("--backoff", type=float, default=2.0,
+                    help="base restart delay; doubles per consecutive "
+                         "no-progress failure")
+    ap.add_argument("--backoff-max", type=float, default=60.0,
+                    help="restart delay ceiling")
+    ap.add_argument("--events", default="supervise_events.jsonl",
+                    help="supervisor JSONL event log (rendered by "
+                         "scripts/obs_report.py)")
+    ap.add_argument("child", nargs=argparse.REMAINDER,
+                    help="-- followed by the training command")
+    args = ap.parse_args(argv)
+    child = list(args.child)
+    if child and child[0] == "--":
+        child = child[1:]
+    if not child:
+        ap.error("no child command given (append '-- PROG ARGS...')")
+    if child[0].endswith(".py"):
+        child = [sys.executable] + child
+    return supervise(child, heartbeat_timeout=args.heartbeat_timeout,
+                     startup_grace=args.startup_grace,
+                     max_restarts=args.max_restarts, backoff=args.backoff,
+                     backoff_max=args.backoff_max,
+                     events_path=args.events)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,9 +86,15 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # idle — docs/fault_tolerance.md §storage faults) ride the same pending
 # window as the clients_sweep/host_offload_scale legs (same compile
 # class).
+# NOTE (integrity PR): the integrity capture + integrity_ab A/B
+# (checksums off vs on-idle vs background-scrub disk-tier rounds, gate
+# <= 2% on-idle, rows bit-identical — docs/fault_tolerance.md §silent
+# corruption) ride the same pending window and compile class as the
+# io_faults legs.
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
 coalesce telemetry watch downlink straggler clients_sweep io_faults \
-participation host_offload_scale watch_ab io_faults_ab \
+integrity participation host_offload_scale watch_ab io_faults_ab \
+integrity_ab \
 compressed_collectives stream_sketch sketch_coalesce fused_epilogue \
 learning profile profile_fused profile_stream profile_coalesce \
 profile_gpt2 host_offload imagenet ops"}
@@ -119,7 +125,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|watch|downlink|straggler|clients_sweep|io_faults)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|watch|downlink|straggler|clients_sweep|io_faults|integrity)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact.
       # `telemetry` is the telemetry-overhead A/B leg: headline geometry
@@ -236,6 +242,21 @@ for step in $STEPS; do
           && grep -q "io_faults A/B" "$OUT/tpu_measure_io_faults.log"
       then
         mark_done io_faults_ab
+      fi
+      ;;
+    integrity_ab)
+      # integrity-plane A/B (docs/fault_tolerance.md §silent
+      # corruption): disk-tier rounds checksums-off vs on-idle (gate
+      # <= 2%) vs on + 32-row background scrub, rows bit-identical
+      log "step $i: tpu_measure.py integrity A/B (timeout 30m)"
+      timeout 1800 python scripts/tpu_measure.py integrity \
+        >"$OUT/tpu_measure_integrity.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_integrity.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "integrity A/B" "$OUT/tpu_measure_integrity.log"
+      then
+        mark_done integrity_ab
       fi
       ;;
     compressed_collectives)

@@ -684,6 +684,96 @@ def io_faults_leg():
                       "— retries are NOT invisible to the trajectory")
 
 
+def integrity_leg():
+    """Integrity-plane A/B (docs/fault_tolerance.md §silent corruption):
+    the disk-tier gather -> headline sketched round -> scatter cycle,
+    per-row checksums OFF vs ON-idle (the verify-every-read CRC pass —
+    gate <= 2% rounds/sec) vs ON + a 32-row/round background scrub on
+    the ordered worker (overlapped, prices the full audit cadence); the
+    final rows pinned BIT-identical across all three legs (verification
+    only reads)."""
+    import shutil
+    import tempfile
+
+    from commefficient_tpu.federated.host_state import (
+        CohortPrefetcher,
+        MemmapRowStore,
+    )
+    from commefficient_tpu.federated.rounds import ClientStates
+    from commefficient_tpu.parallel.mesh import default_client_mesh
+
+    _copy_rows = jax.jit(jnp.copy)
+    n = int(os.environ.get("INTEGRITY_CLIENTS", "100000"))
+    iters = 20
+    rows = []
+    finals = {}
+    W = mesh = None
+    for tag, checksums, scrub in (("off", False, 0),
+                                  ("on_idle", True, 0),
+                                  ("scrub", True, 32)):
+        steps, ps, ss, cs, batch = B.build(tiny=False, error_type="local")
+        if W is None:
+            W = int(np.asarray(batch["worker_mask"]).shape[0])
+            mesh = default_client_mesh(W)
+        row_shape = tuple(int(x) for x in cs.errors.shape[1:])
+        batch = dict(batch)
+        batch["client_ids"] = jnp.arange(W, dtype=jnp.int32)
+        store_dir = tempfile.mkdtemp(prefix=f"integrity_{tag}_")
+        store = MemmapRowStore(store_dir, n, {"errors": row_shape},
+                               mesh=mesh, checksums=checksums,
+                               scrub_rows=scrub)
+        pf = CohortPrefetcher(store.gather_async)
+        rng = np.random.RandomState(11)
+        cohorts = [rng.choice(n, W, replace=False)
+                   for _ in range(iters + 2)]
+
+        def run_rounds(k, ps_, ss_, ms):
+            pf.prefetch(cohorts[0])
+            for i in range(k):
+                stream, _ = pf.take(cohorts[i])
+                old = ClientStates(None, _copy_rows(stream.proxy.errors),
+                                   None)
+                o = steps.train_step(ps_, ss_, stream.proxy, ms, batch,
+                                     0.1, jax.random.key(i))
+                ps_, ss_, new_proxy, ms = o[:4]
+                store.scatter(stream, old, new_proxy)
+                store.scrub_async()
+                pf.prefetch(cohorts[i + 1])
+            store.drain()
+            return ps_, ss_, ms
+
+        state = run_rounds(1, ps, ss, {})  # compile + touch rows
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            state = run_rounds(iters, *state)
+            drain(state[0])
+            best = min(best, (time.perf_counter() - t0) / iters)
+        counts = store.io_counters()
+        assert counts["corrupt"] == 0, (
+            f"integrity {tag}: clean leg detected corruption")
+        rows.append((tag, best))
+        finals[tag] = store.read_full("errors")
+        print(f"integrity {tag}: {best * 1e3:.2f} ms/round "
+              f"({1 / best:.1f} r/s; {counts['scrub_checked']} rows "
+              f"scrubbed)", flush=True)
+        store.close()
+        shutil.rmtree(store_dir, ignore_errors=True)
+    if len(rows) == 3:
+        off, idle, scrub = (dt for _, dt in rows)
+        print(f"integrity A/B: checksums-on costs "
+              f"{(idle - off) * 1e3:+.3f} ms/round "
+              f"({(idle / off - 1) * 100:+.2f}% — gate <= 2%), "
+              f"background scrub costs "
+              f"{(scrub - off) * 1e3:+.3f} ms/round", flush=True)
+        same = (np.array_equal(finals["off"], finals["on_idle"])
+                and np.array_equal(finals["off"], finals["scrub"]))
+        print(f"integrity rows bit-identical across legs: {same}",
+              flush=True)
+        assert same, ("checksum-on rows diverged from checksums-off — "
+                      "verification must only READ")
+
+
 def gpt2_leg(bf16):
     steps, ps, ss, cs, batch, tokens = B.build_gpt2(bf16=bf16)
     # train_step donates ps/client_states: after this call the local
@@ -777,7 +867,7 @@ def main():
     known = {"matmul", "cifar", "ops", "gpt2", "imagenet", "topk_ab",
              "fused_epilogue", "stream_sketch", "sketch_coalesce",
              "compressed_collectives", "participation",
-             "host_offload_scale", "watch", "io_faults"}
+             "host_offload_scale", "watch", "io_faults", "integrity"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -822,6 +912,8 @@ def main():
         leg("watch", watch_leg)
     if sel("io_faults"):
         leg("io_faults", io_faults_leg)
+    if sel("integrity"):
+        leg("integrity", integrity_leg)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,564 @@
+"""End-to-end integrity plane (docs/fault_tolerance.md §silent corruption).
+
+Pins, per the acceptance drill:
+
+- the per-row checksum sidecar round trip: clean gathers verify (holes,
+  coalesced blocks, duplicates included) and checksums-on is BIT-identical
+  to checksums-off on the clean path, store-level and e2e through
+  cv_train on the forced disk tier;
+- seeded ``flip``/``storn`` injection: silent on the faulted op (no
+  error raised, counters advance), deterministic in the seed, captured
+  by the checkpointed injector RNG;
+- detection on every verified read path (gather, coalesced block,
+  scatter read-modify-write, scrub) with the repair ladder behind it:
+  verifying re-read → bit-exact ``.rows``-snapshot repair (clean rows
+  only) → quarantine — every detection resolved, every rung counted;
+- the background scrubber: bounded budget per pass, rolling cursor,
+  cold-row corruption found and repaired before a snapshot can inherit
+  it;
+- the ACCEPTANCE e2e: a seeded ``flip=P`` disk-tier cv_train run
+  detects every injected flip reaching a gathered-or-scrubbed row, each
+  detection repaired or quarantined as counted events, the whole story
+  reproduced from the JSONL log alone via obs_report.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import cv_train  # noqa: E402
+from commefficient_tpu.federated.host_state import (  # noqa: E402
+    IOFaultInjector,
+    IOFaultSchedule,
+    MemmapRowStore,
+    parse_io_fault,
+)
+from commefficient_tpu.federated.rounds import ClientStates  # noqa: E402
+
+
+def _load_obs():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(os.path.dirname(__file__), "..",
+                                   "scripts", "obs_report.py"))
+    obs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs)
+    return obs
+
+
+ROW = (3, 4)
+ROW_NBYTES = int(np.prod(ROW)) * 4
+
+
+def _rows(n=8, seed=0):
+    return np.random.RandomState(seed).randn(n, *ROW).astype(np.float32)
+
+
+def _flip_on_disk(store, name, row, offset=5, xor=0xFF):
+    """Emulate real bit rot: corrupt one byte of the backing file
+    directly, below every software seam."""
+    with open(store.member_path(name), "r+b") as f:
+        pos = row * ROW_NBYTES + offset
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ xor]))
+
+
+def _drive_store(store, rounds=6, w=4, n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    gathered = []
+    for i in range(rounds):
+        ids = np.array([(i + j) % n for j in range(w)])
+        s = store.gather(ids)
+        gathered.append(np.asarray(s.proxy.errors).copy())
+        delta = jnp.asarray(rng.randn(w, *ROW).astype(np.float32))
+        new = ClientStates(None, s.proxy.errors + delta, None)
+        store.scatter(s, s.proxy, new)
+    store.drain()
+    return gathered, store.read_full("errors")
+
+
+# ---------------------------------------------------------------------------
+# checksum sidecar round trip
+# ---------------------------------------------------------------------------
+
+class TestChecksumSidecar:
+    def test_clean_gathers_verify_holes_coalesce_duplicates(self,
+                                                            tmp_path):
+        store = MemmapRowStore(str(tmp_path / "s"), 8, {"errors": ROW})
+        assert store.checksums and store._crc is not None
+        rows = _rows()
+        # rows 0..3 written; 4..7 stay holes (zero-row CRC must verify)
+        store.write_full("errors", np.concatenate(
+            [rows[:4], np.zeros((4,) + ROW, np.float32)]))
+        ids = np.array([1, 2, 3, 3, 6, 0, 1, 2])  # coalesced + dup + hole
+        got = np.asarray(store.gather(ids).proxy.errors)
+        want = np.concatenate([rows[:4],
+                               np.zeros((4,) + ROW, np.float32)])[ids]
+        np.testing.assert_array_equal(got, want)
+        assert store.rows_corrupt == 0 and store.rows_repaired == 0
+        assert store.coalesced_rows > 0, "coalesced path not exercised"
+        store.close()
+
+    def test_kill_switch_env_disables(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("COMMEFFICIENT_IO_CHECKSUMS", "0")
+        store = MemmapRowStore(str(tmp_path / "s"), 8, {"errors": ROW})
+        assert not store.checksums and store._crc is None
+        store.close()
+
+    def test_detect_on_gather_without_snapshot_quarantines(self,
+                                                           tmp_path):
+        store = MemmapRowStore(str(tmp_path / "s"), 8, {"errors": ROW})
+        rows = _rows()
+        store.write_full("errors", rows)
+        _flip_on_disk(store, "errors", 2)
+        got = np.asarray(store.gather(np.array([2])).proxy.errors)
+        # no snapshot covers the row -> the quarantine rung: base re-init
+        np.testing.assert_array_equal(got[0],
+                                      np.zeros(ROW, np.float32))
+        assert store.rows_corrupt == 1
+        assert store.rows_quarantined == 1 and store.rows_repaired == 0
+        kinds = [e["kind"] for e in store.pop_events()]
+        assert kinds == ["row_corrupt", "row_quarantined"]
+        store.close()
+
+    def test_detect_inside_coalesced_block(self, tmp_path):
+        store = MemmapRowStore(str(tmp_path / "s"), 8, {"errors": ROW})
+        rows = _rows(seed=3)
+        store.write_full("errors", rows)
+        _flip_on_disk(store, "errors", 4)  # middle of the 2..6 run
+        got = np.asarray(store.gather(np.arange(2, 7)).proxy.errors)
+        assert store.coalesced_rows > 0
+        assert store.rows_corrupt == 1
+        # healthy neighbors of the corrupt row are untouched bit-exact
+        np.testing.assert_array_equal(got[0], rows[2])
+        np.testing.assert_array_equal(got[1], rows[3])
+        np.testing.assert_array_equal(got[3], rows[5])
+        np.testing.assert_array_equal(got[4], rows[6])
+        np.testing.assert_array_equal(got[2],
+                                      np.zeros(ROW, np.float32))
+        store.close()
+
+    def test_scatter_rmw_detects(self, tmp_path):
+        """A delta must never be applied on top of silently corrupt
+        bytes: the scatter's read-modify-write read is verified too."""
+        store = MemmapRowStore(str(tmp_path / "s"), 8, {"errors": ROW})
+        rows = _rows(seed=4)
+        store.write_full("errors", rows)
+        s = store.gather(np.array([5]))
+        _flip_on_disk(store, "errors", 5)
+        delta = jnp.ones((1,) + ROW, jnp.float32)
+        store.scatter(s, s.proxy,
+                      ClientStates(None, s.proxy.errors + delta, None))
+        store.drain()
+        assert store.rows_corrupt == 1
+        ev = [e["kind"] for e in store.pop_events()]
+        assert "row_corrupt" in ev
+        # quarantine reset the row to base, THEN the delta landed on it
+        # (the delta is f32 (x+1)-x, so 1 only to rounding)
+        np.testing.assert_allclose(store.read_full("errors")[5],
+                                   np.ones(ROW, np.float32), rtol=1e-6)
+        store.close()
+
+    def test_checksums_on_off_bit_identical_clean_store(self, tmp_path,
+                                                        monkeypatch):
+        on = MemmapRowStore(str(tmp_path / "on"), 8, {"errors": ROW})
+        g_on, f_on = _drive_store(on)
+        assert on.rows_corrupt == 0
+        on.close()
+        monkeypatch.setenv("COMMEFFICIENT_IO_CHECKSUMS", "0")
+        off = MemmapRowStore(str(tmp_path / "off"), 8, {"errors": ROW})
+        g_off, f_off = _drive_store(off)
+        off.close()
+        for a, b in zip(g_on, g_off):
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(f_on, f_off)
+
+
+# ---------------------------------------------------------------------------
+# flip / storn injection (the silent faults)
+# ---------------------------------------------------------------------------
+
+class TestSilentInjection:
+    def test_grammar_round_trips_and_mass(self):
+        s = parse_io_fault("eio=0.1,flip=0.05,storn=0.02,seed=3")
+        assert s.flip == 0.05 and s.storn == 0.02
+        assert parse_io_fault(s.spec()) == s
+        assert s.active
+        with pytest.raises((ValueError, AssertionError)):
+            parse_io_fault("eio=0.5,flip=0.3,storn=0.3")  # mass > 1
+        with pytest.raises((ValueError, AssertionError)):
+            parse_io_fault("flip=1.5")
+
+    def test_draw_deterministic_with_silent_kinds(self):
+        sched = parse_io_fault("eio=0.2,flip=0.2,storn=0.2,seed=11")
+        a = IOFaultInjector(sched)
+        b = IOFaultInjector(sched)
+        seq_a = [a.draw() for _ in range(300)]
+        seq_b = [b.draw() for _ in range(300)]
+        assert seq_a == seq_b
+        assert a.injected["flip"] > 0 and a.injected["storn"] > 0
+        # the corrupted byte position is a pure function of the flip
+        # count + row — no RNG state beyond the one-draw-per-op stream
+        assert a.flip_pos(3, 48) == b.flip_pos(3, 48)
+
+    def test_flip_write_is_silent_and_detected_on_read(self, tmp_path):
+        store = MemmapRowStore(
+            str(tmp_path / "s"), 8, {"errors": ROW},
+            inject=parse_io_fault("flip=1.0,seed=1"))
+        vals = np.arange(12, dtype=np.float32).reshape(ROW)
+        store._pwrite_row("errors", 2, vals)  # worker idle: the raw seam
+        assert store.inject.injected["flip"] == 1
+        # SILENT: no exception, but the medium disagrees with the intent
+        raw = os.pread(store._fd["errors"], ROW_NBYTES, 2 * ROW_NBYTES)
+        assert raw != vals.tobytes()
+        # ... and the sidecar recorded the INTENDED bytes
+        store.inject = None  # stop injecting; now read verified
+        store._read_row("errors", 2)
+        assert store.rows_corrupt == 1 and store.rows_quarantined == 1
+        store.close()
+
+    def test_storn_write_is_silent_and_detected_on_read(self, tmp_path):
+        store = MemmapRowStore(str(tmp_path / "s"), 8, {"errors": ROW})
+        first = np.full(ROW, 7.0, np.float32)
+        store._pwrite_row("errors", 1, first)
+        store.inject = IOFaultInjector(parse_io_fault("storn=1.0,seed=1"))
+        second = np.full(ROW, -3.0, np.float32)
+        store._pwrite_row("errors", 1, second)  # silent half-write
+        assert store.inject.injected["storn"] == 1
+        raw = np.frombuffer(
+            os.pread(store._fd["errors"], ROW_NBYTES, ROW_NBYTES),
+            np.float32)
+        assert (raw[: raw.size // 2] == -3.0).all()
+        assert (raw[raw.size // 2:] == 7.0).all()  # the stale tail
+        store.inject = None
+        store._read_row("errors", 1)
+        assert store.rows_corrupt == 1
+        store.close()
+
+    def test_read_side_flip_heals_via_reread(self, tmp_path):
+        """A flipped READ buffer (bad transfer, good media) must repair
+        through the verifying re-read rung — the disk was never wrong,
+        so no content is lost and nothing quarantines."""
+        store = MemmapRowStore(str(tmp_path / "s"), 8, {"errors": ROW})
+        rows = _rows(seed=6)
+        store.write_full("errors", rows)
+        # arm flip=1.0 for exactly the gather's read; the handler's
+        # re-read then draws clean (the transient-fault shape)
+        sched = parse_io_fault("flip=1.0,seed=2")
+
+        class OneShot(IOFaultInjector):
+            fired = False
+
+            def draw(self):
+                if self.fired:
+                    return None
+                kind = super().draw()
+                if kind is not None:
+                    self.fired = True
+                return kind
+
+        store.inject = OneShot(sched)
+        got = np.asarray(store.gather(np.array([3])).proxy.errors)
+        np.testing.assert_array_equal(got[0], rows[3])
+        assert store.rows_corrupt == 1 and store.rows_repaired == 1
+        assert store.rows_quarantined == 0
+        ev = store.pop_events()
+        assert [e["kind"] for e in ev] == ["row_corrupt", "row_repaired"]
+        assert ev[1]["source"] == "reread"
+        store.close()
+
+    def test_injector_rng_checkpoint_round_trip_with_flip(self,
+                                                          tmp_path):
+        sched = parse_io_fault("eio=0.2,flip=0.2,seed=9")
+        store = MemmapRowStore(str(tmp_path / "a"), 8, {"errors": ROW},
+                               inject=sched, io_retries=6,
+                               io_backoff_ms=0.1)
+        _drive_store(store, rounds=2)
+        _, keys, pos, gauss, cached = store.inject.rng.get_state()
+        twin = MemmapRowStore(str(tmp_path / "b"), 8, {"errors": ROW},
+                              inject=sched)
+        twin.inject.rng.set_state(("MT19937", keys, pos, gauss, cached))
+        twin.inject.injected.update(store.inject.injected)
+        want = [store.inject.draw() for _ in range(64)]
+        got = [twin.inject.draw() for _ in range(64)]
+        assert want == got
+        store.close()
+        twin.close()
+
+
+# ---------------------------------------------------------------------------
+# repair-vs-quarantine decision
+# ---------------------------------------------------------------------------
+
+class TestRepair:
+    def _seeded(self, tmp_path, name="s", scrub=0):
+        store = MemmapRowStore(str(tmp_path / name), 8, {"errors": ROW},
+                               scrub_rows=scrub)
+        rows = _rows(seed=1)
+        store.write_full("errors", rows)
+        meta = store.save_snapshot(str(tmp_path / f"{name}.snap"))
+        assert meta["members"]["errors"]["crc"]
+        return store, rows
+
+    def test_clean_row_repairs_bit_exact_from_snapshot(self, tmp_path):
+        store, rows = self._seeded(tmp_path)
+        _flip_on_disk(store, "errors", 3)
+        got = np.asarray(store.gather(np.array([3])).proxy.errors)
+        np.testing.assert_array_equal(got[0], rows[3])
+        assert store.rows_corrupt == 1 and store.rows_repaired == 1
+        assert store.rows_quarantined == 0
+        ev = store.pop_events()
+        assert ev[1]["kind"] == "row_repaired"
+        assert ev[1]["source"] == "snapshot"
+        # the repaired row stays repair-ABLE: corrupt it again
+        _flip_on_disk(store, "errors", 3, offset=11, xor=0x42)
+        got = np.asarray(store.gather(np.array([3])).proxy.errors)
+        np.testing.assert_array_equal(got[0], rows[3])
+        assert store.rows_repaired == 2
+        store.close()
+
+    def test_dirty_row_quarantines_instead_of_stale_repair(self,
+                                                           tmp_path):
+        """A row written SINCE the snapshot must never 'repair' to the
+        snapshot's stale content — that would silently rewind state.
+        The quarantine rung (counted, loud) owns it instead."""
+        store, rows = self._seeded(tmp_path)
+        s = store.gather(np.array([5]))
+        store.scatter(s, s.proxy, ClientStates(
+            None, s.proxy.errors + 1.0, None))
+        store.drain()  # row 5 is now dirty-since-snapshot
+        _flip_on_disk(store, "errors", 5)
+        got = np.asarray(store.gather(np.array([5])).proxy.errors)
+        np.testing.assert_array_equal(got[0],
+                                      np.zeros(ROW, np.float32))
+        assert store.rows_quarantined == 1 and store.rows_repaired == 0
+        store.close()
+
+    def test_failed_repair_write_falls_to_quarantine_not_both(
+            self, tmp_path, monkeypatch):
+        """A snapshot repair whose write-back exhausts the ladder is NOT
+        a repair: exactly one resolution (the quarantine rung) fires —
+        never a row_repaired AND a row_quarantined for one detection —
+        and the caller gets the row's persisted (base) content, not
+        bytes the store failed to land."""
+        store, rows = self._seeded(tmp_path)
+        store.io_retries = 0
+        store.io_backoff_ms = 0.1
+        _flip_on_disk(store, "errors", 2)
+        orig = store._pwrite_row
+        state = {"failed": False}
+
+        def failing(name, row, values):
+            # fail exactly the repair write (the first write to row 2);
+            # the quarantine re-init that follows succeeds
+            if row == 2 and not state["failed"]:
+                state["failed"] = True
+                raise OSError(5, "injected repair-write failure")
+            return orig(name, row, values)
+
+        monkeypatch.setattr(store, "_pwrite_row", failing)
+        got = np.asarray(store.gather(np.array([2])).proxy.errors)
+        np.testing.assert_array_equal(got[0],
+                                      np.zeros(ROW, np.float32))
+        assert store.rows_corrupt == 1
+        assert store.rows_repaired == 0
+        assert store.rows_quarantined == 1
+        kinds = [e["kind"] for e in store.pop_events()]
+        assert kinds == ["row_corrupt", "row_quarantined"]
+        store.close()
+
+    def test_snapshot_moved_keeps_repair_source(self, tmp_path):
+        store, rows = self._seeded(tmp_path)
+        old = str(tmp_path / "s.snap")
+        new = str(tmp_path / "renamed.rows")
+        os.replace(old, new)
+        store.snapshot_moved(new)
+        _flip_on_disk(store, "errors", 2)
+        got = np.asarray(store.gather(np.array([2])).proxy.errors)
+        np.testing.assert_array_equal(got[0], rows[2])
+        assert store.rows_repaired == 1
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# the background scrubber
+# ---------------------------------------------------------------------------
+
+class TestScrub:
+    def test_scrub_detects_and_repairs_cold_row(self, tmp_path):
+        store = MemmapRowStore(str(tmp_path / "s"), 8, {"errors": ROW},
+                               scrub_rows=8)
+        rows = _rows(seed=2)
+        store.write_full("errors", rows)
+        meta1 = store.save_snapshot(str(tmp_path / "snap"))
+        # a COLD row: no cohort ever gathers it — only the scrub can see
+        _flip_on_disk(store, "errors", 6)
+        store.scrub_async()
+        store.drain()
+        assert store.scrub_checked == 8
+        assert store.scrub_mismatch == 1
+        assert store.rows_repaired == 1 and store.rows_quarantined == 0
+        np.testing.assert_array_equal(store.read_full("errors"), rows)
+        # the NEXT snapshot is taken from repaired state, not the rot:
+        # its logical CRC matches the pre-corruption snapshot's exactly
+        meta2 = store.save_snapshot(str(tmp_path / "snap2"))
+        assert meta2["members"]["errors"]["crc"] \
+            == meta1["members"]["errors"]["crc"]
+        store.close()
+
+    def test_scrub_budget_bounded_and_cursor_wraps(self, tmp_path):
+        store = MemmapRowStore(str(tmp_path / "s"), 8, {"errors": ROW},
+                               scrub_rows=3)
+        store.scrub_async()
+        store.drain()
+        assert store.scrub_checked == 3 and store._scrub_cursor == 3
+        for _ in range(3):
+            store.scrub_async()
+        store.drain()
+        assert store.scrub_checked == 12
+        assert store._scrub_cursor == 12 % 8
+        store.close()
+
+    def test_scrub_noop_when_disabled(self, tmp_path):
+        store = MemmapRowStore(str(tmp_path / "s"), 8, {"errors": ROW})
+        store.scrub_async()  # scrub_rows=0: must not enqueue anything
+        store.drain()
+        assert store.scrub_checked == 0
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: cv_train on the forced disk tier
+# ---------------------------------------------------------------------------
+
+def _e2e_args(tmp_path, tag, extra=()):
+    # the test_io_faults geometry verbatim — same jit cache class, so the
+    # suite pays the compile once across both modules
+    return [
+        "--dataset_name", "CIFAR10",
+        "--dataset_dir", str(tmp_path / "data"),
+        "--num_epochs", "1", "--num_workers", "4",
+        "--num_devices", "8",
+        "--local_batch_size", "4", "--valid_batch_size", "8",
+        "--lr_scale", "0.01", "--pivot_epoch", "0.5", "--seed", "0",
+        "--iid", "--num_clients", "8",
+        "--mode", "sketch", "--error_type", "local",
+        "--local_momentum", "0.9",
+        "--k", "200", "--num_cols", "1024", "--num_rows", "3",
+        "--num_blocks", "2",
+        "--checkpoint", "--train_dataloader_workers", "0",
+        "--checkpoint_path", str(tmp_path / tag),
+        "--state_dir", str(tmp_path / tag / "rows"),
+    ] + list(extra)
+
+
+def _weights(tmp_path, tag):
+    from commefficient_tpu.federated.checkpoint import load_checkpoint
+
+    params, _ = load_checkpoint(str(tmp_path / tag / "ResNet9"))
+    return params
+
+
+@pytest.fixture
+def disk_tier(tmp_path, monkeypatch):
+    monkeypatch.setenv("COMMEFFICIENT_SYNTHETIC_PER_CLASS", "16")
+    monkeypatch.setenv("COMMEFFICIENT_STATE_HBM_BUDGET", "1")
+    monkeypatch.setenv("COMMEFFICIENT_STATE_HOST_BUDGET", "1")
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def _newest_log(tmp_path):
+    runs = sorted((tmp_path / "runs").iterdir())
+    assert runs, "no run dir written"
+    return str(runs[-1] / "telemetry.jsonl")
+
+
+class TestChecksumsE2E:
+    def test_checksums_on_off_bit_identical_and_flip_story(self,
+                                                           disk_tier,
+                                                           capsys):
+        """The two e2e acceptance bars in one warm-jit sequence:
+
+        1. BIT-IDENTITY — a clean disk-tier run with per-row checksums
+           ON (the default) has fp32 trajectory + final weights
+           bit-identical to the same run with ``--no_io_checksums``
+           (verification only reads);
+        2. the SILENT-CORRUPTION story — a seeded ``flip=P`` run with
+           checksums + full-coverage scrub detects every injected flip
+           that reaches a gathered-or-scrubbed row (zero undetected
+           poisoned gathers: every detection is counted and resolved as
+           a repair or quarantine), and the WHOLE story — config,
+           detections, repairs, quarantines, realized injected counts —
+           reproduces from the JSONL log alone via obs_report."""
+        tmp_path = disk_tier
+        on = cv_train.main(_e2e_args(tmp_path, "on"))
+        obs = _load_obs()
+        s_on = obs.summarize(obs.load_events(_newest_log(tmp_path)))
+        off = cv_train.main(_e2e_args(tmp_path, "off",
+                                      ["--no_io_checksums"]))
+        s_off = obs.summarize(obs.load_events(_newest_log(tmp_path)))
+        out = capsys.readouterr().out
+        assert "per-row checksums ON" in out
+        assert "per-row checksums OFF" in out
+
+        assert on["train_loss"] == off["train_loss"]
+        assert on["test_acc"] == off["test_acc"]
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            _weights(tmp_path, "on"), _weights(tmp_path, "off"))
+        assert s_on["host_offload"]["io_config"]["checksums"] is True
+        assert s_off["host_offload"]["io_config"]["checksums"] is False
+        assert s_on["host_offload"]["rows_corrupt"] == 0
+
+        # --- the seeded silent-corruption acceptance run ---
+        flip = cv_train.main(_e2e_args(
+            tmp_path, "flip",
+            ["--inject_io_fault", "flip=0.05,seed=7",
+             "--io_scrub_rows", "8",
+             "--metrics_drain_every", "1"]))
+        assert np.isfinite(flip["train_loss"])
+        events = obs.load_events(_newest_log(tmp_path))
+        s = obs.summarize(events)
+        ho = s["host_offload"]
+        assert ho["io_config"]["checksums"] is True
+        assert ho["io_config"]["scrub_rows"] == 8
+        assert ho["io_config"]["inject"].startswith("eio=0,short=0,"
+                                                    "torn=0,stall=0,"
+                                                    "flip=0.05")
+        injected = ho["injected"]
+        assert injected is not None and injected["flip"] > 0, \
+            "the seeded schedule never drew a flip"
+        # every detection resolved — nothing detected-and-dropped
+        assert ho["rows_corrupt"] > 0
+        cks_quarantines = len(
+            [e for e in events if e.get("ev") == "row_quarantined"
+             and "checksum mismatch" in str(e.get("cause"))])
+        assert ho["rows_corrupt"] == ho["rows_repaired"] \
+            + cks_quarantines
+        # a write-side flip reaches disk silently; detection count can
+        # trail the injected count only by rereads of read-side flips
+        assert ho["rows_corrupt"] <= injected["flip"] + injected["storn"]
+        # the scrubber ran with its configured budget every round
+        assert ho["scrub_rows"] > 0
+        # watch plane: detection is observable — the default io_corrupt
+        # rule fired on the first detected round
+        assert any("io_corrupt" in str(e.get("rule"))
+                   for e in events if e.get("ev") == "watch_alert")
+        # and a scrub-found mismatch forced the drain-first checkpoint
+        if ho["scrub_mismatch"]:
+            forced = [e for e in events if e.get("ev") == "checkpoint"
+                      and e.get("forced_by_watch")]
+            assert forced, "scrub_mismatch fired but no forced save"

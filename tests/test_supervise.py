@@ -1,0 +1,316 @@
+"""Self-healing supervisor (scripts/supervise.py,
+docs/fault_tolerance.md §self-healing supervisor).
+
+Pins:
+
+- crash detection + relaunch with ``--resume auto`` (the resume flag
+  appears only on relaunches);
+- hang detection: a child whose heartbeats cease is SIGKILLed at the
+  heartbeat deadline and relaunched;
+- the bounded restart budget (give-up after ``--max-restarts``) and the
+  exponential backoff over consecutive no-progress failures;
+- poison-checkpoint exclusion: a checkpoint whose resume dies twice
+  without a heartbeat is excluded via the
+  ``COMMEFFICIENT_RESUME_EXCLUDE`` seam, and
+  ``find_resume_checkpoint(exclude=)`` skips it (with the reason
+  logged) falling back to the next-newest candidate;
+- the shared heartbeat format: ``profiling.parse_heartbeat`` is the one
+  parser both crash_matrix and the supervisor key on;
+- every decision lands in the supervisor's JSONL and renders through
+  obs_report's Supervisor section.
+
+The unit tests drive the supervisor over a FAKE child (a tiny scripted
+python process: per-attempt behavior plans, no jax) so they stay
+tier-1-fast; the real unattended-recovery drill — SIGKILL / SIGSTOP /
+silent corruption through cv_train under the supervisor — is the @slow
+``TestCrashMatrixSupervise`` leg, per the TestCrashMatrix precedent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from commefficient_tpu.profiling import parse_heartbeat  # noqa: E402
+
+
+def _load_script(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(__file__), "..", "scripts",
+                           f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# the fake child: per-attempt behavior plans
+# ---------------------------------------------------------------------------
+
+_CHILD = textwrap.dedent("""
+    import json, os, sys, time
+    plan_path, state_path = sys.argv[1], sys.argv[2]
+    plan = json.load(open(plan_path))
+    n = int(open(state_path).read()) if os.path.exists(state_path) else 0
+    open(state_path, "w").write(str(n + 1))
+    step = plan[min(n, len(plan) - 1)]
+    with open(state_path + f".attempt{n}", "w") as f:
+        json.dump({"argv": sys.argv[3:],
+                   "exclude": os.environ.get(
+                       "COMMEFFICIENT_RESUME_EXCLUDE", "")}, f)
+    if step.get("resume_print"):
+        print("resumed run state from " + step["resume_print"]
+              + " (continuing at epoch 1)", flush=True)
+    for i in range(step.get("beats", 0)):
+        print(f"HEARTBEAT round={i} loss=1.0", file=sys.stderr,
+              flush=True)
+        time.sleep(step.get("beat_sleep", 0.02))
+    if step.get("hang"):
+        time.sleep(3600)
+    sys.exit(step.get("rc", 0))
+""")
+
+
+@pytest.fixture
+def fake_child(tmp_path):
+    """Returns ``run(plan, **supervise_kwargs) -> (rc, events, attempts)``
+    driving scripts/supervise.py over a scripted child."""
+    sup = _load_script("supervise")
+    child_py = tmp_path / "child.py"
+    child_py.write_text(_CHILD)
+    plan_path = tmp_path / "plan.json"
+    state_path = tmp_path / "state"
+    events_path = tmp_path / "supervise_events.jsonl"
+
+    def run(plan, **kw):
+        plan_path.write_text(json.dumps(plan))
+        kw.setdefault("heartbeat_timeout", 2.0)
+        kw.setdefault("startup_grace", 10.0)
+        kw.setdefault("backoff", 0.05)
+        kw.setdefault("max_restarts", 5)
+        rc = sup.supervise(
+            [sys.executable, str(child_py), str(plan_path),
+             str(state_path)],
+            events_path=str(events_path),
+            out=open(os.devnull, "w"), **kw)
+        events = [json.loads(line)
+                  for line in events_path.read_text().splitlines()]
+        attempts = {}
+        for fn in os.listdir(tmp_path):
+            if fn.startswith("state.attempt"):
+                attempts[int(fn.rsplit("attempt", 1)[1])] = json.loads(
+                    (tmp_path / fn).read_text())
+        return rc, events, attempts
+
+    return run
+
+
+def _evs(events, kind):
+    return [e for e in events if e.get("ev") == kind]
+
+
+class TestSupervisor:
+    def test_crash_detected_and_resumed(self, fake_child):
+        rc, events, attempts = fake_child(
+            [{"beats": 3, "rc": 1}, {"beats": 3, "rc": 0}])
+        assert rc == 0
+        assert len(_evs(events, "supervisor_launch")) == 2
+        restart = _evs(events, "supervisor_restart")
+        assert len(restart) == 1 and restart[0]["reason"] == "crash"
+        assert _evs(events, "supervisor_done")
+        # --resume auto appears on the RELAUNCH only
+        assert "--resume" not in attempts[0]["argv"]
+        assert attempts[1]["argv"][-2:] == ["--resume", "auto"]
+        # the child exit record carries the liveness trail
+        exits = _evs(events, "supervisor_child_exit")
+        assert exits[0]["rc"] == 1 and exits[0]["rounds_seen"] == 3
+
+    def test_hang_detected_by_heartbeat_deadline(self, fake_child):
+        rc, events, _ = fake_child(
+            [{"beats": 2, "hang": True}, {"beats": 2, "rc": 0}],
+            heartbeat_timeout=1.0)
+        assert rc == 0
+        timeouts = _evs(events, "supervisor_timeout")
+        assert len(timeouts) == 1
+        assert timeouts[0]["last_round"] == 1  # beats 0,1 then silence
+        restart = _evs(events, "supervisor_restart")
+        assert restart and restart[0]["reason"] == "hang"
+
+    def test_restart_budget_gives_up(self, fake_child):
+        rc, events, _ = fake_child([{"rc": 3}], max_restarts=2)
+        assert rc == 3
+        assert len(_evs(events, "supervisor_launch")) == 3  # 1 + budget
+        giveup = _evs(events, "supervisor_giveup")
+        assert giveup and giveup[0]["restarts"] == 2
+        assert not _evs(events, "supervisor_done")
+
+    def test_backoff_doubles_on_consecutive_no_progress(self,
+                                                        fake_child):
+        _, events, _ = fake_child([{"rc": 1}], max_restarts=3,
+                                  backoff=0.05)
+        delays = [e["backoff_s"]
+                  for e in _evs(events, "supervisor_restart")]
+        assert delays == [0.05, 0.1, 0.2]
+
+    def test_poison_checkpoint_excluded_after_two_strikes(self,
+                                                          fake_child,
+                                                          tmp_path):
+        poison = str(tmp_path / "ckpt" / "run_state_ep2.npz")
+        rc, events, attempts = fake_child([
+            {"beats": 2, "rc": 1},                  # crash w/ progress
+            {"resume_print": poison, "rc": 1},      # strike 1
+            {"resume_print": poison, "rc": 1},      # strike 2 -> exclude
+            {"beats": 1, "rc": 0},                  # falls back, recovers
+        ])
+        assert rc == 0
+        pe = _evs(events, "supervisor_poison")
+        assert len(pe) == 1 and pe[0]["path"] == poison
+        assert pe[0]["strikes"] == 2
+        # attempts 0-2 saw no exclusion; the post-poison launch did
+        assert attempts[2]["exclude"] == ""
+        assert poison in attempts[3]["exclude"]
+
+    def test_obs_report_renders_supervisor_section(self, fake_child,
+                                                   tmp_path):
+        _, events, _ = fake_child(
+            [{"beats": 1, "rc": 1}, {"beats": 1, "rc": 0}])
+        obs = _load_script("obs_report")
+        s = obs.summarize(events)
+        sup = s["supervisor"]
+        assert sup["launches"] == 2 and sup["restarts"] == 1
+        assert sup["completed"] and not sup["gave_up"]
+        assert sup["crashes"] == 1 and sup["hangs"] == 0
+        import io
+
+        out = io.StringIO()
+        obs.render(events, out=out)
+        assert "## Supervisor" in out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# the exclusion seam in --resume auto discovery
+# ---------------------------------------------------------------------------
+
+def _make_ckpt(path):
+    from commefficient_tpu.federated.checkpoint import _content_checksum
+
+    arrays = {"x": np.arange(4, dtype=np.float32)}
+    meta = {"checksum": _content_checksum(arrays)}
+    arrays["meta_json"] = np.frombuffer(json.dumps(meta).encode(),
+                                        np.uint8)
+    np.savez(path, **arrays)
+
+
+class TestResumeExclusion:
+    def test_exclude_param_skips_with_reason(self, tmp_path, capsys):
+        from commefficient_tpu.federated.checkpoint import (
+            find_resume_checkpoint,
+        )
+
+        _make_ckpt(str(tmp_path / "run_state_ep1"))
+        _make_ckpt(str(tmp_path / "run_state_ep2"))
+        newest = str(tmp_path / "run_state_ep2.npz")
+        assert find_resume_checkpoint(str(tmp_path)) == newest
+        got = find_resume_checkpoint(str(tmp_path), exclude=[newest])
+        assert got == str(tmp_path / "run_state_ep1.npz")
+        assert "excluded (poison-checkpoint list)" \
+            in capsys.readouterr().out
+
+    def test_exclude_env_seam(self, tmp_path, monkeypatch):
+        from commefficient_tpu.federated.checkpoint import (
+            find_resume_checkpoint,
+        )
+
+        _make_ckpt(str(tmp_path / "run_state_ep1"))
+        _make_ckpt(str(tmp_path / "run_state_ep2"))
+        monkeypatch.setenv("COMMEFFICIENT_RESUME_EXCLUDE",
+                           str(tmp_path / "run_state_ep2.npz"))
+        assert find_resume_checkpoint(str(tmp_path)) \
+            == str(tmp_path / "run_state_ep1.npz")
+        # everything excluded -> None (callers start fresh)
+        monkeypatch.setenv(
+            "COMMEFFICIENT_RESUME_EXCLUDE",
+            os.pathsep.join([str(tmp_path / "run_state_ep1.npz"),
+                             str(tmp_path / "run_state_ep2.npz")]))
+        assert find_resume_checkpoint(str(tmp_path)) is None
+
+    def test_skip_reasons_named(self, tmp_path, capsys):
+        from commefficient_tpu.federated.checkpoint import (
+            find_resume_checkpoint,
+        )
+
+        _make_ckpt(str(tmp_path / "run_state_ep1"))
+        # corrupt npz: garbage bytes at the newest name
+        with open(tmp_path / "run_state_ep3.npz", "wb") as f:
+            f.write(b"not a zip archive at all")
+        # bad .rows: a clean npz whose meta names a missing row snapshot
+        arrays = {"x": np.arange(3, dtype=np.float32)}
+        from commefficient_tpu.federated.checkpoint import (
+            _content_checksum,
+        )
+
+        meta = {"checksum": _content_checksum(arrays),
+                "client_store": {"dir": "missing.rows",
+                                 "members": {"errors": {
+                                     "shape": [3, 4], "crc": 1}}}}
+        arrays["meta_json"] = np.frombuffer(json.dumps(meta).encode(),
+                                            np.uint8)
+        np.savez(str(tmp_path / "run_state_ep2"), **arrays)
+        got = find_resume_checkpoint(str(tmp_path))
+        assert got == str(tmp_path / "run_state_ep1.npz")
+        out = capsys.readouterr().out
+        assert "corrupt npz" in out
+        assert "bad .rows snapshot" in out
+
+
+class TestHeartbeatFormat:
+    def test_parse_round_trips_producer_output(self, capsys):
+        from commefficient_tpu.profiling import Heartbeat
+
+        hb = Heartbeat(enabled=True)
+        hb.round(7, loss=0.125, guard_ok=True)
+        hb.round(8)
+        err = capsys.readouterr().err
+        lines = [ln for ln in err.splitlines() if ln]
+        assert parse_heartbeat(lines[0]) == {
+            "round": 7, "loss": 0.125, "guard_ok": True}
+        assert parse_heartbeat(lines[1]) == {"round": 8}
+        assert parse_heartbeat("some other stderr line") is None
+
+
+# ---------------------------------------------------------------------------
+# the real unattended-recovery drill
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestCrashMatrixSupervise:
+    """Marked @slow like TestCrashMatrix (several cv_train subprocesses,
+    each paying a fresh compile — the children run without the
+    persistent XLA cache, see crash_matrix.child_env): the ACCEPTANCE
+    supervisor leg — an external SIGKILL and an external SIGSTOP (hang)
+    both recover unattended with final fp32 weights bit-identical to an
+    uninterrupted baseline, and a forced disk-tier run with seeded
+    silent row corruption (flip=P + checksums + scrub) completes
+    unattended with every detection repaired or quarantined."""
+
+    def test_sigkill_hang_and_flip_recover_unattended(self, tmp_path):
+        scripts_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts")
+        sys.path.insert(0, scripts_dir)
+        try:
+            import crash_matrix
+        finally:
+            sys.path.remove(scripts_dir)
+
+        crash_matrix.run_matrix(str(tmp_path), trials=1, seed=0,
+                                planes=("supervise",))
