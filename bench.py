@@ -154,7 +154,7 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
           telemetry: bool = False, telemetry_hist: bool = False,
           collective_plan: str = "",
           participation: float = 1.0, drop_frac: float = 0.0,
-          error_type: str = "virtual"):
+          error_type: str = "virtual", shard_devices: int = 1):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -223,14 +223,21 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
                       collective_plan=plan)
     loss_train, loss_val = make_cv_losses(model)
     # the entrypoints' real execution path: shard_map+psum over a clients
-    # mesh — a 1-device mesh on the single bench chip
-    from commefficient_tpu.parallel.mesh import default_client_mesh
+    # mesh — a 1-device mesh on the single bench chip; --shard_devices > 1
+    # adds the second server axis (2D clients x shard plane,
+    # docs/multihost.md) and the server reduce runs over the ordered
+    # (shard, clients) tuple
+    from commefficient_tpu.parallel.mesh import (
+        default_client_mesh,
+        server_reduce_axes,
+    )
 
-    mesh = default_client_mesh(num_workers)
+    mesh = default_client_mesh(num_workers, shard_devices=shard_devices)
+    axes = server_reduce_axes(mesh)
     _log(f"mesh: {dict(mesh.shape)} over {mesh.devices.size} device(s), "
          f"mode={mode}, W={num_workers}, server_shard={server_shard}")
     steps = build_round_step(loss_train, loss_val, unravel, ravel, cfg,
-                             sketch=sketch, mesh=mesh)
+                             sketch=sketch, mesh=mesh, axis=axes)
 
     # non_iid models the FEMNIST/CIFAR100 federated split (BASELINE.md
     # config 4): a large client population with skewed per-round sampling.
@@ -238,10 +245,30 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
     # much compute a round does, so the leg is honest about measuring the
     # same round under the non-IID configuration.
     num_clients = 500 if non_iid else 10
+    from commefficient_tpu.parallel.mesh import (
+        axis_product,
+        mesh_axis_placement,
+    )
+
+    lowering = None
+    if plan is not None and plan.per_axis and server_shard:
+        # per-mesh-axis legs (docs/multihost.md): the same resolution
+        # build_round_step does, so the carry slots match the lowering
+        from commefficient_tpu.ops.collectives import (
+            PLAN_LEGS,
+            resolve_leg_lowering,
+        )
+
+        placement = mesh_axis_placement(mesh)
+        lowering = {l: resolve_leg_lowering(getattr(plan, l), axes,
+                                            placement)
+                    for l in PLAN_LEGS}
+    axis_names = (axes,) if isinstance(axes, str) else axes
     server_state = init_server_state(
         scfg, sketch,
-        shard_n=mesh.shape["clients"] if server_shard else 0,
-        plan=plan)
+        shard_n=axis_product(mesh, axes) if server_shard else 0,
+        plan=plan, lowering=lowering,
+        axis_sizes={a: int(mesh.shape[a]) for a in axis_names})
     if server_shard:
         # commit the sharded-plane residency up front — the ONE rule
         # FedModel uses (server.place_server_state), so round 1 hits the
@@ -249,7 +276,7 @@ def build(tiny: bool, num_classes: int = 10, non_iid: bool = False,
         from commefficient_tpu.federated.server import place_server_state
 
         server_state = place_server_state(server_state, mesh, mode,
-                                          server_shard=True)
+                                          server_shard=True, axis=axes)
     client_states = init_client_states(num_clients, d, wcfg, sketch=sketch,
                                        init_weights=flat)
 
@@ -607,6 +634,7 @@ class CfgLeg(NamedTuple):
     collective_plan: str = ""
     participation: float = 1.0
     drop_frac: float = 0.0
+    shard_devices: int = 1
 
 
 _CFG_LEGS = {
@@ -729,6 +757,27 @@ _CFG_LEGS = {
                         "drops (ResNet9, sketch 5x500k k=50k, "
                         "partial-cohort round)",
                         participation=0.5, drop_frac=0.1),
+    # the `shard` leg on the 2D (clients x shard) server plane with the
+    # per-MESH-AXIS collective plan (--shard_devices 2 --collective_plan
+    # table=shard:fp32/clients:int8,..., docs/multihost.md): the shard
+    # hop (ICI on a pod) stays fp32 while the clients hop (the
+    # DCN-spanning axis on a multi-host mesh) is int8-quantized with its
+    # per-level EF carry. On a single-host multi-chip mesh both hops ride
+    # ICI, so the leg reads the hierarchical-lowering + per-level
+    # quantize step-time cost vs the flat `shard`/`downlink` legs; the
+    # cross-host DCN-byte win itself is static (ledger: ~4x fewer
+    # DCN bytes/round) and needs a real multi-host window to time.
+    # Needs >= 2x2 devices — the leg aborts cleanly on the 1-chip bench.
+    "multihost": CfgLeg("sketch", 8, "BASELINE",
+                        "8-worker sketched rounds/sec/chip with "
+                        "--server_shard --shard_devices 2 and the "
+                        "per-axis plan table/downlink=shard:fp32+"
+                        "clients:int8 (ResNet9, sketch 5x500k k=50k, "
+                        "hierarchical quantized collectives)",
+                        server_shard=True, shard_devices=2,
+                        collective_plan="table=shard:fp32/clients:int8,"
+                                        "downlink=shard:fp32/"
+                                        "clients:int8"),
 }
 
 
@@ -750,6 +799,14 @@ def run_config_measurement(name: str) -> None:
             "BASELINE_C2": BASELINE_C2_ROUNDS_PER_SEC,
             "BASELINE_CIFAR100":
                 BASELINE_CIFAR100_ROUNDS_PER_SEC}[leg.baseline]
+    if leg.shard_devices > 1 and jax.device_count() < 2 * leg.shard_devices:
+        # the 2D leg needs a real (clients >= 2) x shard mesh; on fewer
+        # devices default_client_mesh would degrade to 1D and the
+        # per-axis plan would (correctly) refuse to resolve — abort with
+        # the actionable message instead
+        sys.exit(f"--run-cfg {name}: needs >= {2 * leg.shard_devices} "
+                 f"devices for the 2D (clients x shard={leg.shard_devices}) "
+                 f"mesh; found {jax.device_count()}")
     steps, ps, server_state, client_states, batch = build(
         tiny=False, num_classes=num_classes, non_iid=leg.non_iid,
         mode=leg.mode, num_workers=W, server_shard=leg.server_shard,
@@ -758,7 +815,8 @@ def run_config_measurement(name: str) -> None:
         sketch_coalesce=leg.sketch_coalesce, telemetry=leg.telemetry,
         telemetry_hist=leg.telemetry_hist,
         collective_plan=leg.collective_plan,
-        participation=leg.participation, drop_frac=leg.drop_frac)
+        participation=leg.participation, drop_frac=leg.drop_frac,
+        shard_devices=leg.shard_devices)
     if K > 1:
         inner = steps.train_step
 
@@ -1220,6 +1278,12 @@ _EXTRA_LEGS = {
                  "downlink_rounds_per_sec"),
     "straggler": (["--run-cfg", "straggler"], "BENCH_C12_TIMEOUT", 900,
                   "straggler_rounds_per_sec"),
+    # 2D (clients x shard) server plane + per-mesh-axis quantized
+    # collectives (docs/multihost.md): needs >= 4 devices, so this leg
+    # only lands on a multi-chip window (tpu_batch.sh orders it after
+    # the single-chip legs)
+    "multihost": (["--run-cfg", "multihost"], "BENCH_C12_TIMEOUT", 900,
+                  "multihost_rounds_per_sec"),
     # million-client host-offload data plane (docs/host_offload.md):
     # rounds/sec vs synthetic population 10^4/10^5/10^6 with disk-tier
     # (sparse memmap) client state streamed through the cohort prefetcher

@@ -178,6 +178,17 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                         help="Shard the server aggregation/update over the "
                              "worker mesh axis (reduce-scatter -> per-"
                              "shard update -> all-gather).")
+    # 2D server plane (docs/multihost.md): factor the worker axis into
+    # (clients, shard) so the server reduce composes per mesh level — on a
+    # multi-host DCN x ICI mesh the leading 'clients' axis spans processes
+    # and 'shard' stays intra-host, letting --collective_plan pick a wire
+    # dtype per axis (cheap ICI leg exact, expensive DCN leg quantized).
+    parser.add_argument("--shard_devices", type=int, default=1,
+                        help="Devices on the intra-host 'shard' server "
+                             "axis of the 2D (clients x shard) mesh; 1 = "
+                             "the flat 1D worker axis. Requires "
+                             "--server_shard (the shard axis only carries "
+                             "the sharded server plane).")
     parser.add_argument("--reduce_dtype", choices=["float32", "int8"],
                         default="float32",
                         help="LEGACY alias of --collective_plan: int8 sets "
@@ -203,9 +214,14 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                              "fp32), one bare dtype for every leg, or "
                              "'auto' (one-time on-chip probe picks the "
                              "cheapest dtype per leg within "
-                             "--plan_error_budget). Empty = derive from "
-                             "--reduce_dtype. Quantized legs require "
-                             "--server_shard.")
+                             "--plan_error_budget). A leg value may also "
+                             "pick a dtype PER MESH AXIS as slash-joined "
+                             "'axis:dtype' pairs — axis is a mesh axis "
+                             "name or the placement alias ici/dcn (e.g. "
+                             "table=ici:fp32/dcn:int8 quantizes only the "
+                             "cross-host level; docs/multihost.md). Empty "
+                             "= derive from --reduce_dtype. Quantized "
+                             "legs require --server_shard.")
     parser.add_argument("--plan_error_budget", type=float, default=0.05,
                         help="Relative L2 round-trip error budget per leg "
                              "for --collective_plan auto (a candidate "
@@ -724,6 +740,13 @@ def validate_args(args):
                     "the sharded server plane)")
     assert args.plan_error_budget > 0, (
         "--plan_error_budget must be > 0")
+    assert getattr(args, "shard_devices", 1) >= 1, (
+        "--shard_devices must be >= 1")
+    if getattr(args, "shard_devices", 1) > 1:
+        assert args.server_shard, (
+            "--shard_devices factors the server reduce into the 2D "
+            "(clients x shard) mesh; the shard axis only carries the "
+            "sharded server plane, so it requires --server_shard")
     if args.server_shard:
         assert not args.do_topk_down, (
             "--server_shard is incompatible with --topk_down (stale-"

@@ -236,8 +236,21 @@ class FedModel:
                                        expert_devices=getattr(
                                            args, "expert_devices", 1),
                                        n_experts=getattr(
-                                           args, "n_experts", 0))
+                                           args, "n_experts", 0),
+                                       shard_devices=getattr(
+                                           args, "shard_devices", 1))
         self.mesh = mesh
+        # the server reduce axis: "clients", or the ordered
+        # ("shard", "clients") tuple on a 2D mesh (--shard_devices,
+        # docs/multihost.md) — client slots shard and the server plane
+        # reduces over the whole tuple
+        from commefficient_tpu.parallel.mesh import (
+            axis_product,
+            server_reduce_axes,
+        )
+
+        self._server_axes = (server_reduce_axes(mesh)
+                             if mesh is not None else "clients")
         self.training = True
 
         num_clients = num_clients or args.num_clients or \
@@ -288,7 +301,7 @@ class FedModel:
         # (0 = replicated plane); the residency rule itself lives in
         # server.place_server_state (dense velocity/error slices and the
         # qres/dres carries dim-0-sharded — see the ServerState docstring).
-        self._n_shard = (self.mesh.shape["clients"]
+        self._n_shard = (axis_product(self.mesh, self._server_axes)
                          if self._server_shard and self.mesh is not None
                          else 0)
         # Per-leg collective plan (--collective_plan,
@@ -296,6 +309,15 @@ class FedModel:
         # table / downlink), resolved HERE — before the round step builds —
         # from the explicit spec, the one-time on-chip auto-tune probe
         # ('auto'), or the legacy --reduce_dtype alias.
+        # Per-mesh-axis lowering of the plan legs ({leg: dtype | ((axis,
+        # dtype), ...)}, docs/multihost.md) — resolved by _resolve_plan
+        # when the spec has per-axis entries, None otherwise.
+        self._plan_lowering = None
+        self._axis_sizes = None
+        if self.mesh is not None:
+            _axes = (self._server_axes if isinstance(self._server_axes, tuple)
+                     else (self._server_axes,))
+            self._axis_sizes = {a: int(self.mesh.shape[a]) for a in _axes}
         self.collective_plan, self.plan_report = self._resolve_plan(args)
         # On-device health guards + quarantine (--guards,
         # docs/fault_tolerance.md): the jitted server phase gates each
@@ -359,7 +381,8 @@ class FedModel:
         self.steps = build_round_step(
             compute_loss_train,
             compute_loss_val or compute_loss_train,
-            self.unravel, ravel, cfg, sketch=self.sketch, mesh=mesh)
+            self.unravel, ravel, cfg, sketch=self.sketch, mesh=mesh,
+            axis=self._server_axes)
         # Chunked-resident data plane (rounds.build_round_step): ps_weights
         # lives in the sketch's (T, S, 128) chunk layout across rounds; the
         # flat (d,) view exists only transiently at the pytree boundary
@@ -386,7 +409,8 @@ class FedModel:
         # the sharded slice would not fit the per-device HBM budget the plan
         # places the state in host memory (the reference's host-shared-memory
         # design, fed_aggregator.py:105-129, but measured and opt-in).
-        n_shards = self.mesh.shape["clients"] if self.mesh is not None else 1
+        n_shards = (axis_product(self.mesh, self._server_axes)
+                    if self.mesh is not None else 1)
         alloc_clients = -(-self.num_clients // n_shards) * n_shards
         self.memory_plan = plan_client_state_memory(
             alloc_clients, self.grad_size, wcfg, sketch=self.sketch,
@@ -720,7 +744,8 @@ class FedModel:
 
         return place_server_state(state, self.mesh,
                                   self.server_config.mode,
-                                  bool(self._n_shard))
+                                  bool(self._n_shard),
+                                  axis=self._server_axes)
 
     def _plan_leg_geoms(self):
         """{leg: (elements, quant block)} for the wire legs THIS config
@@ -774,6 +799,21 @@ class FedModel:
                   "telemetry run_start event)")
         else:
             plan = C.parse_collective_plan(spec)
+            if plan.per_axis and self.mesh is not None:
+                # per-mesh-axis entries (uplink=ici:fp32/dcn:int8,
+                # docs/multihost.md) must name axes the RESOLVED mesh
+                # actually has — resolve every leg against it now so a
+                # stale axis name or an alias with no matching placement
+                # fails at startup with the axis list, not mid-run.
+                from commefficient_tpu.parallel.mesh import (
+                    mesh_axis_placement,
+                )
+
+                placement = mesh_axis_placement(self.mesh)
+                self._plan_lowering = {
+                    leg: C.resolve_leg_lowering(getattr(plan, leg),
+                                                self._server_axes, placement)
+                    for leg in C.PLAN_LEGS}
             # an explicitly named leg this mode never exercises (sketch
             # mode has no dense uplink — its transmit IS the table; dense
             # modes have no table exchange) would silently run exact fp32
@@ -783,7 +823,7 @@ class FedModel:
             if "=" in spec:
                 unused = ("uplink" if self.server_config.mode == "sketch"
                           else "table")
-                if getattr(plan, unused) != "float32":
+                if C.leg_quantized(getattr(plan, unused)):
                     import warnings
 
                     warnings.warn(
@@ -1328,7 +1368,9 @@ class FedOptimizer:
             init_server_state(
                 fed_model.server_config, fed_model.sketch,
                 shard_n=fed_model._n_shard,
-                plan=fed_model.collective_plan))
+                plan=fed_model.collective_plan,
+                lowering=fed_model._plan_lowering,
+                axis_sizes=fed_model._axis_sizes))
         self._base_lr_vec = None
         if len(self.param_groups) > 1 or self.param_groups[0][0] is not None:
             vec = np.zeros(fed_model.grad_size, np.float32)

@@ -63,6 +63,22 @@ def _read_npz(path: str) -> Dict[str, np.ndarray]:
             f"--resume auto") from e
 
 
+def _fetch_global(arr) -> np.ndarray:
+    """``np.asarray`` that also works on MULTI-PROCESS global arrays
+    (docs/multihost.md): a jax.Array whose shards live partly on other
+    hosts cannot be read locally, so every process collectively assembles
+    the full value (``process_allgather``) and the save below writes it
+    from process 0 only. Single-process arrays (and plain numpy) take the
+    plain ``np.asarray`` path unchanged — bit-identical to the old save."""
+    if isinstance(arr, jax.Array) and jax.process_count() > 1 \
+            and not arr.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr,
+                                                            tiled=True))
+    return np.asarray(arr)
+
+
 def _content_checksum(arrays: Dict[str, np.ndarray]) -> int:
     """CRC32 over every array's name, dtype and raw bytes, in sorted key
     order — cheap, numpy-only, and stable across the savez round trip.
@@ -169,13 +185,14 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
         # checkpoints store the layout-independent flat (d,) view so a run
         # with the chunked-resident data plane (federated/rounds.py) and a
         # pre-chunking run can restore each other's checkpoints
-        return np.asarray(layout.unchunk(arr) if layout is not None else arr)
+        return _fetch_global(layout.unchunk(arr)
+                             if layout is not None else arr)
 
     arrays = {"ps_weights": canon(fm.ps_weights)}
     for name in ("velocities", "errors", "weights"):
         arr = getattr(fm.client_states, name)
         if arr is not None:
-            arrays["client/" + name] = np.asarray(arr)
+            arrays["client/" + name] = _fetch_global(arr)
     arrays.update({"model_state/" + k: v
                    for k, v in _flatten(fm._model_state).items()})
 
@@ -186,7 +203,7 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
         # replicated runs restore each other's checkpoints — the same
         # contract as `canon` for the chunked ps layout. Sketch tables
         # are identical in both planes and pass through.
-        a = np.asarray(arr)
+        a = _fetch_global(arr)
         if getattr(fm, "_n_shard", 0) and a.ndim == 1 \
                 and a.shape[0] != fm.grad_size:
             a = a[: fm.grad_size]
@@ -194,18 +211,27 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
 
     arrays["server/velocity"] = canon_server(optimizer.server_state.velocity)
     arrays["server/error"] = canon_server(optimizer.server_state.error)
-    if optimizer.server_state.qres is not None:
-        # the quantized transmit collective's per-chip EF carry
-        # (server.ServerState.qres) — shape (n_shard, *transmit_shape), a
-        # shard-count-dependent layout; the restore zero-inits it when the
-        # geometry changed (a safe restart for an error-feedback carry)
-        arrays["server/qres"] = np.asarray(optimizer.server_state.qres)
-    if optimizer.server_state.dres is not None:
-        # the quantized DOWNLINK gather's per-chip EF carry
-        # (server.ServerState.dres, docs/compressed_collectives.md) —
-        # the gathered update-tile layout, shard-count-dependent like
-        # qres; same zero-reinit warn path on a geometry/plan mismatch
-        arrays["server/dres"] = np.asarray(optimizer.server_state.dres)
+
+    def save_carry(name, val):
+        # the quantized collectives' per-chip EF carries
+        # (server.ServerState.qres uplink / dres downlink,
+        # docs/compressed_collectives.md) — shard-count-dependent layouts;
+        # the restore zero-inits them when the geometry changed (a safe
+        # restart for an error-feedback carry). A per-MESH-AXIS plan
+        # (docs/multihost.md) carries a TUPLE of per-level slots — saved
+        # as one key per quantized level ('server/qres.0', ...), matched
+        # back by level index.
+        if val is None:
+            return
+        if isinstance(val, tuple):
+            for j, slot in enumerate(val):
+                if slot is not None:
+                    arrays[f"server/{name}.{j}"] = _fetch_global(slot)
+        else:
+            arrays["server/" + name] = _fetch_global(val)
+
+    save_carry("qres", optimizer.server_state.qres)
+    save_carry("dres", optimizer.server_state.dres)
     arrays["rng"] = np.asarray(jax.random.key_data(fm._rng))
     np_name, np_keys, np_pos, np_has_gauss, np_cached = np.random.get_state()
     arrays["np_rng/keys"] = np_keys
@@ -287,6 +313,11 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     store = getattr(fm, "_row_store", None)
     if store is not None:
+        assert jax.process_count() <= 1, (
+            "the disk-tier client row store (--client_state_memory disk) "
+            "keeps per-process backing files and is not multi-process "
+            "coordinated yet; use the hbm/host tiers under multi-process "
+            "runs")
         # Disk-tier client state (host_state.MemmapRowStore,
         # docs/host_offload.md): the rows live in sparse backing files far
         # beyond what an .npz should hold, so the checkpoint snapshots
@@ -339,10 +370,19 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
         json.dumps(meta).encode(), dtype=np.uint8)
     # atomic: a crash mid-save (the very event --resume exists for) must not
     # leave a truncated file at the expected name. The tmp name keeps the
-    # .npz suffix so np.savez does not append another one.
+    # .npz suffix so np.savez does not append another one. Multi-process
+    # runs coordinate (docs/multihost.md): every process participated in
+    # the collective fetches above (identical payloads), process 0 alone
+    # writes, and everyone barriers AFTER the rename — a cohort restart
+    # signal can never observe a half-written checkpoint on any host.
     tmp = path[:-len(".npz")] + ".tmp.npz"
-    np.savez(tmp, **arrays)
-    os.replace(tmp, path)
+    if jax.process_count() <= 1 or jax.process_index() == 0:
+        np.savez(tmp, **arrays)
+        os.replace(tmp, path)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("commefficient:run_state_saved")
     return path
 
 
@@ -726,14 +766,32 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler,
         plan, e.g. fp32 restoring into a quantized run) or a different
         shard geometry — an error-feedback carry restarts safely from
         zero, so warn, don't fail (pinned in test_fault_tolerance /
-        test_compressed_collectives)."""
+        test_compressed_collectives). Per-axis TUPLE carries
+        (docs/multihost.md) apply the same rule per level against the
+        'server/<name>.<level>' keys; a flat<->per-axis plan change never
+        cross-matches, so each side re-initializes cleanly."""
+        import warnings
+
         if cur is None:
             return None
+        if isinstance(cur, tuple):
+            slots = []
+            for j, slot in enumerate(cur):
+                key = f"server/{name}.{j}"
+                if slot is None:
+                    slots.append(None)
+                elif key in flat and flat[key].shape == tuple(slot.shape):
+                    slots.append(jnp.asarray(flat[key]))
+                else:
+                    warnings.warn(
+                        f"checkpoint has no matching {key} carry; "
+                        f"re-initializing the {what} level-{j} residual "
+                        f"to zero")
+                    slots.append(jnp.zeros_like(slot))
+            return tuple(slots)
         key = "server/" + name
         if key in flat and flat[key].shape == tuple(cur.shape):
             return jnp.asarray(flat[key])
-        import warnings
-
         warnings.warn(f"checkpoint has no matching {key} carry; "
                       f"re-initializing the {what} residual to zero")
         return jnp.zeros_like(cur)

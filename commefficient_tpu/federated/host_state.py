@@ -106,7 +106,11 @@ class RowStreamer:
                  host_compute: bool):
         self.host_compute = host_compute
         if mesh is not None:
-            rows_dev = NamedSharding(mesh, P("clients"),
+            from commefficient_tpu.parallel.mesh import (
+                server_reduce_axes,
+            )
+
+            rows_dev = NamedSharding(mesh, P(server_reduce_axes(mesh)),
                                      memory_kind=_supported_kind(
                                          mesh, "device"))
             ids_kind = _supported_kind(
@@ -133,7 +137,7 @@ class RowStreamer:
             scatter, donate_argnums=(0,),
             out_shardings=state_sharding) if state_sharding is not None \
             else jax.jit(scatter, donate_argnums=(0,))
-        self._rows_host = (NamedSharding(mesh, P("clients"),
+        self._rows_host = (NamedSharding(mesh, P(server_reduce_axes(mesh)),
                                          memory_kind=_supported_kind(
                                              mesh, "pinned_host"))
                            if mesh is not None and host_compute else None)
@@ -655,8 +659,15 @@ class MemmapRowStore:
             os.ftruncate(fd, nbytes)
             self._fd[name] = fd
             self._row_nbytes[name] = int(np.prod(shape)) * 4
-        self._rows_sharding = (NamedSharding(mesh, P("clients"))
-                               if mesh is not None else None)
+        if mesh is not None:
+            from commefficient_tpu.parallel.mesh import server_reduce_axes
+
+            # gathered W-row proxies shard like the round step's client
+            # slots: over BOTH server axes of a 2D mesh
+            self._rows_sharding = NamedSharding(
+                mesh, P(server_reduce_axes(mesh)))
+        else:
+            self._rows_sharding = None
         # rolling I/O stats (telemetry: the offload span reads these)
         self.last_gather_ms: float = 0.0
         self.last_scatter_ms: float = 0.0

@@ -173,7 +173,11 @@ def plan_client_state_memory(
     stale = num_clients * grad_size * _F32 if wcfg.do_topk_down else 0
     total = vel + err + stale
 
-    n_shards = mesh.shape.get("clients", 1) if mesh is not None else 1
+    # rows shard over the FULL server plane — both axes of a 2D
+    # (clients x shard) mesh (docs/multihost.md), just the clients axis
+    # on the 1D one
+    n_shards = (mesh.shape.get("clients", 1) * mesh.shape.get("shard", 1)
+                if mesh is not None else 1)
     per_device = total // max(n_shards, 1)
 
     if hbm_budget_bytes is None:
@@ -209,7 +213,9 @@ def client_state_sharding(mesh: Optional[Mesh],
     sharding, applied by the store itself."""
     if mesh is None or plan.placement == "disk":
         return None
-    spec = P("clients")
+    from commefficient_tpu.parallel.mesh import server_reduce_axes
+
+    spec = P(server_reduce_axes(mesh))
     from commefficient_tpu.utils import is_tpu_backend
 
     if plan.placement == "host" and is_tpu_backend():

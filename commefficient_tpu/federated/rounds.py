@@ -303,8 +303,14 @@ def build_round_step(
     cfg: RoundConfig,
     sketch: Optional[CountSketch] = None,
     mesh: Optional[Mesh] = None,
-    axis: str = "clients",
+    axis="clients",
 ) -> FederatedSteps:
+    """``axis`` is the server reduce axis: one mesh axis name, or — on a
+    2D (clients × shard) mesh — the ORDERED axis tuple
+    ``mesh.server_reduce_axes`` (ICI axis first, the DCN-spanning axis
+    last; docs/multihost.md). Client slots shard and the server plane
+    reduces over the whole tuple; per-mesh-axis collective-plan legs
+    lower hierarchically along it."""
     wcfg, scfg = cfg.worker, cfg.server
 
     # Sharded server data plane (docs/sharded_server.md): legality checks
@@ -315,8 +321,10 @@ def build_round_step(
     # an explicit plan wins; otherwise the legacy --reduce_dtype alias
     # (int8 = every leg int8, float32 = the exact fp32 plan)
     from commefficient_tpu.ops.collectives import (
+        PLAN_LEGS,
         CollectivePlan,
         plan_from_reduce_dtype,
+        resolve_leg_lowering,
     )
 
     plan = cfg.collective_plan
@@ -327,13 +335,31 @@ def build_round_step(
         assert server_shard, \
             "quantized collective legs (--collective_plan / " \
             "--reduce_dtype int8) require --server_shard"
+    axis_names = (axis,) if isinstance(axis, str) else tuple(axis)
     if server_shard:
-        assert mesh is not None and axis in mesh.axis_names, \
-            "--server_shard needs a mesh with the worker axis"
+        assert mesh is not None and all(a in mesh.axis_names
+                                        for a in axis_names), \
+            "--server_shard needs a mesh with the worker axis/axes"
         assert not wcfg.do_topk_down, \
             "--server_shard is incompatible with --topk_down (stale-" \
             "weight reconstruction lives on dense per-client rows)"
-    n_shard = mesh.shape[axis] if server_shard else 1
+    n_shard = 1
+    if server_shard:
+        for _a in axis_names:
+            n_shard *= int(mesh.shape[_a])
+    # per-mesh-axis plan legs resolve against THIS mesh (docs/multihost.md):
+    # ici/dcn aliases bind to the axes' fabric placement, all-equal legs
+    # collapse back to the flat single-dtype collectives (bit-identity),
+    # and an entry naming an axis this mesh lacks fails here — at build
+    # time — with the axis list
+    lowering = None
+    if server_shard and plan.per_axis:
+        from commefficient_tpu.parallel.mesh import mesh_axis_placement
+
+        placement = mesh_axis_placement(mesh)
+        lowering = {leg: resolve_leg_lowering(getattr(plan, leg), axis,
+                                              placement)
+                    for leg in PLAN_LEGS}
 
     # Chunked-resident data plane: ps_weights (and every dense (d,)-shaped
     # value of the server phase — unsketched update, per-coordinate lr) stay
@@ -926,15 +952,34 @@ def build_round_step(
         from commefficient_tpu.federated.server import sharded_server_update
 
         _vec = P(axis)
+        # per-axis carries (docs/multihost.md): a hierarchically lowered
+        # leg's carry is a TUPLE of per-axis slots — uplink slots all
+        # stacked over dim 0 (P(axis)); downlink slot j sharded over axes
+        # 0..j only (replicated over the axes already gathered when its
+        # level runs). None slots (fp32 levels) are empty pytree nodes on
+        # both sides, so the spec trees match the state trees.
+        _qres_spec, _dres_spec = _vec, _vec
+        if lowering is not None:
+            up_low = lowering["table"] if scfg.mode == "sketch" \
+                else lowering["uplink"]
+            if isinstance(up_low, tuple):
+                _qres_spec = tuple(_vec if dt != "float32" else None
+                                   for _, dt in up_low)
+            if isinstance(lowering["downlink"], tuple):
+                _dres_spec = tuple(
+                    P(tuple(axis_names[: j + 1])) if dt != "float32"
+                    else None
+                    for j, (_, dt) in enumerate(lowering["downlink"]))
         _state_spec = ServerState(
             velocity=P() if scfg.mode == "sketch" else _vec,
             error=P() if scfg.mode == "sketch" else _vec,
-            qres=_vec, dres=_vec)
+            qres=_qres_spec, dres=_dres_spec)
 
         def _sharded_inner(g, st, lr_, rng_, count_):
             return sharded_server_update(
                 g[0], st, scfg, lr_, count_, axis=axis, n_shard=n_shard,
-                sketch=sketch, layout=layout, rng=rng_, plan=plan)
+                sketch=sketch, layout=layout, rng=rng_, plan=plan,
+                lowering=lowering)
 
         def _sharded_server(grad_stacked, server_state, lr_, rng_, count_):
             return shard_map(

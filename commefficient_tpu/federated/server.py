@@ -126,7 +126,20 @@ class ServerState(NamedTuple):
       ``(n·⌈T/n⌉, S, 128)`` chunk rows, dense ``(d_pad,)`` — folded into
       the chip's next-round emitted update tile before quantization, so
       the downlink error telescopes exactly as ``qres`` telescopes the
-      uplink."""
+      uplink.
+
+    Per-mesh-axis plans (docs/multihost.md): when a leg lowers
+    hierarchically (``ops.collectives.resolve_leg_lowering`` returned an
+    ``((axis, dtype), ...)`` tuple), the matching carry generalizes to a
+    TUPLE of per-axis slots aligned with the lowering — slot j is axis
+    j's error-feedback residual (None at a float32 level). Uplink slot j
+    is the stacked ``(n, *level_j_input_shape)`` array sharded over dim 0
+    (the level input's dim-0 tile shrinks by each reduced axis's size);
+    downlink slot j keeps the FULL gathered shape globally but lives
+    sharded over axes 0..j only (replicated over the axes already
+    gathered when level j runs — see
+    ``ops.collectives.hierarchical_all_gather``). Flat plans keep the
+    single-array spelling unchanged (checkpoint and shard-spec compat)."""
 
     velocity: jax.Array
     error: jax.Array
@@ -137,33 +150,84 @@ class ServerState(NamedTuple):
 def init_server_state(cfg: ServerConfig, sketch: Optional[CountSketch] = None,
                       shard_n: int = 0,
                       quantized: bool = False,
-                      plan=None) -> ServerState:
+                      plan=None, lowering=None,
+                      axis_sizes=None) -> ServerState:
     """``shard_n`` > 0 selects the sharded-server residency (see
     ServerState). ``plan`` (a ``CollectivePlan``,
     docs/compressed_collectives.md) decides which error-feedback carries
     exist: ``qres`` when the mode's uplink leg (dense transmit / sketch
     table) is quantized, ``dres`` when the downlink all-gather is.
     ``quantized`` is the legacy ``--reduce_dtype int8`` spelling — the
-    all-int8 plan (every leg quantized)."""
+    all-int8 plan (every leg quantized). ``lowering``
+    (``{leg: resolve_leg_lowering(...)}``) selects the per-mesh-axis
+    residency: a leg whose lowering is an ``((axis, dtype), ...)`` tuple
+    gets a TUPLE of per-axis carry slots (see ServerState); plain-dtype
+    lowerings (and ``lowering=None``) keep the single-array carries.
+    ``axis_sizes`` (``{axis_name: size}``, required with a hierarchical
+    lowering) sizes the per-level dense uplink slots — the level input
+    shrinks by each already-reduced axis."""
     from commefficient_tpu.ops.collectives import plan_from_reduce_dtype
 
     if plan is None:
         plan = plan_from_reduce_dtype("int8" if quantized else "float32")
+    if lowering is None:
+        lowering = {"uplink": plan.uplink, "table": plan.table,
+                    "downlink": plan.downlink}
+        assert not any(":" in v for v in lowering.values()), \
+            "per-axis collective plans must pass lowering= (the " \
+            "resolve_leg_lowering dict) — the leg strings alone do not " \
+            "size the per-axis carry slots"
     if cfg.mode == "sketch":
         assert sketch is not None
         shape = sketch.table_shape
     else:
         d = cfg.grad_size
         shape = (-(-d // shard_n) * shard_n,) if shard_n else (d,)
-    uplink_leg = plan.table if cfg.mode == "sketch" else plan.uplink
+    up_low = lowering["table"] if cfg.mode == "sketch" \
+        else lowering["uplink"]
+    down_low = lowering["downlink"]
     qres = None
-    if uplink_leg != "float32":
+    if isinstance(up_low, tuple):
+        assert shard_n > 0, \
+            "quantized collective legs require --server_shard"
+        # per-axis slots: level j's input tile is the transmit divided by
+        # the sizes of the axes already reduced (dense); the table leg's
+        # all-reduce preserves shape at every level
+        assert axis_sizes is not None, \
+            "hierarchical lowering needs axis_sizes={axis: size}"
+        slots = []
+        seen = 1
+        for ax, dt in up_low:
+            if dt == "float32":
+                slots.append(None)
+            elif cfg.mode == "sketch":
+                slots.append(jnp.zeros((shard_n,) + shape, jnp.float32))
+            else:
+                slots.append(jnp.zeros((shard_n, shape[0] // seen),
+                                       jnp.float32))
+            seen *= int(axis_sizes[ax])
+        qres = tuple(slots)
+    elif up_low != "float32":
         assert shard_n > 0, \
             "quantized collective legs require --server_shard"
         qres = jnp.zeros((shard_n,) + shape if cfg.mode == "sketch"
                          else (shard_n, shape[0]), jnp.float32)
     dres = None
-    if plan.downlink != "float32":
+    if isinstance(down_low, tuple):
+        assert shard_n > 0, \
+            "quantized collective legs require --server_shard"
+        # every downlink slot keeps the full gathered shape globally
+        # (shardings differ per slot — place_server_state); the sketch
+        # layout pads T to the shard multiple like the flat carry
+        if cfg.mode == "sketch":
+            Tn = -(-sketch.T // shard_n)
+            full = (Tn * shard_n, sketch.sublanes, 128)
+        else:
+            full = shape
+        dres = tuple(None if dt == "float32"
+                     else jnp.zeros(full, jnp.float32)
+                     for _, dt in down_low)
+    elif down_low != "float32":
         assert shard_n > 0, \
             "quantized collective legs require --server_shard"
         if cfg.mode == "sketch":
@@ -184,7 +248,8 @@ def init_server_state(cfg: ServerConfig, sketch: Optional[CountSketch] = None,
 
 
 def place_server_state(state: ServerState, mesh, mode: str,
-                       server_shard: bool, put=None) -> ServerState:
+                       server_shard: bool, put=None,
+                       axis=None) -> ServerState:
     """THE sharded-server residency rule, in one place (callers: FedModel,
     bench.py, the multichip dry-run): sketch tables replicated (they are
     the already-small transmit), dense velocity/error dim-0-sharded over
@@ -192,8 +257,15 @@ def place_server_state(state: ServerState, mesh, mode: str,
     fresh state to these shardings up front keeps round 1 on the jit
     cache and donation safe (see aggregator._place_replicated). ``put``
     overrides plain ``jax.device_put`` for multi-process global arrays
-    (``__graft_entry__.run_tiny_sketched_round``)."""
+    (``__graft_entry__.run_tiny_sketched_round``). ``axis`` is the server
+    reduce axis (name or ordered tuple, ``mesh.server_reduce_axes``;
+    None = the legacy clients axis): per-axis dres slot j lives sharded
+    over axes 0..j only (replicated over the already-gathered rest —
+    ServerState docstring)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     from commefficient_tpu.parallel.mesh import (
+        CLIENTS_AXIS,
         replicated_sharding,
         server_shard_sharding,
     )
@@ -204,14 +276,35 @@ def place_server_state(state: ServerState, mesh, mode: str,
         def put(x, sharding):
             return jax.device_put(x, sharding)
 
+    if axis is None:
+        axis = CLIENTS_AXIS
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
     rep = replicated_sharding(mesh)
-    sh0 = server_shard_sharding(mesh)
+    sh0 = server_shard_sharding(mesh, axis)
     state_sh = sh0 if (server_shard and mode != "sketch") else rep
+
+    def put_qres(q):
+        if q is None:
+            return None
+        if isinstance(q, tuple):  # per-axis slots: all stacked over dim 0
+            return tuple(None if s is None else put(s, sh0) for s in q)
+        return put(q, sh0)
+
+    def put_dres(d):
+        if d is None:
+            return None
+        if isinstance(d, tuple):
+            return tuple(
+                None if s is None
+                else put(s, NamedSharding(mesh, P(tuple(axes[:j + 1]))))
+                for j, s in enumerate(d))
+        return put(d, sh0)
+
     return state._replace(
         velocity=put(state.velocity, state_sh),
         error=put(state.error, state_sh),
-        qres=None if state.qres is None else put(state.qres, sh0),
-        dres=None if state.dres is None else put(state.dres, sh0))
+        qres=put_qres(state.qres),
+        dres=put_dres(state.dres))
 
 
 def round_health(transmit, new_ps, max_abs: float = 0.0):
@@ -323,6 +416,7 @@ def sharded_server_update(
     rng: Optional[jax.Array] = None,
     reduce_dtype: str = "float32",
     plan=None,
+    lowering=None,
 ) -> Tuple[jax.Array, ServerState, Optional[jax.Array]]:
     """The sharded server data plane's per-shard step — MUST run inside a
     ``shard_map`` over mesh axis ``axis`` (rounds.build_round_step wraps
@@ -362,6 +456,13 @@ def sharded_server_update(
       noise) are computed from the EXACT update — what the quantized
       gather did not deliver this round is exactly what ``dres`` delivers
       later, so the server's own EF accounting stays in update units.
+    - ``lowering`` (``{leg: resolve_leg_lowering(...)}``,
+      docs/multihost.md) selects the per-mesh-axis forms: a leg resolved
+      to an ``((axis, dtype), ...)`` tuple runs the hierarchical
+      collectives level by level over ``axis`` (which is then the ordered
+      reduce-axis TUPLE — ICI first, DCN last) with the matching carry a
+      tuple of per-axis slots. None derives flat single-dtype lowerings
+      from ``plan`` — every pre-existing path bit for bit.
 
     Returns ``(lr-scaled full update, new local state, re-sketched update
     table or None)`` — the table is sketch mode's cell-masking byproduct
@@ -370,6 +471,9 @@ def sharded_server_update(
     """
     from commefficient_tpu.ops.collectives import (
         all_gather_tiled,
+        hierarchical_all_gather,
+        hierarchical_psum,
+        hierarchical_psum_scatter,
         plan_from_reduce_dtype,
         quantized_all_gather,
         quantized_psum,
@@ -379,15 +483,27 @@ def sharded_server_update(
 
     if plan is None:
         plan = plan_from_reduce_dtype(reduce_dtype)
-    uplink_leg = plan.table if cfg.mode == "sketch" else plan.uplink
+    if lowering is None:
+        lowering = {"uplink": plan.uplink, "table": plan.table,
+                    "downlink": plan.downlink}
+        assert not any(":" in v for v in lowering.values()), \
+            "per-axis collective plans must pass lowering= " \
+            "(resolve_leg_lowering per leg)"
+    up_low = lowering["table"] if cfg.mode == "sketch" \
+        else lowering["uplink"]
+    down_low = lowering["downlink"]
+    # a hierarchical lowering always mixes dtypes (all-equal collapses to
+    # the flat path in resolve_leg_lowering), so it is always quantized
+    up_q = isinstance(up_low, tuple) or up_low != "float32"
+    down_q = isinstance(down_low, tuple) or down_low != "float32"
 
-    qres_local = state.qres  # (1, *transmit_shape) local row, or None
-    dres_local = state.dres  # this chip's update-tile residual, or None
-    if uplink_leg != "float32":
+    qres_local = state.qres  # (1, *transmit_shape) local row(s), or None
+    dres_local = state.dres  # this chip's update-tile residual(s), or None
+    if up_q:
         assert qres_local is not None, \
             "quantized uplink/table leg needs the qres carry " \
             "(init_server_state plan=)"
-    if plan.downlink != "float32":
+    if down_q:
         assert dres_local is not None, \
             "quantized downlink leg needs the dres carry " \
             "(init_server_state plan=)"
@@ -395,16 +511,26 @@ def sharded_server_update(
     # raw key is used directly, so a plan that quantizes exactly the legs
     # --reduce_dtype int8 used to reproduces the PR-2 draws
     rng_up = rng_down = rng
-    if uplink_leg != "float32" and plan.downlink != "float32":
+    if up_q and down_q:
         rng_up, rng_down = jax.random.split(rng)
 
     if cfg.mode == "sketch":
         assert sketch is not None and layout is not None
-        if uplink_leg != "float32":
+        if isinstance(up_low, tuple):
+            # per-axis table exchange: level-by-level all-reduce, each
+            # quantized level folding ITS carry slot's local row
+            table, new_slots = hierarchical_psum(
+                transmit_local, up_low, rng_up,
+                residuals=[None if q is None else q[0]
+                           for q in qres_local],
+                block=sketch.c_pad)
+            new_qres = tuple(None if r is None else r[None]
+                             for r in new_slots)
+        elif up_q:
             # block = one table row (c_pad = S·128 lanes) per scale
             table, new_qres = quantized_psum(
                 transmit_local, axis, rng_up, residual=qres_local[0],
-                block=sketch.c_pad, dtype=uplink_leg)
+                block=sketch.c_pad, dtype=up_low)
             new_qres = new_qres[None]
         else:
             table = jax.lax.psum(transmit_local, axis)
@@ -442,13 +568,20 @@ def sharded_server_update(
         if cfg.error_type == "local":
             # torch aliasing parity (see _sketched)
             error = velocity
-        if plan.downlink != "float32":
+        if isinstance(down_low, tuple):
+            # per-axis downlink: gather level by level in reverse reduce
+            # order; slot j's local view IS level j's input tile
+            full, new_dres = hierarchical_all_gather(
+                upd_local, down_low, rng_down, residuals=dres_local,
+                block=sketch.sublanes * 128)
+            update = full[: sketch.T]
+        elif down_q:
             # downlink leg: quantize this shard's update chunks (one scale
             # per (S, 128) resident chunk) before the gather; the
             # remainder telescopes through dres like qres on the uplink
             full, new_dres = quantized_all_gather(
                 upd_local, axis, rng_down, residual=dres_local,
-                block=sketch.sublanes * 128, dtype=plan.downlink)
+                block=sketch.sublanes * 128, dtype=down_low)
             update = full[: sketch.T]
         else:
             update = all_gather_tiled(upd_local, axis)[: sketch.T]
@@ -461,10 +594,15 @@ def sharded_server_update(
     d = cfg.grad_size
     d_pad = -(-d // n_shard) * n_shard
     x = jnp.pad(transmit_local, (0, d_pad - d))
-    if uplink_leg != "float32":
+    if isinstance(up_low, tuple):
+        tile, new_slots = hierarchical_psum_scatter(
+            x, up_low, rng_up,
+            residuals=[None if q is None else q[0] for q in qres_local])
+        new_qres = tuple(None if r is None else r[None] for r in new_slots)
+    elif up_q:
         tile, new_qres = quantized_psum_scatter(x, axis, rng_up,
                                                 residual=qres_local[0],
-                                                dtype=uplink_leg)
+                                                dtype=up_low)
         new_qres = new_qres[None]
     else:
         tile = reduce_scatter_sum(x, axis)
@@ -493,7 +631,7 @@ def sharded_server_update(
             # statistically independent of the quantization dither; the
             # fp32 plan keeps the pre-plan draw bit for bit.
             noise_rng = rng
-            if uplink_leg != "float32" or plan.downlink != "float32":
+            if up_q or down_q:
                 noise_rng = jax.random.fold_in(rng, 2)
             noise = jax.random.normal(noise_rng, (d_pad,), upd_local.dtype)
             per = d_pad // n_shard
@@ -501,10 +639,14 @@ def sharded_server_update(
                 jax.lax.dynamic_slice_in_dim(
                     noise, jax.lax.axis_index(axis) * per, per)
 
-    if plan.downlink != "float32":
+    if isinstance(down_low, tuple):
+        full, new_dres = hierarchical_all_gather(
+            upd_local, down_low, rng_down, residuals=dres_local)
+        update = full[:d]
+    elif down_q:
         full, new_dres = quantized_all_gather(
             upd_local, axis, rng_down, residual=dres_local,
-            dtype=plan.downlink)
+            dtype=down_low)
         update = full[:d]
     else:
         update = all_gather_tiled(upd_local, axis)[:d]

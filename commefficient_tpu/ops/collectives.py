@@ -69,6 +69,25 @@ cost. ``autotune_collective_plan`` closes the loop: a one-time on-chip
 probe times each {leg x dtype} candidate's quantize->dequantize round
 trip against a calibration transmit and picks the cheapest dtype per leg
 within an error budget (``--collective_plan auto``).
+
+Part 3 — per-MESH-AXIS wire dtypes (docs/multihost.md). On a 2D
+(clients × shard) mesh whose leading axis spans hosts over DCN, one
+dtype per leg prices the slow cross-host hop and the fast ICI hop
+identically. A leg may instead carry slash-joined ``axis:dtype`` pairs
+(``uplink=ici:fp32/dcn:int8``; ``ici``/``dcn`` are placement aliases
+resolved against ``parallel.mesh.mesh_axis_placement``, explicit mesh
+axis names also work, unnamed axes stay float32).
+``resolve_leg_lowering`` turns such a leg into an ordered
+``((axis, dtype), ...)`` lowering over the server reduce axes — or
+collapses it back to ONE dtype when every axis resolves equal, so an
+fp32-everywhere per-axis spelling runs the existing flat collectives
+bit-identically. The genuinely mixed case runs the EQuARX-style
+(arXiv:2506.17615) hierarchical collectives below: reduce level by level
+in the tuple order (gather in reverse), each quantized level carrying
+ITS OWN error-feedback residual slot (``ServerState.qres``/``dres``
+generalize to per-axis tuples), each level's SR stream decorrelated by
+folding the level index into the rng. Conservation holds per axis: each
+level's folded tile + new carry ≡ its exact tile + old carry.
 """
 
 from __future__ import annotations
@@ -91,6 +110,13 @@ __all__ = [
     "quantized_psum_scatter",
     "quantized_psum",
     "quantized_all_gather",
+    "hierarchical_psum_scatter",
+    "hierarchical_psum",
+    "hierarchical_all_gather",
+    "leg_axis_entries",
+    "leg_quantized",
+    "resolve_leg_lowering",
+    "PLACEMENT_ALIASES",
     "payload_bytes",
     "int8_payload_bytes",
     "CollectivePlan",
@@ -461,6 +487,100 @@ def quantized_all_gather(x, axis_name, rng, residual=None,
 
 
 # --------------------------------------------------------------------------
+# per-mesh-axis hierarchical collectives (docs/multihost.md)
+# --------------------------------------------------------------------------
+
+def hierarchical_psum_scatter(x, axis_dtypes, rng, residuals=None,
+                              block=DEFAULT_QUANT_BLOCK):
+    """Level-by-level reduce-scatter over an ORDERED ``((axis, dtype), ...)``
+    lowering (``resolve_leg_lowering``; ICI axes first, the DCN axis
+    last), each level at its own wire dtype with its own error-feedback
+    residual. Must run inside ``shard_map``; ``x.shape[0]`` must divide
+    by the product of the axis sizes. Reducing level by level in the
+    tuple order tiles IDENTICALLY to one flat tuple collective over the
+    same ordering (both linearize first-name-major), which is what lets
+    the fp32-everywhere plan skip this path entirely.
+
+    ``residuals`` is a sequence of per-level carries aligned with
+    ``axis_dtypes`` (None for a float32 level — exact levels carry
+    nothing; None also ⇒ zeros on first use); each level-j residual has
+    the shape of that level's INPUT (the dim-0 tile shrinks by the axis
+    size per level). Each quantized level folds its level index into
+    ``rng`` so the SR streams decorrelate across levels, then its shard
+    index inside ``quantized_psum_scatter``. Returns
+    ``(local_sum_tile, new_residuals)`` with ``new_residuals`` a tuple
+    aligned with ``axis_dtypes`` (None at float32 levels). Conservation
+    per axis (pinned in tests/test_multihost.py): each level's folded
+    tile + new carry ≡ its exact tile + old carry."""
+    new_residuals = []
+    t = x
+    for lvl, (ax, dt) in enumerate(axis_dtypes):
+        if dt == "float32":
+            t = reduce_scatter_sum(t, ax)
+            new_residuals.append(None)
+        else:
+            res = residuals[lvl] if residuals is not None else None
+            t, nr = quantized_psum_scatter(
+                t, ax, jax.random.fold_in(rng, lvl), residual=res,
+                block=block, dtype=dt)
+            new_residuals.append(nr)
+    return t, tuple(new_residuals)
+
+
+def hierarchical_psum(x, axis_dtypes, rng, residuals=None,
+                      block=DEFAULT_QUANT_BLOCK):
+    """Level-by-level all-reduce over an ordered ``((axis, dtype), ...)``
+    lowering — the sketch-table leg's hierarchical form. Each level runs
+    the exact ``psum`` (float32) or ``quantized_psum`` (its own EF
+    residual, ``x``-shaped at EVERY level since an all-reduce preserves
+    shape). Returns ``(sum, new_residuals)`` aligned with
+    ``axis_dtypes``."""
+    new_residuals = []
+    t = x
+    for lvl, (ax, dt) in enumerate(axis_dtypes):
+        if dt == "float32":
+            t = jax.lax.psum(t, ax)
+            new_residuals.append(None)
+        else:
+            res = residuals[lvl] if residuals is not None else None
+            t, nr = quantized_psum(
+                t, ax, jax.random.fold_in(rng, lvl), residual=res,
+                block=block, dtype=dt)
+            new_residuals.append(nr)
+    return t, tuple(new_residuals)
+
+
+def hierarchical_all_gather(x, axis_dtypes, rng, residuals=None,
+                            block=DEFAULT_QUANT_BLOCK):
+    """Level-by-level all-gather over an ordered ``((axis, dtype), ...)``
+    lowering — the downlink's hierarchical form, run in REVERSE tuple
+    order (the minor/last-reduced axis gathers first), which reassembles
+    exactly the tiling ``hierarchical_psum_scatter`` produced.
+
+    ``residuals``/``new_residuals`` stay aligned with ``axis_dtypes``
+    (slot j carries axis j's gather residual even though level j runs at
+    reverse position). Slot j has the shape of level j's gather INPUT —
+    the full array divided by the sizes of axes 0..j — and is identical
+    across the already-gathered later axes (the level's rng folds only
+    axis j's own index, so sibling chips quantize identical data with
+    identical draws), i.e. globally it lives sharded over axes 0..j and
+    replicated over the rest. Returns ``(gathered, new_residuals)``."""
+    new_residuals = [None] * len(axis_dtypes)
+    t = x
+    for lvl in reversed(range(len(axis_dtypes))):
+        ax, dt = axis_dtypes[lvl]
+        if dt == "float32":
+            t = all_gather_tiled(t, ax)
+        else:
+            res = residuals[lvl] if residuals is not None else None
+            t, nr = quantized_all_gather(
+                t, ax, jax.random.fold_in(rng, lvl), residual=res,
+                block=block, dtype=dt)
+            new_residuals[lvl] = nr
+    return t, tuple(new_residuals)
+
+
+# --------------------------------------------------------------------------
 # per-leg collective plan (--collective_plan, docs/compressed_collectives.md)
 # --------------------------------------------------------------------------
 
@@ -472,6 +592,113 @@ def quantized_all_gather(x, axis_name, rng, residual=None,
 #   downlink — the update all-gather (both mode families).
 PLAN_LEGS = ("uplink", "table", "downlink")
 
+# placement aliases a per-axis plan entry may use instead of a mesh axis
+# name; resolved against parallel.mesh.mesh_axis_placement at round build
+PLACEMENT_ALIASES = ("ici", "dcn")
+
+
+def leg_axis_entries(value: str):
+    """Parse one leg value's per-axis form: ``axis:dtype`` pairs joined
+    by ``/`` (``ici:fp32/dcn:int8``) -> ordered ``[(token, dtype), ...]``
+    with dtypes normalized to ``WIRE_DTYPES`` spelling. Returns None for
+    a plain single-dtype leg. Raises ValueError on a malformed pair, an
+    unknown dtype, or a token named twice — grammar-level checks only
+    (token-vs-mesh validation needs the resolved mesh:
+    ``resolve_leg_lowering``)."""
+    if ":" not in value:
+        return None
+    entries = []
+    seen = set()
+    for part in value.split("/"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(
+                f"collective plan per-axis entry {part!r}: expected "
+                f"axis:dtype (e.g. dcn:int8)")
+        tok, dt = part.split(":", 1)
+        tok = tok.strip()
+        dt = {"fp32": "float32", "fp8": "fp8_e4m3"}.get(dt.strip(),
+                                                        dt.strip())
+        if not tok:
+            raise ValueError(
+                f"collective plan per-axis entry {part!r}: empty axis name")
+        if dt not in WIRE_DTYPES:
+            raise ValueError(
+                f"collective plan per-axis dtype {dt!r}: choose from "
+                f"{WIRE_DTYPES}")
+        if tok in seen:
+            raise ValueError(
+                f"collective plan names axis {tok!r} twice in one leg")
+        seen.add(tok)
+        entries.append((tok, dt))
+    if not entries:
+        raise ValueError(f"collective plan leg {value!r}: no axis:dtype "
+                         f"entries")
+    return entries
+
+
+def leg_quantized(value: str) -> bool:
+    """True iff the leg moves any non-fp32 bytes (per-axis legs: any
+    entry quantized)."""
+    entries = leg_axis_entries(value)
+    if entries is None:
+        return value != "float32"
+    return any(dt != "float32" for _, dt in entries)
+
+
+def resolve_leg_lowering(value: str, axis_order, placement: dict):
+    """Resolve one leg value against the mesh: plain dtype -> itself;
+    per-axis form -> an ordered ``((axis, dtype), ...)`` lowering over
+    ``axis_order`` (the server reduce axes, a name or ordered tuple),
+    for ``hierarchical_psum_scatter``/``_psum``/``_all_gather``.
+
+    Entry tokens may be mesh axis names from ``axis_order`` or the
+    placement aliases ``ici``/``dcn`` (an alias covers EVERY reduce axis
+    with that placement in ``placement``, per
+    ``parallel.mesh.mesh_axis_placement``). Axes no entry covers stay
+    float32. A token matching neither — a mesh axis this mesh doesn't
+    have, an alias no axis resolves to — raises ValueError naming the
+    available axes and their placements, at startup rather than at first
+    collective. When every resolved axis lands on the SAME dtype the leg
+    collapses back to that plain dtype: the flat tuple collective over
+    the same ordering is bit-identical (and cheaper — one hop), and it
+    keeps fp32-everywhere per-axis spellings on the exact legacy path."""
+    entries = leg_axis_entries(value)
+    if entries is None:
+        return value
+    axes = (axis_order,) if isinstance(axis_order, str) else tuple(axis_order)
+    resolved = {}
+    for tok, dt in entries:
+        if tok in axes:
+            targets = [tok]
+        elif tok in PLACEMENT_ALIASES:
+            targets = [a for a in axes if placement.get(a) == tok]
+            if not targets:
+                raise ValueError(
+                    f"collective plan entry {tok}:{dt} resolves to no mesh "
+                    f"axis: no server reduce axis has {tok!r} placement "
+                    f"(axes: " + ", ".join(
+                        f"{a}={placement.get(a, '?')}" for a in axes) + ")")
+        else:
+            raise ValueError(
+                f"collective plan entry names mesh axis {tok!r} which the "
+                f"resolved mesh does not have (server reduce axes: "
+                + ", ".join(f"{a}={placement.get(a, '?')}" for a in axes)
+                + f"; placement aliases: {'/'.join(PLACEMENT_ALIASES)})")
+        for a in targets:
+            if a in resolved:
+                raise ValueError(
+                    f"collective plan covers mesh axis {a!r} twice "
+                    f"(entry {tok}:{dt} overlaps an earlier entry)")
+            resolved[a] = dt
+    lowering = tuple((a, resolved.get(a, "float32")) for a in axes)
+    dtypes = {dt for _, dt in lowering}
+    if len(dtypes) == 1:
+        return next(iter(dtypes))
+    return lowering
+
 
 @dataclass(frozen=True)
 class CollectivePlan:
@@ -480,7 +707,10 @@ class CollectivePlan:
     collectives (bit-identical to the pre-plan code paths); quantized legs
     run the block-scaled stochastic-rounding EF collectives above with
     their residual carried in ``ServerState.qres`` (uplink/table) or
-    ``ServerState.dres`` (downlink)."""
+    ``ServerState.dres`` (downlink). A leg may also hold a per-mesh-axis
+    value (``ici:fp32/dcn:int8`` — ``leg_axis_entries`` grammar); such
+    legs lower hierarchically per ``resolve_leg_lowering`` with per-axis
+    residual slots."""
 
     uplink: str = "float32"
     table: str = "float32"
@@ -489,12 +719,21 @@ class CollectivePlan:
     def __post_init__(self):
         for leg in PLAN_LEGS:
             dt = getattr(self, leg)
+            if ":" in dt:
+                leg_axis_entries(dt)  # grammar check; raises ValueError
+                continue
             assert dt in WIRE_DTYPES, \
-                f"collective plan leg {leg}={dt!r}: choose from {WIRE_DTYPES}"
+                f"collective plan leg {leg}={dt!r}: choose from " \
+                f"{WIRE_DTYPES} or per-axis axis:dtype pairs"
 
     @property
     def quantized(self) -> bool:
-        return any(getattr(self, leg) != "float32" for leg in PLAN_LEGS)
+        return any(leg_quantized(getattr(self, leg)) for leg in PLAN_LEGS)
+
+    @property
+    def per_axis(self) -> bool:
+        """True iff any leg carries a per-mesh-axis value."""
+        return any(":" in getattr(self, leg) for leg in PLAN_LEGS)
 
     def spec(self) -> str:
         return ",".join(f"{leg}={getattr(self, leg)}" for leg in PLAN_LEGS)
@@ -512,6 +751,13 @@ def parse_collective_plan(spec: str) -> CollectivePlan:
       (``uplink=int8,downlink=fp8_e4m3,table=fp32``) — unnamed legs stay
       float32. ``fp32`` is accepted as a spelling of ``float32``.
 
+    A leg's dtype may also be PER MESH AXIS: slash-joined ``axis:dtype``
+    pairs (``uplink=ici:fp32/dcn:int8``; bare ``ici:fp32/dcn:int8``
+    applies to every leg), where ``axis`` is a mesh axis name or the
+    ``ici``/``dcn`` placement alias — see ``resolve_leg_lowering``
+    (grammar checked here; axis-vs-mesh validation happens when the mesh
+    is known).
+
     ``auto`` is NOT handled here — callers resolve it through
     ``autotune_collective_plan`` first."""
     if not spec:
@@ -523,6 +769,9 @@ def parse_collective_plan(spec: str) -> CollectivePlan:
 
     def norm(dt):
         dt = dt.strip()
+        if ":" in dt:
+            # per-axis form: normalize each pair's dtype, keep the tokens
+            return "/".join(f"{tok}:{d}" for tok, d in leg_axis_entries(dt))
         dt = {"fp32": "float32", "fp8": "fp8_e4m3"}.get(dt, dt)
         assert dt in WIRE_DTYPES, \
             f"collective plan dtype {dt!r}: choose from {WIRE_DTYPES}"

@@ -20,11 +20,21 @@ Axes used by the framework:
 Multi-host: with more than one JAX process, ``make_mesh`` builds a hybrid
 mesh via ``mesh_utils.create_hybrid_device_mesh`` so that the *last* mesh
 axes ride ICI within a slice and the leading axis spans DCN across hosts —
-keeping the hot psum/ppermute traffic on ICI.
+keeping the hot psum/ppermute traffic on ICI. ``--shard_devices`` adds a
+second server axis (``shard``) right after ``clients``: the server data
+plane then reduces over the ORDERED tuple ``(shard, clients)`` — ICI axis
+first, the DCN-spanning axis last — which tiles identically whether the
+reduction runs as one flat tuple collective or level by level
+(docs/multihost.md), so the per-mesh-axis collective plan can pick a wire
+dtype per hop. ``mesh_axis_placement`` reports which axis rides which
+fabric; ``maybe_init_distributed`` joins a cohort from the
+``COMMEFFICIENT_PROC_ID``/``NUM_PROCS``/``COORDINATOR`` environment seam
+(scripts/supervise.py ``--procs N``).
 """
 
 from __future__ import annotations
 
+import os
 import warnings
 from typing import Optional, Sequence, Tuple
 
@@ -40,7 +50,12 @@ __all__ = [
     "client_sharding",
     "replicated_sharding",
     "server_shard_sharding",
+    "server_reduce_axes",
+    "axis_product",
+    "mesh_axis_placement",
+    "maybe_init_distributed",
     "CLIENTS_AXIS",
+    "SHARD_AXIS",
     "SEQ_AXIS",
     "MODEL_AXIS",
     "STAGE_AXIS",
@@ -48,6 +63,7 @@ __all__ = [
 ]
 
 CLIENTS_AXIS = "clients"
+SHARD_AXIS = "shard"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 STAGE_AXIS = "stage"
@@ -59,7 +75,8 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
                         model_devices: int = 1,
                         pipeline_devices: int = 1,
                         expert_devices: int = 1,
-                        n_experts: int = 0) -> Mesh:
+                        n_experts: int = 0,
+                        shard_devices: int = 1) -> Mesh:
     """The entrypoints' mesh policy (replaces the reference's device counting,
     fed_aggregator.py:131-134): a 1-D ``clients`` mesh over
     ``min(--num_devices, available)`` devices, reduced to the largest divisor
@@ -72,8 +89,14 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
     ``pipeline_devices > 1`` appends a ``stage`` axis (pipeline
     parallelism, ``--pipeline_devices``); ``expert_devices > 1`` appends
     an ``expert`` axis (expert parallelism for MoE models,
-    ``--expert_devices``). The ``clients`` axis shrinks to fit
-    ``available // (seq·model·stage·expert)`` devices.
+    ``--expert_devices``). ``shard_devices > 1`` inserts a ``shard`` axis
+    directly after ``clients`` — the second server axis of the 2D
+    (clients × shard) data plane (``--shard_devices``,
+    docs/multihost.md): client slots shard over BOTH axes, the server
+    reduce runs over the ordered tuple ``(shard, clients)``, and on a
+    multi-process mesh ``clients`` (the leading axis) spans DCN while
+    ``shard`` rides ICI. The ``clients`` axis shrinks to fit
+    ``available // (shard·seq·model·stage·expert)`` devices.
     ``model`` is the *minor-most* (fastest-varying) axis — its two
     activation psums per transformer block are the highest-rate collective
     traffic, so they ride neighboring ICI links; ``seq`` comes next for
@@ -121,19 +144,32 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
                       f"{npp} stage x {ne} expert device(s) claimed first — "
                       f"axis priority model > stage > expert > seq)",
                       stacklevel=2)
+    # server shard axis: claimed after the model-parallel axes, before
+    # clients. Client slots shard over (clients × shard), so the shard
+    # size must divide num_workers like the clients size does.
+    nsh = max(1, min(shard_devices, n_avail // (ns * nm * npp * ne)))
+    while num_workers % nsh:
+        nsh -= 1
+    if shard_devices > nsh:
+        warnings.warn(f"--shard_devices {shard_devices} reduced to {nsh} "
+                      f"(must divide num_workers={num_workers}; "
+                      f"{n_avail} devices available, {ns * nm * npp * ne} "
+                      f"claimed by seq/model/stage/expert)", stacklevel=2)
     requested = num_devices if num_devices and num_devices > 0 \
         else n_avail
-    n = max(1, min(requested, n_avail // (ns * nm * npp * ne)))
-    while num_workers % n:
+    n = max(1, min(requested, n_avail // (nsh * ns * nm * npp * ne)))
+    while num_workers % (n * nsh):
         n -= 1
-    if 0 < num_devices != n and num_devices != n * ns * nm * npp * ne:
+    if 0 < num_devices != n and num_devices != n * nsh * ns * nm * npp * ne:
         warnings.warn(
             f"--num_devices {num_devices} reduced to {n} on the clients axis "
-            f"(must divide num_workers={num_workers}; {ns} seq x {nm} model "
-            f"x {npp} stage x {ne} expert device(s) per client shard; "
-            f"{n_avail} available devices)",
+            f"(must divide num_workers={num_workers}; {nsh} shard x {ns} seq "
+            f"x {nm} model x {npp} stage x {ne} expert device(s) per client "
+            f"shard; {n_avail} available devices)",
             stacklevel=2)
     axes = [(CLIENTS_AXIS, n)]
+    if nsh > 1:
+        axes.append((SHARD_AXIS, nsh))
     if ns > 1:
         axes.append((SEQ_AXIS, ns))
     if nm > 1:
@@ -142,7 +178,7 @@ def default_client_mesh(num_workers: int, num_devices: int = -1,
         axes.append((STAGE_AXIS, npp))
     if ne > 1:
         axes.append((EXPERT_AXIS, ne))
-    return make_mesh(axes, devices=devices[:n * ns * nm * npp * ne])
+    return make_mesh(axes, devices=devices[:n * nsh * ns * nm * npp * ne])
 
 
 def make_mesh(axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,
@@ -222,10 +258,75 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def server_shard_sharding(mesh: Mesh, axis: str = CLIENTS_AXIS) -> NamedSharding:
-    """Dim-0 sharding over the worker axis for the sharded server plane's
-    resident state (--server_shard, docs/sharded_server.md): dense-mode
-    server velocity/error slices and the int8 qres carry live sharded at
-    rest, so each chip stores 1/n of the d-sized state the replicated
-    plane duplicated per chip."""
+def server_shard_sharding(mesh: Mesh, axis=CLIENTS_AXIS) -> NamedSharding:
+    """Dim-0 sharding over the worker axis (or ordered axis tuple on a 2D
+    clients × shard mesh) for the sharded server plane's resident state
+    (--server_shard, docs/sharded_server.md): dense-mode server
+    velocity/error slices and the int8 qres carry live sharded at rest,
+    so each chip stores 1/n of the d-sized state the replicated plane
+    duplicated per chip."""
     return NamedSharding(mesh, P(axis))
+
+
+def server_reduce_axes(mesh: Mesh):
+    """The axis (or ordered axis TUPLE) the server data plane reduces
+    over. On a 1-D mesh this is just ``clients``; when the mesh carries a
+    ``shard`` axis the reduce runs over ``(shard, clients)`` — ICI axis
+    first, the (potentially DCN-spanning) leading axis last — the one
+    ordering used for every P spec and collective of the plane, so the
+    flat tuple collectives and the per-axis hierarchical lowering tile
+    identically (docs/multihost.md)."""
+    if SHARD_AXIS in mesh.axis_names:
+        return (SHARD_AXIS, CLIENTS_AXIS)
+    return CLIENTS_AXIS
+
+
+def axis_product(mesh: Mesh, axis) -> int:
+    """Total device count across ``axis`` (a name or tuple of names)."""
+    if isinstance(axis, str):
+        return int(mesh.shape[axis])
+    return int(np.prod([mesh.shape[a] for a in axis]))
+
+
+def mesh_axis_placement(mesh: Mesh) -> dict:
+    """Which fabric each mesh axis rides: ``{axis_name: "dcn" | "ici"}``.
+
+    Under multi-process JAX the LEADING axis spans hosts over DCN (the
+    ``make_mesh`` multihost contract above); every other axis rides ICI.
+    Single-process meshes are all-ICI. ``COMMEFFICIENT_FORCE_DCN_AXIS=
+    <name>`` overrides the named axis to "dcn" — the seam the forced
+    single-process CPU harness and tests use to exercise the per-axis
+    plan's DCN legs (and the ledger's DCN byte split) without a pod."""
+    placement = {name: "ici" for name in mesh.axis_names}
+    if jax.process_count() > 1 and mesh.axis_names:
+        placement[mesh.axis_names[0]] = "dcn"
+    forced = os.environ.get("COMMEFFICIENT_FORCE_DCN_AXIS", "")
+    if forced and forced in placement:
+        placement[forced] = "dcn"
+    return placement
+
+
+def maybe_init_distributed() -> bool:
+    """Join a multi-process cohort if the supervisor seam says so.
+
+    ``scripts/supervise.py --procs N`` launches each cohort member with
+    ``COMMEFFICIENT_PROC_ID`` / ``COMMEFFICIENT_NUM_PROCS`` /
+    ``COMMEFFICIENT_COORDINATOR`` in the environment; entrypoints call
+    this before touching ``jax.devices()`` so the process joins the
+    coordinator and the mesh builders see the global device set. Returns
+    True iff ``jax.distributed.initialize`` ran (absent/size-1 seams are
+    a no-op, as is an already-initialized distributed runtime)."""
+    n = int(os.environ.get("COMMEFFICIENT_NUM_PROCS", "0") or 0)
+    if n <= 1:
+        return False
+    coord = os.environ.get("COMMEFFICIENT_COORDINATOR", "")
+    pid = int(os.environ.get("COMMEFFICIENT_PROC_ID", "0") or 0)
+    if not coord:
+        raise ValueError(
+            "COMMEFFICIENT_NUM_PROCS is set but COMMEFFICIENT_COORDINATOR "
+            "is not (expected host:port of process 0's coordinator)")
+    if jax.process_count() > 1:
+        return False  # already initialized (e.g. by the launcher)
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=n, process_id=pid)
+    return True

@@ -211,6 +211,20 @@ def device_round_metrics(transmit, update, new_ps, state, guard_ok=None,
     def l2(x):
         return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
 
+    def l2_carry(x):
+        # EF carries may be per-mesh-axis TUPLES of level slots
+        # (docs/multihost.md); one combined norm keeps the metric schema
+        # fixed — and reduces to the old scalar on flat carries
+        if x is None:
+            return jnp.float32(0.0)
+        if isinstance(x, tuple):
+            sq = jnp.float32(0.0)
+            for s in x:
+                if s is not None:
+                    sq = sq + jnp.sum(jnp.square(s.astype(jnp.float32)))
+            return jnp.sqrt(sq)
+        return l2(x)
+
     abs_u = jnp.abs(update.astype(jnp.float32))
     nz = abs_u != 0
     thr = jnp.min(jnp.where(nz, abs_u, jnp.inf))
@@ -223,12 +237,12 @@ def device_round_metrics(transmit, update, new_ps, state, guard_ok=None,
         thr,
         l2(state.velocity),
         l2(state.error),
-        l2(state.qres) if state.qres is not None else jnp.float32(0.0),
+        l2_carry(state.qres),
         l2(new_ps),
         jnp.max(jnp.abs(new_ps.astype(jnp.float32))),
         (guard_ok.astype(jnp.float32) if guard_ok is not None
          else jnp.float32(1.0)),
-        l2(state.dres) if state.dres is not None else jnp.float32(0.0),
+        l2_carry(state.dres),
     )
     out = jnp.stack([jnp.asarray(v, jnp.float32).reshape(()) for v in vals])
     if hists:
@@ -241,7 +255,9 @@ def device_round_metrics(transmit, update, new_ps, state, guard_ok=None,
 def collective_ledger(mode: str, grad_size: int, *,
                       sketch=None, n_shard: int = 0,
                       reduce_dtype: str = "float32",
-                      k: int = 0, plan=None) -> Dict[str, Dict[str, Any]]:
+                      k: int = 0, plan=None,
+                      lowering=None, axis_sizes=None,
+                      axis_placement=None) -> Dict[str, Dict[str, Any]]:
     """Static per-round wire-byte ledger, one entry per collective leg.
 
     Bytes are LOGICAL payload per chip per round, priced by THE one
@@ -262,6 +278,16 @@ def collective_ledger(mode: str, grad_size: int, *,
     runtime (table: one scale per (c_pad,) row; downlink sketch: one per
     (S, 128) chunk; dense: DEFAULT_QUANT_BLOCK). ``reduce_dtype`` is the
     legacy alias used when ``plan`` is None.
+
+    ``lowering`` (``{leg: resolve_leg_lowering(...)}``, docs/multihost.md)
+    splits a per-MESH-AXIS leg's bytes per level: the entry gains a
+    ``bytes_per_axis`` map ({axis: {dtype, elements, bytes_per_round,
+    placement}}) priced by the same ``payload_bytes`` formula at each
+    level's real input size — the hierarchical scatter/gather levels
+    shrink/grow by each already-reduced axis (``axis_sizes``), the table
+    all-reduce keeps the full table at every level. ``axis_placement``
+    (``mesh_axis_placement(mesh)``) labels each axis ici/dcn so
+    obs_report can render the cross-host vs intra-host wire split.
     """
     from commefficient_tpu.ops.collectives import (
         DEFAULT_QUANT_BLOCK,
@@ -282,13 +308,50 @@ def collective_ledger(mode: str, grad_size: int, *,
                         "bytes_per_round": int(payload_bytes(int(elems),
                                                              dtype, block))}
 
+    def leg_low(name):
+        # the leg's per-axis lowering tuple, or None for flat legs
+        key = {"transmit_reduce": "table" if mode == "sketch" else "uplink",
+               "update_all_gather": "downlink"}[name]
+        low = (lowering or {}).get(key)
+        return low if isinstance(low, tuple) else None
+
+    def per_axis_leg(name, collective, elems, low,
+                     block=DEFAULT_QUANT_BLOCK, shrink=False):
+        # one hierarchical collective = one wire level per mesh axis, in
+        # reduce order; ``shrink`` models the scatter/gather level sizes
+        # (level j moves the tile already divided by the earlier axes),
+        # the table all-reduce moves the full table at every level
+        per_axis = {}
+        total, seen = 0, 1
+        for ax, dt in low:
+            lvl = int(elems) // seen if shrink else int(elems)
+            b = int(payload_bytes(lvl, dt, block))
+            per_axis[ax] = {
+                "dtype": dt, "elements": lvl, "bytes_per_round": b,
+                "placement": (axis_placement or {}).get(ax, "ici")}
+            total += b
+            if shrink:
+                assert axis_sizes is not None, \
+                    "per-axis ledger needs axis_sizes={axis: size}"
+                seen *= int(axis_sizes[ax])
+        ledger[name] = {
+            "collective": f"{collective} (per-axis)",
+            "elements": int(elems),
+            "dtype": "/".join(f"{ax}:{dt}" for ax, dt in low),
+            "bytes_per_round": total,
+            "bytes_per_axis": per_axis}
+
     # per-client uplink: what one participating client logically transmits
     # (mirrors aggregator._account_bytes_deferred's upload accounting)
     if mode == "sketch":
         table_elems = sketch.r * sketch.c_pad if sketch is not None else 0
         c_pad = sketch.c_pad if sketch is not None else None
         leg("client_uplink", "transmit", table_elems, "float32")
-        if plan.table != "float32":
+        if leg_low("transmit_reduce") is not None:
+            per_axis_leg("transmit_reduce", "hierarchical_psum",
+                         table_elems, leg_low("transmit_reduce"),
+                         block=c_pad)
+        elif plan.table != "float32":
             leg("transmit_reduce", "quantized_psum", table_elems,
                 plan.table, block=c_pad)
         else:
@@ -297,7 +360,10 @@ def collective_ledger(mode: str, grad_size: int, *,
         per_client = k if mode == "local_topk" else d
         leg("client_uplink", "transmit", per_client, "float32")
         d_pad = -(-d // n_shard) * n_shard if n_shard else d
-        if n_shard and plan.uplink != "float32":
+        if n_shard and leg_low("transmit_reduce") is not None:
+            per_axis_leg("transmit_reduce", "hierarchical_psum_scatter",
+                         d_pad, leg_low("transmit_reduce"), shrink=True)
+        elif n_shard and plan.uplink != "float32":
             leg("transmit_reduce", "quantized_psum_scatter", d_pad,
                 plan.uplink)
         elif n_shard:
@@ -319,7 +385,11 @@ def collective_ledger(mode: str, grad_size: int, *,
         else:
             up_elems = -(-d // n_shard) * n_shard
             down_block = DEFAULT_QUANT_BLOCK
-        if plan.downlink != "float32":
+        if leg_low("update_all_gather") is not None:
+            per_axis_leg("update_all_gather", "hierarchical_all_gather",
+                         up_elems, leg_low("update_all_gather"),
+                         block=down_block, shrink=True)
+        elif plan.downlink != "float32":
             leg("update_all_gather", "quantized_all_gather", up_elems,
                 plan.downlink, block=down_block)
         else:
@@ -828,11 +898,20 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
     # real per-leg wire bytes and an 'auto' run's chosen plan is auditable
     # from the log alone (docs/compressed_collectives.md)
     plan = getattr(fed_model, "collective_plan", None)
+    mesh = getattr(fed_model, "mesh", None)
+    placement = None
+    if mesh is not None:
+        from commefficient_tpu.parallel.mesh import mesh_axis_placement
+
+        placement = mesh_axis_placement(mesh)
     ledger = collective_ledger(
         args.mode, fed_model.grad_size, sketch=fed_model.sketch,
         n_shard=fed_model._n_shard,
         reduce_dtype=getattr(args, "reduce_dtype", "float32") or "float32",
-        k=args.k, plan=plan)
+        k=args.k, plan=plan,
+        lowering=getattr(fed_model, "_plan_lowering", None),
+        axis_sizes=getattr(fed_model, "_axis_sizes", None),
+        axis_placement=placement)
     run_info = {
         "entrypoint": entrypoint,
         "mode": args.mode,
@@ -846,6 +925,15 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
         "backend": jax.default_backend(),
         "ledger": ledger,
     }
+    if mesh is not None:
+        # mesh topology (docs/multihost.md): which axes exist, their
+        # sizes, and their ici/dcn placement — with process_count, the
+        # run log alone says whether a leg's bytes crossed hosts
+        run_info["mesh"] = {
+            "process_count": int(jax.process_count()),
+            "axes": [{"name": n, "size": int(mesh.shape[n]),
+                      "placement": placement[n]}
+                     for n in mesh.axis_names]}
     # Participation-layer config (--participation / --inject_client_fault,
     # federated/participation.py): recorded in the run header so a logged
     # run is reproducible from the log alone — the fault schedule is

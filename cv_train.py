@@ -379,6 +379,11 @@ def build_param_groups(args, params):
 
 
 def main(argv=None):
+    from commefficient_tpu.parallel.mesh import maybe_init_distributed
+
+    # join a multi-process cohort (supervise.py --procs N env seam) BEFORE
+    # the first jax.devices() call, so the mesh sees the global device set
+    maybe_init_distributed()
     args = parse_args(argv=argv)
     assert args.model_devices == 1, (
         "--model_devices (tensor parallelism) is GPT-2 only; the CV models "

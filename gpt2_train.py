@@ -267,6 +267,11 @@ def train_gpt2(model, opt, scheduler, train_loader, val_loader, args,
 
 
 def train(argv=None):
+    from commefficient_tpu.parallel.mesh import maybe_init_distributed
+
+    # join a multi-process cohort (supervise.py --procs N env seam) BEFORE
+    # the first jax.devices() call, so the mesh sees the global device set
+    maybe_init_distributed()
     args = parse_args(default_lr=4e-2, argv=argv)
     if not args.dataset_name:
         args.dataset_name = "PERSONA"

@@ -84,7 +84,20 @@
 #     find_resume_checkpoint exclude seam (skip reasons logged), the
 #     shared profiling.parse_heartbeat format, supervisor JSONL rendered
 #     by obs_report (tests/test_supervise.py — the real SIGKILL/SIGSTOP/
-#     silent-corruption recovery drill is its @slow crash-matrix leg).
+#     silent-corruption recovery drill is its @slow crash-matrix leg);
+#   - the multi-host data plane (docs/multihost.md): the virtual 2D
+#     (clients x shard) mesh bit-identical to the 1D mesh under the fp32
+#     plan (round step, engine dispatch, checkpoint restore ACROSS mesh
+#     shapes), the per-mesh-axis --collective_plan grammar
+#     (uplink=ici:fp32/dcn:int8) resolving/validating at startup with
+#     hierarchical lowering + per-level EF-carry conservation pins
+#     (tests/test_compressed_collectives.py §7), the 2-process cohort
+#     restart unit (tests/test_supervise.py TestCohortSupervise), the
+#     ledger's >= 3.99x DCN-byte acceptance ratio with ICI bytes
+#     unchanged, and run_start mesh-topology telemetry rendered by
+#     obs_report (tests/test_multihost.py — the REAL 2-process
+#     jax.distributed legs gate on a jaxlib whose CPU backend compiles
+#     multi-process computations).
 # Any extra args are passed through to pytest (e.g. -k bit_identical).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -96,5 +109,5 @@ exec env JAX_PLATFORMS=cpu \
     tests/test_compressed_collectives.py \
     tests/test_participation.py tests/test_host_offload.py \
     tests/test_io_faults.py tests/test_integrity.py \
-    tests/test_supervise.py \
+    tests/test_supervise.py tests/test_multihost.py \
     -q -m "not slow" -p no:cacheprovider "$@"

@@ -142,7 +142,11 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         leg: {"bytes_per_round": row["bytes_per_round"],
               "collective": row["collective"],
               "dtype": row.get("dtype"),
-              "total_bytes": row["bytes_per_round"] * len(rounds)}
+              "total_bytes": row["bytes_per_round"] * len(rounds),
+              # per-mesh-axis split of hierarchical legs
+              # (docs/multihost.md) — carried through for the ici/dcn
+              # wire-split line in the ledger section
+              "bytes_per_axis": row.get("bytes_per_axis")}
         for leg, row in ledger.items()}
 
     def metric_mean(name):
@@ -388,6 +392,7 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         "participation": participation,
         "host_offload": host_offload,
         "ledger": ledger_totals,
+        "mesh": run_info.get("mesh"),
         # continuous-observability additions (schema v3 + watch plane)
         "metric_schema_len": len(run_info.get("schema", []) or []) or None,
         "alerts": alerts,
@@ -456,6 +461,22 @@ def render(events: List[dict], out=None) -> Dict[str, Any]:
             p(f"mesh wire legs total: {s['wire_bytes_per_round']:,} "
               "bytes/round (client_uplink excluded — per-client, not a "
               "mesh collective)")
+        # ici-vs-dcn wire split of the per-mesh-axis legs
+        # (docs/multihost.md): intra-host (ICI) vs cross-host (DCN)
+        # bytes, the quantity a dcn:int8 plan exists to shrink
+        split = {"ici": 0, "dcn": 0}
+        for leg, row in s["ledger"].items():
+            for ax, lvl in (row.get("bytes_per_axis") or {}).items():
+                split[lvl.get("placement", "ici")] += lvl["bytes_per_round"]
+        if split["ici"] or split["dcn"]:
+            mesh = s.get("mesh") or {}
+            axes = ", ".join(
+                f"{a['name']}={a['size']} ({a['placement']})"
+                for a in mesh.get("axes", []))
+            p(f"per-axis wire split: ICI {split['ici']:,} bytes/round, "
+              f"DCN {split['dcn']:,} bytes/round"
+              + (f" — mesh {axes}, {mesh.get('process_count', 1)} "
+                 f"process(es)" if axes else ""))
     if s["mean_update_nnz"] is not None:
         p(f"runtime compression: mean resolved k "
           f"{s['mean_update_nnz']:.1f}, mean |threshold| "

@@ -33,11 +33,23 @@ unattended night's restarts reconstruct from the log alone.
 Usage:
     python scripts/supervise.py [--heartbeat-timeout S] [--startup-grace S]
         [--max-restarts N] [--backoff S] [--backoff-max S] [--events PATH]
-        -- cv_train.py --args...
+        [--procs N] -- cv_train.py --args...
 
 The child argv follows ``--``; a leading ``*.py`` gets ``sys.executable``
 prepended. The FIRST launch runs the argv verbatim; relaunches append
 ``--resume auto`` unless the argv already carries ``--resume``.
+
+``--procs N`` (docs/multihost.md) supervises an N-process jax cohort as
+ONE unit: each launch picks a fresh coordinator port and starts N copies
+of the argv with the ``COMMEFFICIENT_NUM_PROCS`` / ``_PROC_ID`` /
+``_COORDINATOR`` environment seam (``parallel.mesh.maybe_init_distributed``
+reads it in the entrypoints). Multi-process jax cannot survive a lost
+member — the survivors wedge inside a collective — so ANY member crash,
+nonzero exit, or cohort-wide heartbeat silence SIGKILLs every member and
+relaunches all N together with ``--resume auto`` (the checkpoint save is
+process-coordinated, so every member resumes the same state). A member
+that exits 0 just waits for its peers; the cohort succeeds only when all
+N exit 0.
 Acceptance drill: ``scripts/crash_matrix.py --planes supervise`` proves
 SIGKILL, an injected hang (SIGSTOP), and injected silent row corruption
 (``flip=P`` + scrub) all recover unattended, the kill/hang legs with
@@ -63,6 +75,18 @@ from commefficient_tpu.profiling import parse_heartbeat  # noqa: E402
 
 # the one resume-report line resume_run prints (federated/checkpoint.py)
 RESUME_RE = re.compile(r"resumed run state from (\S+)")
+
+
+def _free_port() -> int:
+    """A currently-free localhost port for the cohort coordinator. The
+    pick is inherently racy (the socket closes before the coordinator
+    binds); the cohort restart ladder absorbs a lost race — a bind
+    failure is just one failed launch, retried with a FRESH port."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 class EventLog:
@@ -123,17 +147,19 @@ def supervise(child_argv, heartbeat_timeout: float = 120.0,
               startup_grace: float = 900.0, max_restarts: int = 5,
               backoff: float = 2.0, backoff_max: float = 60.0,
               events_path: str = "supervise_events.jsonl",
-              out=None) -> int:
+              procs: int = 1, out=None) -> int:
     """Run ``child_argv`` to successful completion, restarting on crash
     or heartbeat-silence with ``--resume auto``; returns the final child
-    return code (0 on recovered success). See the module docstring for
-    the full ladder."""
+    return code (0 on recovered success). ``procs`` > 1 runs an
+    N-process jax cohort restarted as a unit (module docstring). See the
+    module docstring for the full ladder."""
     out = out if out is not None else sys.stdout
+    procs_n = max(1, int(procs))
     log = EventLog(events_path)
     log.event("supervisor_start", argv=list(child_argv),
               heartbeat_timeout=heartbeat_timeout,
               startup_grace=startup_grace, max_restarts=max_restarts,
-              backoff=backoff)
+              backoff=backoff, procs=procs_n)
     excluded: list = []
     strikes: dict = {}
     restarts = 0
@@ -146,34 +172,72 @@ def supervise(child_argv, heartbeat_timeout: float = 120.0,
             resume = attempt > 1 and "--resume" not in argv
             if resume:
                 argv += ["--resume", "auto"]
-            env = dict(os.environ)
-            env["COMMEFFICIENT_HEARTBEAT"] = "1"
-            # the child's stdout is a pipe: without this the resume-
-            # report line sits in a block buffer until (possibly after)
-            # the crash the supervisor needs it to diagnose
-            env["PYTHONUNBUFFERED"] = "1"
-            if excluded:
-                env["COMMEFFICIENT_RESUME_EXCLUDE"] = \
-                    os.pathsep.join(excluded)
-            proc = subprocess.Popen(argv, env=env,
-                                    stdout=subprocess.PIPE,
-                                    stderr=subprocess.STDOUT, text=True)
-            print(f"[supervise] launch attempt={attempt} pid={proc.pid}"
+            port = _free_port() if procs_n > 1 else None
+            children = []
+            for i in range(procs_n):
+                env = dict(os.environ)
+                env["COMMEFFICIENT_HEARTBEAT"] = "1"
+                # the child's stdout is a pipe: without this the resume-
+                # report line sits in a block buffer until (possibly
+                # after) the crash the supervisor needs it to diagnose
+                env["PYTHONUNBUFFERED"] = "1"
+                if excluded:
+                    env["COMMEFFICIENT_RESUME_EXCLUDE"] = \
+                        os.pathsep.join(excluded)
+                if procs_n > 1:
+                    # the multi-process env seam
+                    # (parallel.mesh.maybe_init_distributed)
+                    env["COMMEFFICIENT_NUM_PROCS"] = str(procs_n)
+                    env["COMMEFFICIENT_PROC_ID"] = str(i)
+                    env["COMMEFFICIENT_COORDINATOR"] = f"127.0.0.1:{port}"
+                children.append(subprocess.Popen(
+                    argv, env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True))
+            pids = [p.pid for p in children]
+            print(f"[supervise] launch attempt={attempt} pid(s)={pids}"
+                  + (f" coordinator=127.0.0.1:{port}" if port else "")
                   + (" (--resume auto)" if resume else ""),
                   file=out, flush=True)
-            log.event("supervisor_launch", attempt=attempt, pid=proc.pid,
-                      resume=resume, excluded=list(excluded))
+            log.event("supervisor_launch", attempt=attempt, pid=pids[0],
+                      pids=pids, resume=resume, excluded=list(excluded))
+            # ONE shared watch: any member's heartbeat counts as cohort
+            # liveness (a wedged collective silences every member at once)
             watch = _ChildWatch()
             t_launch = time.monotonic()
-            reader = threading.Thread(target=_read_child,
-                                      args=(proc, watch, out),
-                                      daemon=True)
-            reader.start()
+            readers = []
+            for p in children:
+                r = threading.Thread(target=_read_child,
+                                     args=(p, watch, out), daemon=True)
+                r.start()
+                readers.append(r)
+
+            def kill_cohort():
+                for p in children:
+                    if p.poll() is None:
+                        p.kill()  # SIGKILL: lands on SIGSTOP'd ones too
+                for p in children:
+                    try:
+                        p.wait(30)
+                    except subprocess.TimeoutExpired:
+                        pass
+
             hang = False
             while True:
-                rc = proc.poll()
-                if rc is not None:
+                rcs = [p.poll() for p in children]
+                if any(r is not None and r != 0 for r in rcs):
+                    # a failed member takes the cohort down as a unit:
+                    # multi-process jax cannot lose one process and keep
+                    # the survivors out of a wedged collective
+                    if procs_n > 1 and any(r is None for r in rcs):
+                        log.event("supervisor_cohort_kill",
+                                  attempt=attempt, rcs=rcs)
+                        print(f"[supervise] cohort member failed "
+                              f"(rcs={rcs}) — SIGKILL the rest",
+                              file=out, flush=True)
+                    kill_cohort()
                     break
+                if all(r is not None for r in rcs):
+                    break  # every member exited 0
                 now = time.monotonic()
                 if watch.beats:
                     silent = now - watch.last_beat
@@ -190,17 +254,16 @@ def supervise(child_argv, heartbeat_timeout: float = 120.0,
                               last_round=watch.last_round)
                     print(f"[supervise] no heartbeat for {silent:.0f}s "
                           f"(deadline {deadline:g}s; last round "
-                          f"{watch.last_round}) — SIGKILL pid "
-                          f"{proc.pid}", file=out, flush=True)
-                    proc.kill()  # SIGKILL: lands on SIGSTOP'd children too
-                    try:
-                        proc.wait(30)
-                    except subprocess.TimeoutExpired:
-                        pass
-                    rc = proc.returncode
+                          f"{watch.last_round}) — SIGKILL pid(s) "
+                          f"{pids}", file=out, flush=True)
+                    kill_cohort()
                     break
                 time.sleep(0.25)
-            reader.join(5)
+            rcs = [p.returncode for p in children]
+            rc = (0 if all(r == 0 for r in rcs)
+                  else next((r for r in rcs if r not in (0, None)), 1))
+            for r in readers:
+                r.join(5)
             log.event("supervisor_child_exit", attempt=attempt, rc=rc,
                       hang=hang, rounds_seen=watch.beats,
                       last_round=watch.last_round,
@@ -273,6 +336,11 @@ def main(argv=None) -> int:
     ap.add_argument("--events", default="supervise_events.jsonl",
                     help="supervisor JSONL event log (rendered by "
                          "scripts/obs_report.py)")
+    ap.add_argument("--procs", type=int, default=1,
+                    help="run the child as an N-process jax cohort "
+                         "(COMMEFFICIENT_NUM_PROCS/_PROC_ID/_COORDINATOR "
+                         "env seam) restarted as a unit on any member "
+                         "failure (docs/multihost.md)")
     ap.add_argument("child", nargs=argparse.REMAINDER,
                     help="-- followed by the training command")
     args = ap.parse_args(argv)
@@ -287,7 +355,7 @@ def main(argv=None) -> int:
                      startup_grace=args.startup_grace,
                      max_restarts=args.max_restarts, backoff=args.backoff,
                      backoff_max=args.backoff_max,
-                     events_path=args.events)
+                     events_path=args.events, procs=args.procs)
 
 
 if __name__ == "__main__":

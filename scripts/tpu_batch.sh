@@ -91,10 +91,16 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # <= 2% on-idle, rows bit-identical — docs/fault_tolerance.md §silent
 # corruption) ride the same pending window and compile class as the
 # io_faults legs.
+# NOTE (multihost PR): the multihost capture + multihost_ab A/B (the 2D
+# clients x shard server plane under the per-mesh-axis quantized plan
+# vs the fp32 plan — docs/multihost.md) need >= 4 devices, so they wait
+# for a MULTI-CHIP window (both legs abort/skip cleanly on the 1-chip
+# tunnel); the ledger's >= 3.99x DCN-byte projection is pinned on CPU
+# in tests/test_multihost.py meanwhile.
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
 coalesce telemetry watch downlink straggler clients_sweep io_faults \
 integrity participation host_offload_scale watch_ab io_faults_ab \
-integrity_ab \
+integrity_ab multihost multihost_ab \
 compressed_collectives stream_sketch sketch_coalesce fused_epilogue \
 learning profile profile_fused profile_stream profile_coalesce \
 profile_gpt2 host_offload imagenet ops"}
@@ -125,7 +131,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|watch|downlink|straggler|clients_sweep|io_faults|integrity)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|watch|downlink|straggler|clients_sweep|io_faults|integrity|multihost)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact.
       # `telemetry` is the telemetry-overhead A/B leg: headline geometry
@@ -257,6 +263,23 @@ for step in $STEPS; do
           && grep -q "integrity A/B" "$OUT/tpu_measure_integrity.log"
       then
         mark_done integrity_ab
+      fi
+      ;;
+    multihost_ab)
+      # 2D (clients x shard) per-mesh-axis plan A/B (docs/multihost.md):
+      # fp32 plan vs shard:fp32/clients:int8 on the 2D mesh + the
+      # ledger's projected ICI/DCN byte split. Needs >= 4 devices —
+      # the leg prints a skip line and exits 0 on a 1-chip window, so
+      # done is gated on the A/B line actually landing.
+      log "step $i: tpu_measure.py multihost A/B (timeout 30m)"
+      timeout 1800 python scripts/tpu_measure.py multihost \
+        >"$OUT/tpu_measure_multihost.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_multihost.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "multihost A/B" "$OUT/tpu_measure_multihost.log"
+      then
+        mark_done multihost_ab
       fi
       ;;
     compressed_collectives)

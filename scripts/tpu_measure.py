@@ -431,6 +431,81 @@ def compressed_collectives_leg():
               f"{x.size:,} elems (rel err {rel:.4f})", flush=True)
 
 
+def multihost_leg():
+    """2D (clients x shard) server plane + per-MESH-AXIS quantized
+    collectives A/B (docs/multihost.md): the sharded headline round on
+    the 2D mesh under the all-fp32 plan vs the per-axis plan that keeps
+    the shard (ICI) hop fp32 and quantizes the clients hop — the one
+    that spans DCN on a real multi-host mesh. Prints the ledger's
+    per-axis ICI/DCN byte split for both plans (the >= 3.99x DCN win is
+    static; tests/test_multihost.py pins it) and the step-time delta =
+    the hierarchical-lowering + per-level quantize/EF-carry cost. On a
+    single-host mesh both hops ride ICI, so the timing is the honest
+    no-regression number and the DCN bytes are the projection."""
+    from commefficient_tpu.ops import collectives as C
+    from commefficient_tpu.parallel.mesh import (
+        default_client_mesh,
+        server_reduce_axes,
+    )
+    from commefficient_tpu.telemetry import collective_ledger
+
+    if jax.device_count() < 4:
+        print(f"multihost leg needs >= 4 devices for the 2D "
+              f"(clients x shard=2) mesh; found {jax.device_count()} — "
+              "skipping", flush=True)
+        return
+    per_axis = ("table=shard:fp32/clients:int8,"
+                "downlink=shard:fp32/clients:int8")
+    steps_f, ps_f, ss_f, cs_f, batch = B.build(tiny=False,
+                                               server_shard=True,
+                                               shard_devices=2)
+    steps_q, ps_q, ss_q, cs_q, _ = B.build(tiny=False, server_shard=True,
+                                           shard_devices=2,
+                                           collective_plan=per_axis)
+    geo = sk.make_sketch(6_568_640, c=500_000, r=5, seed=42, num_blocks=20)
+    mesh = default_client_mesh(8, shard_devices=2)
+    axes = server_reduce_axes(mesh)
+    sizes = {a: int(mesh.shape[a]) for a in
+             ((axes,) if isinstance(axes, str) else axes)}
+    n_shard = 1
+    for v in sizes.values():
+        n_shard *= v
+    # on-pod placement (clients spans DCN); single-host runs project it
+    placement = {"shard": "ici", "clients": "dcn"}
+    for tag, spec in (("fp32", ""), ("per-axis", per_axis)):
+        plan = C.parse_collective_plan(spec)
+        low = {l: C.resolve_leg_lowering(getattr(plan, l), axes, placement)
+               for l in C.PLAN_LEGS} if plan.per_axis else None
+        led = collective_ledger("sketch", geo.d, sketch=geo,
+                                n_shard=n_shard, plan=plan, lowering=low,
+                                axis_sizes=sizes,
+                                axis_placement=placement)
+        split = {"ici": 0, "dcn": 0}
+        for name, row in led.items():
+            if name == "client_uplink":
+                continue
+            pa = row.get("bytes_per_axis")
+            if pa:
+                for ax, lvl in pa.items():
+                    split[lvl["placement"]] += lvl["bytes_per_round"]
+            else:
+                # flat legs cross every hop of the mesh once
+                for ax, pl in placement.items():
+                    if ax in sizes:
+                        split[pl] += row["bytes_per_round"]
+        print(f"plan {tag}: projected ICI {split['ici']:,} B/round, "
+              f"DCN {split['dcn']:,} B/round", flush=True)
+    dt_f, rtt, _ = time_rounds(steps_f, (ps_f, ss_f, cs_f, {}), batch)
+    print(f"multihost A/B 2D fp32-plan round: {dt_f * 1e3:.2f} ms "
+          f"({1 / dt_f:.1f} r/s), rtt {rtt * 1e3:.0f} ms", flush=True)
+    dt_q, _, _ = time_rounds(steps_q, (ps_q, ss_q, cs_q, {}), batch)
+    print(f"multihost A/B 2D per-axis-plan round: {dt_q * 1e3:.2f} ms "
+          f"({1 / dt_q:.1f} r/s) | delta {(dt_q - dt_f) * 1e3:+.2f} ms = "
+          "hierarchical lowering + per-level quantize/EF-carry cost "
+          "(the DCN-byte win itself needs a multi-host window)",
+          flush=True)
+
+
 def participation_leg():
     """Partial-cohort participation A/B (docs/fault_tolerance.md §client
     faults): the headline sketched round at --participation 1.0 vs 0.5 vs
@@ -867,7 +942,8 @@ def main():
     known = {"matmul", "cifar", "ops", "gpt2", "imagenet", "topk_ab",
              "fused_epilogue", "stream_sketch", "sketch_coalesce",
              "compressed_collectives", "participation",
-             "host_offload_scale", "watch", "io_faults", "integrity"}
+             "host_offload_scale", "watch", "io_faults", "integrity",
+             "multihost"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -904,6 +980,8 @@ def main():
         leg("sketch_coalesce", sketch_coalesce_leg)
     if sel("compressed_collectives"):
         leg("compressed_collectives", compressed_collectives_leg)
+    if sel("multihost"):
+        leg("multihost", multihost_leg)
     if sel("participation"):
         leg("participation", participation_leg)
     if sel("host_offload_scale"):

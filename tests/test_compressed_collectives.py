@@ -766,6 +766,14 @@ class TestPlanFedModel:
         np.testing.assert_array_equal(np.asarray(fm.ps_weights),
                                       np.asarray(fm2.ps_weights))
 
+    def test_per_axis_unknown_axis_fails_at_startup(self):
+        """Satellite contract (docs/multihost.md): a per-axis plan entry
+        naming a mesh axis the resolved mesh does not have fails at
+        FedModel construction — with the available axes in the message —
+        not at the first collective."""
+        with pytest.raises(ValueError, match="clients=ici"):
+            self._fed_model(collective_plan="table=bogus:int8")
+
     def test_dres_norm_rides_telemetry(self):
         """The new dres_norm slot (schema v2) lands nonzero for a
         compressed-downlink run and 0.0 for fp32 — per-round downlink
@@ -792,3 +800,229 @@ class TestPlanFedModel:
         fields2 = dict(zip(scalar_fields,
                            np.asarray(fm2._pending_telemetry)))
         assert fields2["dres_norm"] == 0.0 and fields2["qres_norm"] == 0.0
+
+
+# --------------------------------------------------------------------------
+# 7. per-mesh-axis plans: grammar, resolution, hierarchical collectives
+#    (docs/multihost.md; the 2D-mesh round/engine pins live in
+#    tests/test_multihost.py)
+# --------------------------------------------------------------------------
+
+AXES = ("shard", "clients")  # the server reduce order: ICI first, DCN last
+
+
+def _mesh2d():
+    """The 2D (clients x shard) server plane on the forced 8-device CPU
+    mesh — clients is the leading (would-be DCN) axis, shard the minor
+    ICI axis, mirroring default_client_mesh(shard_devices=4)."""
+    return Mesh(np.array(jax.devices()[:N]).reshape(2, 4),
+                ("clients", "shard"))
+
+
+class TestPerAxisGrammar:
+    def test_parse_normalizes_pairs(self):
+        p = C.parse_collective_plan("table=shard:fp32/clients:int8")
+        assert p.table == "shard:float32/clients:int8"
+        assert p.per_axis and p.quantized
+        assert C.leg_quantized(p.table)
+        # an all-fp32 per-axis leg is per_axis but NOT quantized
+        q = C.parse_collective_plan("downlink=ici:fp32/dcn:fp32")
+        assert q.per_axis and not q.quantized
+        # bare per-axis spelling applies to every leg
+        b = C.parse_collective_plan("ici:fp32/dcn:int8")
+        assert b.uplink == b.table == b.downlink == "ici:float32/dcn:int8"
+
+    def test_parse_rejects_malformed_pairs(self):
+        for bad in ("table=shard:int7", "table=:int8",
+                    "table=shard:int8/shard:int4", "table=shard:"):
+            with pytest.raises((ValueError, AssertionError)):
+                C.parse_collective_plan(bad)
+
+    def test_resolve_explicit_names_orders_by_reduce_axes(self):
+        low = C.resolve_leg_lowering("clients:int8/shard:fp32", AXES,
+                                     {"shard": "ici", "clients": "ici"})
+        assert low == (("shard", "float32"), ("clients", "int8"))
+        # an uncovered axis stays float32
+        low2 = C.resolve_leg_lowering("clients:int8", AXES,
+                                      {"shard": "ici", "clients": "ici"})
+        assert low2 == (("shard", "float32"), ("clients", "int8"))
+
+    def test_resolve_collapses_uniform_dtypes(self):
+        """All-equal per-axis dtypes collapse to the flat dtype string —
+        the flat tuple collective over the same ordering is bit-identical
+        and one hop; fp32-everywhere spellings land on the legacy path."""
+        pl = {"shard": "ici", "clients": "dcn"}
+        assert C.resolve_leg_lowering("ici:fp32/dcn:fp32", AXES, pl) \
+            == "float32"
+        assert C.resolve_leg_lowering("shard:int8/clients:int8", AXES, pl) \
+            == "int8"
+
+    def test_resolve_placement_aliases(self):
+        pl = {"shard": "ici", "clients": "dcn"}
+        low = C.resolve_leg_lowering("ici:fp32/dcn:int8", AXES, pl)
+        assert low == (("shard", "float32"), ("clients", "int8"))
+        # an alias with no matching axis names the placements in the error
+        with pytest.raises(ValueError, match="no server reduce axis"):
+            C.resolve_leg_lowering("dcn:int8", AXES,
+                                   {"shard": "ici", "clients": "ici"})
+        # alias + explicit name covering the same axis is a clash
+        with pytest.raises(ValueError, match="twice"):
+            C.resolve_leg_lowering("clients:int8/dcn:fp32", AXES, pl)
+
+    def test_resolve_unknown_axis_lists_axes(self):
+        with pytest.raises(ValueError) as ei:
+            C.resolve_leg_lowering("bogus:int8", AXES,
+                                   {"shard": "ici", "clients": "dcn"})
+        msg = str(ei.value)
+        assert "bogus" in msg and "shard=ici" in msg and "clients=dcn" in msg
+
+    def test_forced_dcn_axis_env_seam(self, monkeypatch):
+        """COMMEFFICIENT_FORCE_DCN_AXIS marks a named axis DCN on a
+        single-process mesh — the harness seam that exercises the dcn:
+        alias paths without a pod."""
+        from commefficient_tpu.parallel.mesh import mesh_axis_placement
+
+        mesh = _mesh2d()
+        assert mesh_axis_placement(mesh) \
+            == {"clients": "ici", "shard": "ici"}
+        monkeypatch.setenv("COMMEFFICIENT_FORCE_DCN_AXIS", "clients")
+        pl = mesh_axis_placement(mesh)
+        assert pl == {"clients": "dcn", "shard": "ici"}
+        assert C.resolve_leg_lowering("ici:fp32/dcn:int8", AXES, pl) \
+            == (("shard", "float32"), ("clients", "int8"))
+
+
+class TestHierarchicalCollectives:
+    """Unit pins for the per-level collectives on the 2D mesh. Layout
+    convention of every test: global dim 0 sharded P(("shard",
+    "clients")) — position p = s*n_clients + c for chip (clients=c,
+    shard=s) — the ONE ordering the server plane uses everywhere."""
+
+    def _shard(self, f, n_in, n_out):
+        mesh = _mesh2d()
+        return shard_map(
+            f, mesh=mesh, in_specs=(P(("shard", "clients")),) * n_in + (P(),),
+            out_specs=tuple(P(("shard", "clients")) for _ in range(n_out)),
+            check_vma=False)
+
+    def test_fp32_scatter_tiles_like_flat_tuple(self):
+        """Level-by-level fp32 reduce-scatter lands every destination
+        chunk on the SAME chip as the flat tuple collective (the tiling
+        identity that makes the per-axis lowering transparent), with the
+        values agreeing to reduction-order tolerance."""
+        x = np.random.RandomState(0).randn(N, N, 128).astype(np.float32)
+        low = (("shard", "float32"), ("clients", "float32"))
+
+        def hier(xl, key):
+            t, _ = C.hierarchical_psum_scatter(xl[0], low, key)
+            return (t,)
+
+        def flat(xl, key):
+            return (C.reduce_scatter_sum(xl[0], ("shard", "clients")),)
+
+        h = np.asarray(self._shard(hier, 1, 1)(jnp.asarray(x),
+                                               jax.random.key(0))[0])
+        f = np.asarray(self._shard(flat, 1, 1)(jnp.asarray(x),
+                                               jax.random.key(0))[0])
+        np.testing.assert_allclose(h, f, rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(f, x.sum(axis=0), rtol=1e-5, atol=1e-5)
+
+    def test_fp32_gather_bit_identical_to_flat_tuple(self):
+        """The reverse-order hierarchical gather reassembles the flat
+        tuple all_gather's layout BIT for bit (no reductions — pure
+        concatenation, so exactness is the contract, not tolerance)."""
+        x = np.random.RandomState(1).randn(N, 2, 128).astype(np.float32)
+        low = (("shard", "float32"), ("clients", "float32"))
+
+        def hier(xl, key):
+            t, _ = C.hierarchical_all_gather(xl[0], low, key)
+            return (t[None],)
+
+        def flat(xl, key):
+            return (C.all_gather_tiled(xl[0], ("shard", "clients"))[None],)
+
+        h = np.asarray(self._shard(hier, 1, 1)(jnp.asarray(x),
+                                               jax.random.key(0))[0])
+        f = np.asarray(self._shard(flat, 1, 1)(jnp.asarray(x),
+                                               jax.random.key(0))[0])
+        np.testing.assert_array_equal(h, f)
+        # every chip reassembled the ORIGINAL global array
+        np.testing.assert_array_equal(h.reshape(N, N, 2, 128)[0],
+                                      x.reshape(N, 2, 128)
+                                      .reshape(N, 2, 128))
+
+    def test_scatter_conservation_per_axis(self):
+        """THE per-axis conservation contract (hierarchical_psum_scatter
+        docstring): the quantized clients level's folded chunks + the
+        psum of its residual rows ≡ the exact chunks — nothing silently
+        lost at the level boundary."""
+        x = np.random.RandomState(2).randn(N, N, 128).astype(np.float32)
+        low = (("shard", "float32"), ("clients", "int8"))
+
+        def hier(xl, key):
+            t, res = C.hierarchical_psum_scatter(xl[0], low, key,
+                                                 block=128)
+            assert res[0] is None  # fp32 level carries nothing
+            return t, res[1][None]
+
+        def flat(xl, key):
+            return (C.reduce_scatter_sum(xl[0], ("shard", "clients")),)
+
+        out, res = self._shard(hier, 1, 2)(jnp.asarray(x),
+                                           jax.random.key(7))
+        exact = np.asarray(self._shard(flat, 1, 1)(
+            jnp.asarray(x), jax.random.key(7))[0])
+        out, res = np.asarray(out), np.asarray(res)
+        # res global: (N, 2, 128) in p = s*2 + c order; chip (c, s)'s row
+        # c' is its un-sent remainder for destination (c', s). Summing
+        # the clients pair at each s gives the per-destination loss.
+        res_sum = res.reshape(4, 2, 2, 128).sum(axis=1).reshape(N, 128)
+        np.testing.assert_allclose(out + res_sum, exact, atol=5e-5)
+        assert np.abs(res).max() > 0  # actually lossy
+
+    def test_psum_conservation_and_replication(self):
+        """The table leg's hierarchical all-reduce with a quantized
+        clients level: the summed table is IDENTICAL on every chip (the
+        replicated-state invariant) and conservation holds — sum + psum
+        of residuals ≡ the exact global sum."""
+        x = np.random.RandomState(3).randn(N, 4, 128).astype(np.float32)
+        low = (("shard", "float32"), ("clients", "int8"))
+
+        def hier(xl, key):
+            t, res = C.hierarchical_psum(xl[0], low, key)
+            return t[None], res[1][None]
+
+        out, res = self._shard(hier, 1, 2)(jnp.asarray(x),
+                                           jax.random.key(9))
+        out, res = np.asarray(out), np.asarray(res)
+        for p in range(1, N):
+            np.testing.assert_array_equal(out[p], out[0],
+                                          err_msg=f"chip {p} diverged")
+        # residuals depend only on the clients index (the quantized
+        # level's inputs are the exact shard-psums, equal across s)
+        got = out[0] + res[0] + res[1]  # s=0 pair covers both c values
+        np.testing.assert_allclose(got, x.sum(axis=0), atol=1e-4)
+        np.testing.assert_array_equal(res[0], res[2])  # same c, other s
+
+    def test_gather_conservation_per_chip(self):
+        """Downlink: the quantized clients gather level's emitted tile +
+        its residual ≡ the exact tile (dres telescoping contract, per
+        axis), and the fp32 shard level above it moves the dequantized
+        payloads untouched."""
+        x = np.random.RandomState(4).randn(N, 2, 128).astype(np.float32)
+        low = (("shard", "float32"), ("clients", "int8"))
+
+        def hier(xl, key):
+            t, res = C.hierarchical_all_gather(xl[0], low, key, block=128)
+            assert res[0] is None
+            return t[None], res[1][None]
+
+        full, res = self._shard(hier, 1, 2)(jnp.asarray(x),
+                                            jax.random.key(11))
+        full, res = np.asarray(full), np.asarray(res)
+        for p in range(1, N):
+            np.testing.assert_array_equal(full[p], full[0])
+        # chunk p of the gathered array is Q(x_p); + res_p ≡ x_p exactly
+        np.testing.assert_allclose(
+            full[0].reshape(N, 2, 128) + res, x, atol=5e-5)
+        assert np.abs(res).max() > 0

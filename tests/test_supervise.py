@@ -314,3 +314,80 @@ class TestCrashMatrixSupervise:
 
         crash_matrix.run_matrix(str(tmp_path), trials=1, seed=0,
                                 planes=("supervise",))
+
+
+# ---------------------------------------------------------------------------
+# the N-process cohort (--procs, docs/multihost.md)
+# ---------------------------------------------------------------------------
+
+_COHORT_CHILD = textwrap.dedent("""
+    import json, os, sys, time
+    state_dir = sys.argv[1]
+    pid = os.environ["COMMEFFICIENT_PROC_ID"]
+    count_path = os.path.join(state_dir, "count." + pid)
+    n = int(open(count_path).read()) if os.path.exists(count_path) else 0
+    open(count_path, "w").write(str(n + 1))
+    with open(os.path.join(state_dir, f"proc{pid}.attempt{n}"), "w") as f:
+        json.dump({"argv": sys.argv[2:], "proc_id": pid,
+                   "nprocs": os.environ["COMMEFFICIENT_NUM_PROCS"],
+                   "coordinator": os.environ["COMMEFFICIENT_COORDINATOR"]},
+                  f)
+    for i in range(3):
+        print(f"HEARTBEAT round={i} loss=1.0", file=sys.stderr, flush=True)
+        time.sleep(0.05)
+    if n == 0:
+        if pid == "1":
+            sys.exit(1)       # the failed member
+        time.sleep(3600)      # the survivor: cohort kill must reach it
+    if pid == "0":
+        time.sleep(0.3)       # relaunch: members exit 0 at different times
+    sys.exit(0)
+""")
+
+
+class TestCohortSupervise:
+    def test_procs_2_cohort_restarts_as_a_unit(self, tmp_path):
+        """A 2-process cohort under ``--procs 2``: one member's nonzero
+        exit SIGKILLs the healthy survivor (which would otherwise sleep
+        in a wedged collective forever), the WHOLE cohort relaunches with
+        ``--resume auto``, every member carries the
+        COMMEFFICIENT_NUM_PROCS/_PROC_ID/_COORDINATOR env seam (distinct
+        proc ids, one shared coordinator per attempt), and the cohort
+        succeeds only when all members exit 0."""
+        sup = _load_script("supervise")
+        child_py = tmp_path / "cohort_child.py"
+        child_py.write_text(_COHORT_CHILD)
+        events_path = tmp_path / "supervise_events.jsonl"
+        rc = sup.supervise(
+            [sys.executable, str(child_py), str(tmp_path)],
+            events_path=str(events_path), out=open(os.devnull, "w"),
+            heartbeat_timeout=5.0, startup_grace=10.0, backoff=0.05,
+            max_restarts=2, procs=2)
+        assert rc == 0
+        events = [json.loads(line)
+                  for line in events_path.read_text().splitlines()]
+        # the failed member took the survivor down with it
+        kills = _evs(events, "supervisor_cohort_kill")
+        assert len(kills) == 1
+        assert sorted(kills[0]["rcs"], key=str) == [1, None]
+        launches = _evs(events, "supervisor_launch")
+        assert len(launches) == 2
+        assert all(len(e["pids"]) == 2 for e in launches)
+        assert _evs(events, "supervisor_done")
+
+        def attempt(n):
+            return {p: json.loads(
+                (tmp_path / f"proc{p}.attempt{n}").read_text())
+                for p in (0, 1)}
+
+        for n in (0, 1):
+            a = attempt(n)
+            assert {a[0]["proc_id"], a[1]["proc_id"]} == {"0", "1"}
+            assert a[0]["nprocs"] == a[1]["nprocs"] == "2"
+            # one coordinator per attempt, shared by the whole cohort
+            assert a[0]["coordinator"] == a[1]["coordinator"]
+            assert a[0]["coordinator"].startswith("127.0.0.1:")
+        # the relaunch — and only the relaunch — resumes
+        assert "--resume" not in attempt(0)[0]["argv"]
+        assert attempt(1)[0]["argv"][-2:] == ["--resume", "auto"]
+        assert attempt(1)[1]["argv"][-2:] == ["--resume", "auto"]
