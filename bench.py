@@ -1190,6 +1190,148 @@ def run_integrity_measurement() -> None:
     print(json.dumps(out), flush=True)
 
 
+def run_async_measurement() -> None:
+    """Child-process entry (--run-cfg async): the round-barrier A/B of
+    docs/async.md — synchronous vs buffered-async (--async_buffer K)
+    server throughput under injected slow clients.
+
+    Six legs: {sync, async K=4} x injected slow probability
+    P in {0, 0.1, 0.3}. Client latency is SIMULATED (fast 3 ms, slow
+    40 ms per cohort member — the ~13x straggler regime of the FL
+    practicality survey, arXiv:2405.20431) because this bench prices the
+    server's SCHEDULING semantics, not client compute: the sync plane
+    cannot fold round t until its slowest member returns (it sleeps
+    max(latency) — the classic barrier), while the async plane folds
+    whenever K contributions have landed, so a straggler parks in the
+    real ParticipationController pending/buffer machinery
+    (hold -> land -> staleness-weighted masked fold, the exact jitted
+    helpers cv_train runs) and the server only ever waits for the
+    on-time members. Gates (asserted): at P=0.3 the async plane holds
+    >= 80% of its own fault-free rate while the sync plane degrades
+    >= 2x — plus the conservation invariant contributions == folded +
+    async_expired + expired (nothing silently dropped)."""
+    from typing import NamedTuple as _NT
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.federated import participation as P
+
+    FAST_S, SLOW_S = 0.003, 0.040
+    W, K, D, ROUNDS = 8, 4, 500_000, 80
+    DELAY = 2  # straggler landing delay (rounds) on both planes
+
+    class SimCtx(_NT):
+        gradient: object
+        count: object
+
+    @jax.jit
+    def _client(model, i):
+        # a cohort's already-normalized mean transmit: cheap but real
+        # device arithmetic so the fold path runs on-device, not on a
+        # python scalar stand-in
+        return jnp.sin(model + jnp.float32(i) * 1e-3) * 1e-2
+
+    @jax.jit
+    def _apply(model, grad):
+        return model - 0.1 * grad
+
+    def run_plane(plane: str, p_slow: float):
+        rng = np.random.RandomState(1000 + int(p_slow * 100))
+        sched = P.FaultSchedule(slow=p_slow, delay=DELAY, seed=7)
+        pc = P.ParticipationController(schedule=sched, decay=0.5,
+                                       async_k=(K if plane == "async"
+                                                else 0))
+        model = jnp.zeros((D,), jnp.float32)
+        # warm the jit cache outside the timed region — including the
+        # controller's fold helpers (hold -> land -> masked fold), else
+        # their compiles land inside the first async leg's timing
+        jax.block_until_ready(_apply(model, _client(model, 0)))
+        if plane == "async":
+            warm = P.ParticipationController(schedule=sched, decay=0.5,
+                                             async_k=2)
+            warm.hold(P._transmit_sum(_client(model, 0), np.float32(1)),
+                      1.0, np.arange(1), 0)
+            for j in range(2):
+                wctx, wfold, _ = warm.async_step(
+                    SimCtx(gradient=_client(model, j), count=None),
+                    j + DELAY, sharded=False, count=float(W),
+                    ids=np.arange(W))
+                if wfold:
+                    jax.block_until_ready(wctx.gradient)
+        t0 = time.perf_counter()
+        for i in range(ROUNDS):
+            lat = np.where(rng.random_sample(W) < p_slow, SLOW_S, FAST_S)
+            transmit = _client(model, i)
+            if plane == "sync":
+                # BARRIER: the fold waits for the slowest cohort member
+                time.sleep(float(lat.max()))
+                model = _apply(model, transmit)
+                continue
+            # ASYNC: the server waits only for the on-time members; a
+            # slow slot's contribution is held (version-tagged) and
+            # lands into the buffer DELAY rounds later
+            time.sleep(FAST_S)
+            n_slow = int((lat > FAST_S).sum())
+            if n_slow:
+                pc.hold(P._transmit_sum(transmit, np.float32(n_slow)),
+                        float(n_slow), np.arange(n_slow), i)
+            ctx = SimCtx(gradient=transmit, count=None)
+            ctx, fold, _info = pc.async_step(
+                ctx, i, sharded=False, count=float(max(W - n_slow, 1)),
+                ids=np.arange(W))
+            if fold:
+                model = _apply(model, ctx.gradient)
+        jax.block_until_ready(model)
+        dt = time.perf_counter() - t0
+        if plane == "async":
+            # end-of-run audit, exactly the entrypoints' finally block
+            pc.expire_buffer()
+            pc.expire_pending()
+            assert pc.contributions == (pc.folded + pc.async_expired
+                                        + pc.expired), (
+                f"async P={p_slow}: conservation violated — "
+                f"{pc.contributions} contributions vs {pc.folded} folded "
+                f"+ {pc.async_expired} + {pc.expired} expired")
+        return ROUNDS / dt, pc
+
+    out = {
+        "async_metric": (
+            f"dispatches/sec sync vs --async_buffer {K} under injected "
+            f"slow clients (P in 0/0.1/0.3; fast {FAST_S * 1e3:g} ms, "
+            f"slow {SLOW_S * 1e3:g} ms, {W} members, {ROUNDS} rounds; "
+            "docs/async.md)"),
+        "platform": jax.default_backend(),
+    }
+    rates = {}
+    for plane in ("sync", "async"):
+        for p_slow in (0.0, 0.1, 0.3):
+            rps, pc = run_plane(plane, p_slow)
+            rates[(plane, p_slow)] = rps
+            tag = f"{plane}_slow{p_slow:g}".replace(".", "p")
+            out[f"async_rounds_per_sec_{tag}"] = round(rps, 2)
+            if plane == "async":
+                out[f"async_folds_{tag}"] = pc.folds
+                out[f"async_folded_{tag}"] = pc.folded
+                out[f"async_expired_{tag}"] = (pc.async_expired
+                                               + pc.expired)
+            _log(f"async cfg {plane} P={p_slow}: {rps:.1f} rounds/s")
+    sync_deg = rates[("sync", 0.0)] / rates[("sync", 0.3)]
+    async_keep = rates[("async", 0.3)] / rates[("async", 0.0)]
+    out["async_sync_degradation_0p3"] = round(sync_deg, 3)
+    out["async_async_retention_0p3"] = round(async_keep, 3)
+    # THE acceptance gates (ISSUE 17): the barrier is the bottleneck,
+    # removing it is the win
+    assert sync_deg >= 2.0, (
+        f"sync plane degraded only {sync_deg:.2f}x at P=0.3 — the "
+        f"simulated barrier is not binding; raise SLOW_S or ROUNDS")
+    assert async_keep >= 0.8, (
+        f"async plane kept only {async_keep:.1%} of its fault-free rate "
+        f"at P=0.3 — buffered folds are stalling on stragglers")
+    print(json.dumps(out), flush=True)
+
+
 # --------------------------------------------------------------------------
 # parent orchestration
 # --------------------------------------------------------------------------
@@ -1300,6 +1442,12 @@ _EXTRA_LEGS = {
     # on + background scrub (bit-identical rows pinned in-leg)
     "integrity": (["--run-cfg", "integrity"], "BENCH_C12_TIMEOUT", 900,
                   "integrity_rounds_per_sec_on_idle"),
+    # async buffered federation (docs/async.md): sync vs --async_buffer 4
+    # dispatches/sec under injected slow clients (P = 0/0.1/0.3) — the
+    # round-barrier A/B, gates asserted in-leg (sync degrades >= 2x at
+    # P=0.3 while async keeps >= 80% of its fault-free rate)
+    "async": (["--run-cfg", "async"], "BENCH_C12_TIMEOUT", 900,
+              "async_rounds_per_sec_async_slow0p3"),
 }
 
 
@@ -1606,6 +1754,12 @@ if __name__ == "__main__":
             # scrub-active (same custom round loop)
             run_integrity_measurement()
             sys.exit(0)
+        if sel == "async":
+            # round-barrier A/B: sync vs buffered-async dispatches/sec
+            # under injected slow clients (its own simulated-latency
+            # loop over the real ParticipationController fold machinery)
+            run_async_measurement()
+            sys.exit(0)
         # the allowlist IS the leg table — a hand-maintained copy here
         # silently orphaned the coalesce/straggler captures (their
         # children exited "unknown config" while the parent reported a
@@ -1615,7 +1769,7 @@ if __name__ == "__main__":
             # parent orchestration and claim the chip for a headline bench
             sys.exit(f"--run-cfg: unknown config {sel!r}; use "
                      + "|".join(sorted(_CFG_LEGS))
-                     + "|clients_sweep|io_faults|integrity")
+                     + "|clients_sweep|io_faults|integrity|async")
         run_config_measurement(sel)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--capture":

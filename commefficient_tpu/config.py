@@ -406,6 +406,22 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                         help="Max requeues per client per epoch for "
                              "dropped-client data before the drop is "
                              "abandoned (participation layer).")
+    # Asynchronous buffered federation (docs/async.md): remove the round
+    # barrier — cohorts dispatch continuously and the server folds a
+    # buffered update whenever K contributions have landed (FedBuff,
+    # arXiv:2106.06639), each contribution staleness-weighted by the
+    # EXACT number of server folds it missed (w(Δ) = --staleness_decay**Δ
+    # with Δ = server_version_at_fold - version_read). Off (0) keeps the
+    # synchronous path bit-identical.
+    parser.add_argument("--async_buffer", type=int, default=0,
+                        help="Buffered-asynchronous federation: fold a "
+                             "server update whenever K contributions have "
+                             "landed instead of once per dispatch; "
+                             "contributions carry exact model-version "
+                             "staleness and fold with w(delta) = "
+                             "--staleness_decay**delta. 0 (default) = "
+                             "synchronous rounds (bit-identical legacy "
+                             "path).")
     # Zero-sync telemetry plane (docs/observability.md): on-device round
     # metrics computed inside the jitted server phase (norms of the
     # transmit / update / error-feedback carries, resolved top-k
@@ -627,6 +643,17 @@ def validate_args(args):
         f"--staleness_decay {args.staleness_decay} must be in (0, 1]")
     assert args.client_retry_limit >= 0, (
         "--client_retry_limit must be >= 0")
+    # async buffered federation (docs/async.md): fail fast on a malformed
+    # buffer size, and document the interactions that change meaning
+    assert getattr(args, "async_buffer", 0) >= 0, (
+        f"--async_buffer {args.async_buffer} must be >= 0 (0 = "
+        f"synchronous rounds)")
+    if getattr(args, "async_buffer", 0):
+        print(f"async buffered federation: fold every "
+              f"{args.async_buffer} landed contribution(s), "
+              f"w(Δ)={args.staleness_decay:g}**Δ exact-version staleness "
+              f"(docs/async.md); buffered dispatches fold the TRANSMIT "
+              f"only — client carries advance on fold dispatches")
     if getattr(args, "participation", ""):
         from commefficient_tpu.federated.participation import (
             parse_participation,

@@ -118,6 +118,12 @@ class RoundHandle(NamedTuple):
     # hit/miss — attached by seal_round like guard/telemetry and merged
     # into the telemetry round record at drain (docs/host_offload.md).
     offload: Optional[dict] = None
+    # async buffered federation (--async_buffer, docs/async.md): the
+    # fold's on-device masked-contribution count — a () f32 device array
+    # (how many buffered contributions' finiteness verdicts failed),
+    # materialized with the batched drain like guard/telemetry. None on
+    # the sync path and on non-fold dispatches.
+    async_masked: Optional[Any] = None
 
 
 @jax.jit
@@ -568,6 +574,12 @@ class FedModel:
         # untouched legacy path (bit-identical trajectories, pinned in
         # tests/test_participation.py).
         self._participation = None
+        # async buffered federation (--async_buffer, docs/async.md): set
+        # by begin_round when a dispatch only BUFFERS its contribution —
+        # _apply_server then skips the server phase for that dispatch
+        # (no fold, no scatter, ps_weights untouched). Always False on
+        # the synchronous path.
+        self._async_skip_server = False
 
         # ---- fault-tolerance bookkeeping (docs/fault_tolerance.md) ----
         # guard verdict of the most recent server phase, waiting for
@@ -1021,7 +1033,25 @@ class FedModel:
             ctx = ctx._replace(gradient=g.at[(0,) * g.ndim].set(poison))
             print(f"inject_fault: poisoned round {round_no} transmit "
                   f"with {poison}")
-        if part is not None:
+        async_masked = None
+        if part is not None and getattr(part, "async_k", 0):
+            # Async buffered federation (--async_buffer, docs/async.md):
+            # every contribution is a landing. Due stragglers land into
+            # the buffer; this dispatch either becomes the FOLD BASE
+            # (buffer + it reaches K — the server phase runs on the
+            # folded ctx and this cohort gets the client-state scatter)
+            # or its transmit is buffered and _apply_server skips the
+            # server phase. Host bookkeeping + jitted device arithmetic;
+            # zero blocking fetches.
+            ctx, fold, async_info = part.async_step(
+                ctx, round_no, sharded=bool(self._n_shard),
+                count=float(max(np.asarray(batch["mask"]).sum(), 1.0)),
+                ids=participating)
+            self._async_skip_server = not fold
+            async_masked = async_info.pop("masked_dev", None)
+            cohort_info = dict(cohort_info or {})
+            cohort_info["async"] = async_info
+        elif part is not None:
             # fold every DUE straggler cohort into this round's aggregate
             # with the staleness decay w(Δ) — device arithmetic on arrays
             # already in flight (participation.fold_due; the count comes
@@ -1040,7 +1070,8 @@ class FedModel:
                            participating=participating,
                            download=download_dev, upload=upload,
                            round_no=round_no, staleness=staleness,
-                           cohort=cohort_info or None)
+                           cohort=cohort_info or None,
+                           async_masked=async_masked)
 
     def finish_round(self, handle: RoundHandle):
         """Materialize a dispatched round's results — the ONE blocking host
@@ -1063,14 +1094,30 @@ class FedModel:
         # published for the engine's heartbeat line (loss + verdict tail,
         # docs/observability.md §heartbeat); None when guards are off
         self.last_guard_ok = guard_ok
-        if handle.telemetry is not None and self.telemetry is not None:
+        if handle.async_masked is not None:
+            # async fold (--async_buffer): the fold's on-device masked-
+            # contribution count, part of the same batched drain; counted
+            # into the controller ledger and the round's async record so
+            # a poisoned contribution is observable, never silent
+            n_masked = int(round(float(materialize(handle.async_masked))))
+            if self._participation is not None:
+                self._participation.note_masked(n_masked)
+            if n_masked and handle.cohort and "async" in handle.cohort:
+                handle.cohort["async"]["masked"] = n_masked
+        # async non-fold dispatches carry no server-phase metrics vector,
+        # but their round record must still land in the event log with
+        # the async buffer depth — hence the relaxed gate
+        has_async = bool(handle.cohort and "async" in handle.cohort)
+        if self.telemetry is not None and (handle.telemetry is not None
+                                           or has_async):
             # the round's device metrics vector — part of the SAME batched
             # drain (one counted materialize), recorded before the guard
             # ladder below so a fatal escalation still leaves this round's
             # metrics in the event log
             from commefficient_tpu.telemetry import METRIC_FIELDS
 
-            vals = materialize(handle.telemetry)
+            vals = (materialize(handle.telemetry)
+                    if handle.telemetry is not None else None)
             loss = (float(np.mean(ms[0][handle.valid]))
                     if len(ms) and np.any(handle.valid) else None)
             cohort = {"participants": int(len(handle.participating)),
@@ -1084,12 +1131,14 @@ class FedModel:
             if handle.cohort:
                 # participation-layer bookkeeping captured at dispatch
                 # (cohort target, drop/slow/corrupt counts, retry ladder,
-                # late landings — federated/participation.py); obs_report
-                # renders the participation section from these fields
+                # late landings, async buffer record —
+                # federated/participation.py); obs_report renders the
+                # participation/async sections from these fields
                 cohort.update(handle.cohort)
             self.telemetry.on_metrics(
                 handle.round_no,
-                {k: float(v) for k, v in zip(METRIC_FIELDS, vals)},
+                ({k: float(v) for k, v in zip(METRIC_FIELDS, vals)}
+                 if vals is not None else None),
                 loss=loss, guard_ok=guard_ok, cohort=cohort,
                 offload=handle.offload)
         if guard_ok is not None:
@@ -1202,6 +1251,19 @@ class FedModel:
         proxy DELTAS stream back into the big host-resident arrays; the
         pre-round row values come from the (undonated) round ctx because
         server_step donates its client_states argument."""
+        if self._async_skip_server:
+            # async BUFFERED dispatch (--async_buffer, docs/async.md):
+            # the contribution is already parked in the controller's
+            # buffer — no server fold this dispatch. ps_weights, server
+            # state, and client rows are untouched (transmit-only
+            # buffering, the late-landing limitation generalized); a
+            # streamed row proxy is dropped without a scatter (its rows
+            # are unchanged by construction). The model RNG is NOT
+            # consumed: the server rule runs only on folds.
+            self._async_skip_server = False
+            self._round_ctx = None
+            self._stream_round = None
+            return server_state
         ctx = self._round_ctx
         rng = self._next_rng()
         if not self.streaming:

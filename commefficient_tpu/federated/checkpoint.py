@@ -242,11 +242,13 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
         arrays["drop_rng/meta"] = np.asarray(
             [d_pos, d_gauss], np.int64)
         arrays["drop_rng/cached"] = np.asarray([d_cached], np.float64)
-    # participation layer (--participation / --inject_client_fault,
-    # federated/participation.py): the fault RNG, the pending straggler
-    # buffer (each cohort's held device transmit sum — table-/d-sized,
-    # fetched here where syncs are allowed), and the counters. A seeded
-    # fault-injected run SIGKILLed mid-epoch resumes bit-exactly.
+    # participation layer (--participation / --inject_client_fault /
+    # --async_buffer, federated/participation.py): the fault RNG, the
+    # pending straggler buffer AND the async landed-contribution buffer
+    # (each cohort's held device transmit sum — table-/d-sized, fetched
+    # here where syncs are allowed), the server-version/fold counters.
+    # A seeded fault-injected or async run SIGKILLed mid-epoch resumes
+    # bit-exactly — MID-BUFFER included (tests/test_async.py).
     part = getattr(fm, "_participation", None)
     if part is not None:
         p_arrays, p_meta = part.state_payload()

@@ -26,7 +26,12 @@ fetched values. This engine restructures the loop around that fact:
   (``jax.block_until_ready`` — a completion wait, not a transfer, so it
   does not count as a host sync). Without the bound the host can enqueue
   unboundedly far ahead of the device (50+ unsynced steps were observed to
-  wedge the bench tunnel, bench.py).
+  wedge the bench tunnel, bench.py). On the async buffered plane
+  (``--async_buffer``, docs/async.md) this window IS the concurrency
+  limit, not a round barrier: buffered dispatches skip the server phase
+  entirely, so nothing downstream of a slow contribution ever waits for
+  it — the server folds whenever K contributions have landed and the
+  engine keeps dispatching at window depth throughout.
 
 The zero-syncs-per-round invariant is auditable: wrap the submit loop in
 ``profiling.host_sync_monitor`` and assert ``counter.count == 0`` (the
@@ -231,9 +236,25 @@ class PipelinedRoundEngine:
                 hb_loss = (float(np.mean(loss_arr))
                            if loss_arr is not None
                            and getattr(loss_arr, "size", 0) else None)
+                # async buffered federation (--async_buffer,
+                # docs/async.md): buffer depth + oldest un-folded
+                # contribution age ride the line, so hang detection stays
+                # meaningful when rounds no longer tick uniformly — a
+                # full-but-never-folding buffer must not read as a
+                # healthy heartbeat (scripts/supervise.py --max-stale).
+                # All host bookkeeping; None (and absent from the line)
+                # on the synchronous path.
+                hb_buf = hb_stale = None
+                part = getattr(self.model, "_participation", None)
+                if part is not None and getattr(part, "async_k", 0):
+                    hb_buf = len(part.buffer)
+                    hb_stale = part.oldest_age(
+                        getattr(self.model, "rounds_dispatched",
+                                self._next_index))
                 self.heartbeat.round(
                     rn, loss=hb_loss,
-                    guard_ok=getattr(self.model, "last_guard_ok", None))
+                    guard_ok=getattr(self.model, "last_guard_ok", None),
+                    buffer=hb_buf, stale=hb_stale)
             if self.telemetry is not None:
                 self.telemetry.on_drained(rn,
                                           time.monotonic() - t_fetch)
@@ -256,10 +277,14 @@ class PipelinedRoundEngine:
         """Final drain (the docstring's ``close()``): materialize every
         in-flight round and return the results. A convenience alias of
         ``drain()`` for callers that drive the engine to completion —
-        NOTE it does NOT expire pending straggler cohorts
-        (federated/participation.py): stragglers may legally land in a
-        later epoch's engine instance, so end-of-run expiry belongs to
-        the entrypoints, which own the run lifetime."""
+        NOTE it does NOT expire pending straggler cohorts or the async
+        contribution buffer (federated/participation.py): stragglers may
+        legally land — and buffered contributions fold — in a later
+        epoch's engine instance, so the end-of-run expiry audit
+        (``expire_pending`` + ``expire_buffer``, with the
+        ``straggler_expired``/``async_expired`` run events) belongs to
+        the entrypoints, which own the run lifetime. Nothing is silently
+        dropped: tests/test_async.py pins the conservation count."""
         return self.drain()
 
     @property

@@ -68,10 +68,26 @@ pending straggler buffer, and counters ride ``save_run_state``/
 ``load_run_state`` (``part/*`` keys), so a seeded fault-injected run
 SIGKILLed mid-epoch resumes bit-exactly with ``--resume auto``.
 
+4. **Asynchronous buffered federation** (``--async_buffer K``,
+   docs/async.md): the late-landing machinery generalized from "late
+   stragglers fold into a sync round" to "EVERY contribution is a
+   landing" (FedBuff, arXiv:2106.06639). Cohorts dispatch continuously;
+   the server folds a buffered update whenever K contributions have
+   landed; each contribution carries the server model VERSION it read, so
+   its staleness Δ at fold time is exact (folds missed), not
+   schedule-derived, and it folds with w(Δ) = decay**Δ masked by an
+   on-device per-contribution finiteness verdict (one bad client cannot
+   poison a buffered fold). The buffer + version timeline ride the same
+   ``part/*`` checkpoint keys — a seeded async run resumes bit-exactly
+   mid-buffer. ``--async_buffer 0`` (default) leaves the synchronous path
+   bit-identical.
+
 Limitations (documented in docs/fault_tolerance.md): a straggler's late
 landing folds the TRANSMIT only — per-client velocity/error/stale-weight
 state does not advance for the straggler cohort (their slots are masked at
-dispatch, so the scatter leaves their rows at pre-round values).
+dispatch, so the scatter leaves their rows at pre-round values). The same
+holds for async BUFFERED dispatches: only the fold-base cohort's client
+state advances (docs/async.md).
 
 The layer COMPOSES with host-offloaded client state (the host and disk
 RowStreamer/MemmapRowStore tiers, docs/host_offload.md): the straggler
@@ -95,6 +111,7 @@ import jax.numpy as jnp
 
 __all__ = [
     "SAMPLING_CHOICES",
+    "AsyncContribution",
     "FaultSchedule",
     "LateCohort",
     "ParticipationController",
@@ -230,13 +247,34 @@ class LateCohort(NamedTuple):
     (device array — the sketch table / dense sum, or the stacked per-shard
     sums on the ``--server_shard`` plane), its datum count (host float),
     the client ids, and the dispatch/due round indices (global
-    ``round_no`` space)."""
+    ``round_no`` space). ``version_read`` is the server model version the
+    cohort sampled (async mode only; -1 on the synchronous path, whose
+    staleness is schedule-derived)."""
 
     transmit_sum: Any
     count: float
     ids: np.ndarray
     dispatch_round: int
     due_round: int
+    version_read: int = -1
+
+
+class AsyncContribution(NamedTuple):
+    """One LANDED-but-unfolded contribution in the async buffer
+    (``--async_buffer``, docs/async.md): the un-normalized transmit sum
+    (device), its datum count (host float — from the dispatch mask), the
+    client ids, the server model version the cohort READ (exact staleness
+    at fold is ``server_version - version_read``), the dispatch index,
+    and ``ok`` — the on-device per-contribution finiteness verdict that
+    masks a poisoned contribution out of the fold (weight 0 via a select,
+    never NaN·0)."""
+
+    transmit_sum: Any
+    count: float
+    ids: np.ndarray
+    version_read: int
+    dispatch_round: int
+    ok: Any
 
 
 # Jitted fold helpers: scalar operands are passed as () f32 ARRAYS (not
@@ -272,6 +310,46 @@ def _add(a, b):
     return a + b
 
 
+# Async buffered-fold helpers (--async_buffer, docs/async.md): the
+# per-contribution guard is a SELECT, never a multiply — a non-finite
+# contribution folds with weight 0 without NaN·0 poisoning the fold.
+
+@jax.jit
+def _finite_ok(x):
+    """Per-contribution health verdict: True iff every element of the
+    held transmit sum is finite. A () device bool — computed at landing
+    time, materialized only with the batched drain."""
+    return jnp.isfinite(x).all()
+
+
+@jax.jit
+def _masked_fold(acc_sum, c_sum, weight, ok):
+    """acc + w·contribution with the contribution selected to zero when
+    its verdict failed (``jnp.where``: a NaN sum never touches the
+    accumulator, even scaled by 0)."""
+    safe = jnp.where(ok, c_sum, jnp.zeros_like(c_sum))
+    return acc_sum + weight * safe
+
+
+@jax.jit
+def _masked_count(acc_count, c_weighted_count, ok):
+    """Denominator twin of ``_masked_fold``: the (already w-scaled) datum
+    count joins only when the contribution's verdict passed."""
+    return acc_count + c_weighted_count * ok.astype(jnp.float32)
+
+
+@jax.jit
+def _count_masked(acc, ok):
+    return acc + (1.0 - ok.astype(jnp.float32))
+
+
+@jax.jit
+def _safe_mean(num, den):
+    """num/den with an all-masked fold degrading to a ZERO update (den
+    clamped to >= 1) instead of 0/0 = NaN."""
+    return num / jnp.maximum(den, 1.0)
+
+
 def _f32(x):
     return np.float32(x)
 
@@ -284,7 +362,7 @@ class ParticipationController:
 
     def __init__(self, schedule: Optional[FaultSchedule] = None,
                  decay: float = 0.5, sampler=None,
-                 target: Optional[int] = None):
+                 target: Optional[int] = None, async_k: int = 0):
         self.schedule = schedule
         self.decay = float(decay)
         self.sampler = sampler
@@ -307,6 +385,23 @@ class ParticipationController:
         # must survive epoch-boundary checkpoints, which carry no sampler
         # state — restore re-applies it to the attached sampler
         self._quarantined_clients: set = set()
+        # -- async buffered federation (--async_buffer K, docs/async.md):
+        # every contribution is a landing. ``server_version`` counts
+        # server FOLDS (≠ dispatches once K > 1); each contribution is
+        # tagged with the version it read, so staleness Δ at fold time is
+        # exact, not schedule-derived. ``buffer`` holds landed-but-
+        # unfolded contributions; the conservation invariant
+        # contributions == folded + len(buffer) + len(pending)
+        # (+ async_expired + expired after end-of-run audit) is pinned in
+        # tests/test_async.py — nothing is silently dropped.
+        self.async_k = int(async_k)
+        self.server_version = 0
+        self.buffer: List[AsyncContribution] = []
+        self.contributions = 0    # contributions created (async mode)
+        self.folded = 0           # contributions that entered a fold
+        self.folds = 0            # server folds applied (== server_version)
+        self.masked = 0           # fold entries masked non-finite (drained)
+        self.async_expired = 0    # buffered contributions expired at run end
 
     @property
     def quarantined(self) -> int:
@@ -423,7 +518,12 @@ class ParticipationController:
             transmit_sum=transmit_sum, count=float(count),
             ids=np.asarray(ids, np.int64),
             dispatch_round=int(round_no),
-            due_round=int(round_no) + int(self.schedule.delay)))
+            due_round=int(round_no) + int(self.schedule.delay),
+            # async mode: tag the version this cohort READ — its exact
+            # staleness at fold is server_version_then - version_read
+            version_read=(self.server_version if self.async_k else -1)))
+        if self.async_k:
+            self.contributions += 1
 
     def fold_due(self, ctx, round_no: int, sharded: bool, count: float
                  ) -> Tuple[Any, List[dict]]:
@@ -467,16 +567,148 @@ class ParticipationController:
         self.expired += n
         return n
 
+    # -- async buffered federation (--async_buffer, docs/async.md) ---------
+
+    def async_step(self, ctx, round_no: int, sharded: bool, count: float,
+                   ids=None) -> Tuple[Any, bool, Dict[str, Any]]:
+        """One dispatch on the buffered-asynchronous plane: land every due
+        straggler contribution into the buffer, then either FOLD (when the
+        buffer plus this dispatch reaches K landed contributions — this
+        dispatch's full ctx is the fold base, so its cohort gets the
+        client-state scatter exactly like a synchronous round's primary
+        cohort) or BUFFER this dispatch's transmit and skip the server
+        phase entirely.
+
+        Returns ``(ctx, fold, info)``: ``fold`` tells the aggregator
+        whether to run the server phase; ``info`` is the host-side async
+        record for the telemetry ``cohort`` span (buffer depth, server
+        version, per-contribution staleness list, and — on folds — the
+        on-device masked-contribution count under ``"masked_dev"``, a ()
+        device array the aggregator materializes with the batched drain).
+        Everything here is host bookkeeping + jitted device arithmetic on
+        arrays already in flight: zero blocking fetches."""
+        assert self.async_k >= 1
+        # 1. due stragglers LAND (pending → buffer) — same due_round
+        #    timeline as the synchronous fold_due, but landing now means
+        #    joining the buffer, not folding into this round
+        due = [c for c in self.pending if c.due_round <= round_no]
+        if due:
+            self.pending = [c for c in self.pending
+                            if c.due_round > round_no]
+            for coh in due:
+                self.landed += 1
+                self.buffer.append(AsyncContribution(
+                    transmit_sum=coh.transmit_sum, count=coh.count,
+                    ids=coh.ids,
+                    version_read=(coh.version_read
+                                  if coh.version_read >= 0
+                                  else self.server_version),
+                    dispatch_round=coh.dispatch_round,
+                    ok=_finite_ok(coh.transmit_sum)))
+        self.contributions += 1  # this dispatch's primary contribution
+        info: Dict[str, Any] = {"version": self.server_version,
+                                "depth": len(self.buffer)}
+
+        if len(self.buffer) + 1 < self.async_k:
+            # 2a. BUFFER: hold the un-normalized transmit (sums fold
+            #     linearly); the server phase is skipped this dispatch
+            transmit = (ctx.gradient if sharded
+                        else _transmit_sum(ctx.gradient, _f32(count)))
+            self.buffer.append(AsyncContribution(
+                transmit_sum=transmit, count=float(count),
+                ids=np.asarray(ids if ids is not None else [], np.int64),
+                version_read=self.server_version,
+                dispatch_round=int(round_no),
+                ok=_finite_ok(transmit)))
+            info["depth"] = len(self.buffer)
+            return ctx, False, info
+
+        # 2b. FOLD: this dispatch is the base (weight 1, Δ=0 by
+        #     construction — the buffer empties at every fold, so a
+        #     same-version contribution cannot have missed one); every
+        #     buffered contribution folds transmit-only with
+        #     w(Δ) = decay**Δ, Δ exact from its version tag, masked by
+        #     its on-device finiteness verdict
+        folds = self.buffer
+        self.buffer = []
+        staleness: List[dict] = []
+        masked_dev = None
+        if folds:
+            masked_dev = _f32(0.0)
+            if sharded:
+                grad, cnt = ctx.gradient, ctx.count
+            else:
+                grad = _transmit_sum(ctx.gradient, _f32(count))
+                cnt = _f32(count)
+            for c in folds:
+                delta = self.server_version - c.version_read
+                w = staleness_weight(delta, self.decay)
+                grad = _masked_fold(grad, c.transmit_sum, _f32(w), c.ok)
+                cnt = _masked_count(cnt, _f32(w * c.count), c.ok)
+                masked_dev = _count_masked(masked_dev, c.ok)
+                self.folded += 1
+                staleness.append({"from_round": c.dispatch_round,
+                                  "delay": int(delta),
+                                  "weight": round(w, 6),
+                                  "count": c.count})
+            if sharded:
+                ctx = ctx._replace(gradient=grad, count=cnt)
+            else:
+                ctx = ctx._replace(gradient=_safe_mean(grad, cnt))
+        self.folded += 1  # the base contribution itself
+        self.folds += 1
+        self.server_version += 1
+        info.update(folded=len(folds) + 1, version=self.server_version)
+        if staleness:
+            info["staleness"] = staleness
+        if masked_dev is not None:
+            info["masked_dev"] = masked_dev
+        return ctx, True, info
+
+    def note_masked(self, n: int) -> None:
+        """Drain-time callback: ``n`` fold entries' finiteness verdicts
+        came back False (materialized with the batched drain — the fold
+        itself never fetched them)."""
+        self.masked += int(n)
+
+    def expire_buffer(self) -> int:
+        """Discard landed-but-unfolded contributions at run end (the
+        buffer never reached K again). Counted, never silent — the
+        ``async_expired`` run event and obs_report carry the number."""
+        n = len(self.buffer)
+        self.buffer = []
+        self.async_expired += n
+        return n
+
+    def oldest_age(self, round_no: int) -> int:
+        """Dispatch-age (in rounds) of the oldest un-folded contribution
+        — buffered or still pending. The engine's heartbeat carries it so
+        a full-but-never-folding buffer cannot read as a healthy
+        heartbeat (scripts/supervise.py --max-stale)."""
+        oldest = [c.dispatch_round for c in self.buffer] + \
+                 [c.dispatch_round for c in self.pending]
+        if not oldest:
+            return 0
+        return max(0, int(round_no) - min(oldest))
+
     # -- counters / checkpoint state --------------------------------------
 
     def counters(self) -> Dict[str, int]:
-        return {"drops": self.drops, "slows": self.slows,
-                "corrupts": self.corrupts, "landed": self.landed,
-                "expired": self.expired, "requeued": self.requeued,
-                "abandoned": self.abandoned,
-                "quarantined": self.quarantined,
-                "fault_skips": self.fault_skips,
-                "pending": len(self.pending)}
+        out = {"drops": self.drops, "slows": self.slows,
+               "corrupts": self.corrupts, "landed": self.landed,
+               "expired": self.expired, "requeued": self.requeued,
+               "abandoned": self.abandoned,
+               "quarantined": self.quarantined,
+               "fault_skips": self.fault_skips,
+               "pending": len(self.pending)}
+        if self.async_k:
+            out.update(contributions=self.contributions,
+                       folded=self.folded, folds=self.folds,
+                       masked=self.masked,
+                       async_expired=self.async_expired,
+                       buffered=len(self.buffer),
+                       server_version=self.server_version)
+        return out
 
     def state_payload(self) -> Tuple[Dict[str, np.ndarray], dict]:
         """Checkpoint half: (arrays, meta). Arrays carry the fault RNG
@@ -502,9 +734,28 @@ class ParticipationController:
             "quarantined_clients": sorted(self._quarantined_clients),
             "pending": [{"count": c.count,
                          "dispatch_round": c.dispatch_round,
-                         "due_round": c.due_round}
+                         "due_round": c.due_round,
+                         "version_read": c.version_read}
                         for c in self.pending],
         }
+        if self.async_k:
+            # async buffered federation (docs/async.md): the landed-but-
+            # unfolded buffer and the server-version counter ride the
+            # SAME part/* seam, so a seeded async run resumes bit-exactly
+            # MID-BUFFER (tests/test_async.py). The per-contribution ok
+            # verdict is derivable from the saved sum — restore recomputes
+            # it on device rather than shipping a () bool.
+            for i, c in enumerate(self.buffer):
+                arrays[f"buffer{i}/sum"] = np.asarray(c.transmit_sum)
+                arrays[f"buffer{i}/ids"] = np.asarray(c.ids, np.int64)
+            meta["async"] = {
+                "k": self.async_k,
+                "server_version": self.server_version,
+                "buffer": [{"count": c.count,
+                            "version_read": c.version_read,
+                            "dispatch_round": c.dispatch_round}
+                           for c in self.buffer],
+            }
         return arrays, meta
 
     def restore_state(self, arrays: Dict[str, np.ndarray], meta: dict,
@@ -534,8 +785,34 @@ class ParticipationController:
                        count=float(p["count"]),
                        ids=np.asarray(arrays[f"pending{i}/ids"], np.int64),
                        dispatch_round=int(p["dispatch_round"]),
-                       due_round=int(p["due_round"]))
+                       due_round=int(p["due_round"]),
+                       version_read=int(p.get("version_read", -1)))
             for i, p in enumerate(meta.get("pending", []))]
+        a_meta = meta.get("async")
+        if a_meta is not None and self.async_k:
+            # mid-buffer resume: rebuild the landed buffer (verdicts
+            # recomputed on device from the restored sums) and continue
+            # the fold/version timeline exactly where the save left it
+            self.server_version = int(a_meta.get("server_version", 0))
+            self.buffer = []
+            for i, b in enumerate(a_meta.get("buffer", [])):
+                s = lift(arrays[f"buffer{i}/sum"])
+                self.buffer.append(AsyncContribution(
+                    transmit_sum=s, count=float(b["count"]),
+                    ids=np.asarray(arrays[f"buffer{i}/ids"], np.int64),
+                    version_read=int(b["version_read"]),
+                    dispatch_round=int(b["dispatch_round"]),
+                    ok=_finite_ok(s)))
+            for name in ("contributions", "folded", "folds", "masked",
+                         "async_expired"):
+                setattr(self, name, int(ctr.get(name, 0)))
+        elif self.async_k:
+            import warnings
+
+            warnings.warn(
+                "--async_buffer is on but the checkpoint predates the "
+                "async plane; the buffer/version timeline restarts empty "
+                "at version 0")
 
 
 def attach_participation(args, fed_model, sampler=None):
@@ -549,17 +826,18 @@ def attach_participation(args, fed_model, sampler=None):
                                  args.num_workers)
     spec = (getattr(args, "inject_client_fault", "") or "").strip()
     schedule = parse_client_fault(spec) if spec else None
+    async_k = int(getattr(args, "async_buffer", 0) or 0)
     if sampler is not None:
         sampler.participation = target
         sampler.sampling = getattr(args, "participation_sampling",
                                    "uniform")
         sampler.retry_limit = int(getattr(args, "client_retry_limit", 3))
-    if target is None and schedule is None:
+    if target is None and schedule is None and not async_k:
         return None
     ctl = ParticipationController(
         schedule=schedule,
         decay=float(getattr(args, "staleness_decay", 0.5)),
-        sampler=sampler, target=target)
+        sampler=sampler, target=target, async_k=async_k)
     fed_model._participation = ctl
     parts = []
     if target is not None:
@@ -569,6 +847,10 @@ def attach_participation(args, fed_model, sampler=None):
     if schedule is not None:
         parts.append(f"client faults {schedule.spec()} "
                      f"(w(Δ)={ctl.decay:g}**Δ late landing)")
+    if async_k:
+        parts.append(f"async buffer K={async_k} "
+                     f"(fold on K landed contributions, exact-version "
+                     f"staleness — docs/async.md)")
     print("participation layer: " + "; ".join(parts)
           + " (docs/fault_tolerance.md)")
     return ctl

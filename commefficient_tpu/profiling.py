@@ -52,13 +52,18 @@ HEARTBEAT_RE = re.compile(
     r"HEARTBEAT round=(\d+)"
     r"(?: epoch=(\d+))?"
     r"(?: loss=(\S+))?"
-    r"(?: guard=(ok|TRIP))?")
+    r"(?: guard=(ok|TRIP))?"
+    r"(?: buf=(\d+))?"
+    r"(?: stale=(\d+))?")
 
 
 def parse_heartbeat(line: str):
     """Parse one ``Heartbeat.round`` stderr line; None for non-heartbeat
     lines. Returns ``{"round": int}`` plus whichever optional fields the
-    line carried (``epoch`` int, ``loss`` float, ``guard_ok`` bool)."""
+    line carried (``epoch`` int, ``loss`` float, ``guard_ok`` bool, and —
+    async buffered federation, docs/async.md — ``buf`` int buffer depth
+    and ``stale`` int dispatch-age of the oldest un-folded
+    contribution)."""
     m = HEARTBEAT_RE.match(line.strip())
     if m is None:
         return None
@@ -72,6 +77,10 @@ def parse_heartbeat(line: str):
             pass
     if m.group(4) is not None:
         out["guard_ok"] = m.group(4) == "ok"
+    if m.group(5) is not None:
+        out["buf"] = int(m.group(5))
+    if m.group(6) is not None:
+        out["stale"] = int(m.group(6))
     return out
 
 
@@ -104,7 +113,15 @@ class Heartbeat:
 
     def round(self, index: int, epoch: int | None = None,
               loss: float | None = None,
-              guard_ok: bool | None = None) -> None:
+              guard_ok: bool | None = None,
+              buffer: int | None = None,
+              stale: int | None = None) -> None:
+        """``buffer``/``stale`` (async buffered federation, docs/async.md)
+        carry the landed-but-unfolded buffer depth and the dispatch-age of
+        the oldest un-folded contribution, so a full-but-never-folding
+        buffer is visible to the supervisor's hang detection
+        (scripts/supervise.py --max-stale) even while dispatch heartbeats
+        keep ticking."""
         if not self.enabled:
             return
         line = f"HEARTBEAT round={index}"
@@ -114,6 +131,10 @@ class Heartbeat:
             line += f" loss={loss:.6g}"
         if guard_ok is not None:
             line += f" guard={'ok' if guard_ok else 'TRIP'}"
+        if buffer is not None:
+            line += f" buf={buffer}"
+        if stale is not None:
+            line += f" stale={stale}"
         print(line, file=sys.stderr, flush=True)
 
 

@@ -787,7 +787,7 @@ class RunTelemetry:
         if span is not None and "compute_ms" not in span:
             span["compute_ms"] = (time.monotonic() - span["t_sealed"]) * 1e3
 
-    def on_metrics(self, round_no: int, metrics: Dict[str, float],
+    def on_metrics(self, round_no: int, metrics: Optional[Dict[str, float]],
                    loss: Optional[float] = None,
                    guard_ok: Optional[bool] = None,
                    cohort: Optional[Dict[str, Any]] = None,
@@ -795,11 +795,15 @@ class RunTelemetry:
         """Called by ``FedModel.finish_round`` with the drained (host)
         metric values; ``cohort`` carries the host-side participation/
         staleness summary (participants, slots, staleness_mean/max when
-        the accounting regime tracks per-client participation);
+        the accounting regime tracks per-client participation, and the
+        async buffer record on the ``--async_buffer`` plane);
         ``offload`` the host-offload data-plane record (placement tier,
-        gather/scatter ms, prefetch hit/miss — docs/host_offload.md)."""
+        gather/scatter ms, prefetch hit/miss — docs/host_offload.md).
+        ``metrics`` is None for async BUFFERED dispatches — the server
+        phase (whose jitted vector the metrics are) runs only on folds."""
         span = self._spans.setdefault(round_no, {})
-        span["metrics"] = metrics
+        if metrics is not None:
+            span["metrics"] = metrics
         if loss is not None:
             span["loss"] = loss
         if guard_ok is not None:
@@ -959,6 +963,15 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
             "quarantine_after": sched.quarantine_after}
     else:
         run_info["client_fault"] = None
+    # Async buffered federation (--async_buffer, docs/async.md): the
+    # fold threshold + decay in the run header, so a logged async run's
+    # buffer/staleness story reproduces from the log alone (obs_report's
+    # Async section) — same auditability contract as the fault schedule
+    async_k = int(getattr(args, "async_buffer", 0) or 0)
+    run_info["async"] = ({"buffer": async_k,
+                          "staleness_decay": float(
+                              getattr(args, "staleness_decay", 0.5))}
+                         if async_k else None)
     # Host-offload data plane (docs/host_offload.md): the resolved
     # placement tier + per-round streamed-row geometry, so the obs_report
     # "Host offload" section reproduces the data-plane story from the log

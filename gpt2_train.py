@@ -485,11 +485,18 @@ def train(argv=None):
                                resume_mid=resume_mid)
         finally:
             if pc is not None:
-                # stragglers whose due round will never dispatch: counted,
-                # never silent (obs_report's participation section)
+                # end-of-run expiry audit (owned HERE, not engine.close()
+                # — cohorts legally land across engine instances):
+                # stragglers whose due round will never dispatch AND
+                # async contributions that landed but never reached a
+                # K-fold are counted, never silent (obs_report's
+                # participation/async sections)
                 expired = pc.expire_pending()
                 if expired and rt is not None:
                     rt.event("straggler_expired", count=expired)
+                a_expired = pc.expire_buffer() if pc.async_k else 0
+                if a_expired and rt is not None:
+                    rt.event("async_expired", count=a_expired)
             tracer = getattr(fed_model, "tracer", None)
             if tracer is not None:
                 # a capture window left open at run end stops here; its

@@ -85,6 +85,21 @@
 #     shared profiling.parse_heartbeat format, supervisor JSONL rendered
 #     by obs_report (tests/test_supervise.py — the real SIGKILL/SIGSTOP/
 #     silent-corruption recovery drill is its @slow crash-matrix leg);
+#   - asynchronous buffered federation (--async_buffer K,
+#     docs/async.md): the engine's buffered K-fold trajectory
+#     bit-identical to a hand-computed twin applying the exact
+#     jitted-helper fold sequence on BOTH server planes (incl. the
+#     buffered-dispatch-consumes-no-model-RNG contract), exact
+#     fold-counted staleness from version tags (Δ = server_version -
+#     version_read, not wall-clock), per-contribution finiteness
+#     masking with the all-masked fold degrading to a zero update,
+#     mid-buffer checkpoint/resume bit-exactness through the part/*
+#     seam, async-off fp32 bit-identity across both planes x both
+#     epilogues (parity row A21), the contributions == folded +
+#     async_expired + expired conservation audit reproduced from the
+#     telemetry JSONL alone, the strict zero-host-sync audit with
+#     buffering + folds in flight, and the heartbeat buf/stale fields
+#     feeding supervise.py --max-stale (tests/test_async.py);
 #   - the multi-host data plane (docs/multihost.md): the virtual 2D
 #     (clients x shard) mesh bit-identical to the 1D mesh under the fp32
 #     plan (round step, engine dispatch, checkpoint restore ACROSS mesh
@@ -110,4 +125,5 @@ exec env JAX_PLATFORMS=cpu \
     tests/test_participation.py tests/test_host_offload.py \
     tests/test_io_faults.py tests/test_integrity.py \
     tests/test_supervise.py tests/test_multihost.py \
+    tests/test_async.py \
     -q -m "not slow" -p no:cacheprovider "$@"

@@ -202,6 +202,45 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         "retry_ladder": retry_ladder,
     }
 
+    # Async section (--async_buffer, federated/participation.py,
+    # docs/async.md): rebuilt entirely from the per-round cohort `async`
+    # sub-records + the `async_expired` run event + the run header —
+    # the same log-alone reproducibility drill as the participation
+    # section (tests/test_async.py compares these totals against the
+    # live controller's counters).
+    async_recs = [c["async"] for c in cohorts if "async" in c]
+    async_info = None
+    if async_recs or run_info.get("async"):
+        folds = [r for r in async_recs if r.get("folded")]
+        fold_stal = [s for r in folds for s in r.get("staleness", [])]
+        a_stal_hist: Dict[str, int] = {}
+        for rec in fold_stal:
+            key = str(rec.get("delay"))
+            a_stal_hist[key] = a_stal_hist.get(key, 0) + 1
+        depths = [r["depth"] for r in async_recs if "depth" in r]
+        async_info = {
+            "buffer": (run_info.get("async") or {}).get("buffer"),
+            "staleness_decay": (run_info.get("async") or {}).get(
+                "staleness_decay", run_info.get("staleness_decay")),
+            "dispatches": len(async_recs),
+            "folds": len(folds),
+            "folded_contributions": sum(r.get("folded", 0)
+                                        for r in folds),
+            "server_version": max((r.get("version", 0)
+                                   for r in async_recs), default=0),
+            "depth_mean": _mean(depths),
+            "depth_max": max(depths, default=0),
+            "staleness_hist": a_stal_hist,
+            "stale_folds": len([s for s in fold_stal
+                                if s.get("delay", 0) > 0]),
+            "fold_weight_mean": _mean(
+                [s["weight"] for s in fold_stal
+                 if isinstance(s.get("weight"), (int, float))]),
+            "masked": sum(r.get("masked", 0) for r in async_recs),
+            "expired": sum(e.get("count", 0) for e in events
+                           if e.get("ev") == "async_expired"),
+        }
+
     # Host-offload section (docs/host_offload.md): rebuilt entirely from
     # the per-round `offload` span fields + the run header — the same
     # log-alone reproducibility drill as the participation section
@@ -390,6 +429,7 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
                                  if isinstance(e.get("loss"), float)
                                  and math.isfinite(e["loss"])])),
         "participation": participation,
+        "async": async_info,
         "host_offload": host_offload,
         "ledger": ledger_totals,
         "mesh": run_info.get("mesh"),
@@ -560,6 +600,28 @@ def render(events: List[dict], out=None) -> Dict[str, Any]:
                     part["retry_ladder"].items(),
                     key=lambda kv: int(kv[0])))
             p(f"drop-requeue retry ladder: {ladder}")
+
+    asy = s.get("async")
+    if asy:
+        p("\n## Async buffered federation (docs/async.md)")
+        p(f"buffer K={asy.get('buffer')}, "
+          f"staleness decay {asy.get('staleness_decay')}")
+        p(f"{asy['dispatches']} dispatch(es) -> {asy['folds']} fold(s), "
+          f"{asy['folded_contributions']} contribution(s) folded, "
+          f"server version {asy['server_version']}")
+        p(f"buffer depth mean {asy['depth_mean']} / max "
+          f"{asy['depth_max']}")
+        if asy.get("staleness_hist"):
+            hist = ", ".join(
+                f"D={d}: {n}" for d, n in sorted(
+                    asy["staleness_hist"].items(),
+                    key=lambda kv: int(kv[0])))
+            p(f"exact staleness at fold ({asy['stale_folds']} stale, "
+              f"mean weight {asy['fold_weight_mean']}): {hist}")
+        if asy.get("masked") or asy.get("expired"):
+            p(f"{asy.get('masked', 0)} contribution(s) masked non-finite "
+              f"at fold, {asy.get('expired', 0)} expired unfolded at "
+              "run end")
 
     ho = s.get("host_offload")
     if ho:

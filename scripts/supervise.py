@@ -112,11 +112,20 @@ class EventLog:
 class _ChildWatch:
     """Shared liveness state the reader thread updates per child line."""
 
-    def __init__(self):
+    def __init__(self, max_stale: int = 0):
         self.last_beat: float = 0.0   # monotonic stamp of the last beat
         self.beats: int = 0
         self.last_round: int = -1
         self.resumed_from: str = ""
+        # async buffered federation (docs/async.md): a dispatch heartbeat
+        # whose ``stale`` field (dispatch-age of the oldest un-folded
+        # contribution) reaches this bound does NOT refresh liveness — a
+        # full-but-never-folding buffer must not read as a healthy
+        # heartbeat, so the ordinary hang deadline then declares the
+        # child wedged. 0 disables the check (sync heartbeats carry no
+        # ``stale`` field and are never affected).
+        self.max_stale = int(max_stale)
+        self.last_stale: int = 0
 
 
 def _read_child(proc, watch: _ChildWatch, out) -> None:
@@ -132,9 +141,12 @@ def _read_child(proc, watch: _ChildWatch, out) -> None:
                 pass
             hb = parse_heartbeat(line)
             if hb is not None:
-                watch.last_beat = time.monotonic()
                 watch.beats += 1
                 watch.last_round = hb["round"]
+                watch.last_stale = hb.get("stale", 0)
+                if not (watch.max_stale
+                        and watch.last_stale >= watch.max_stale):
+                    watch.last_beat = time.monotonic()
                 continue
             m = RESUME_RE.search(line)
             if m:
@@ -147,19 +159,25 @@ def supervise(child_argv, heartbeat_timeout: float = 120.0,
               startup_grace: float = 900.0, max_restarts: int = 5,
               backoff: float = 2.0, backoff_max: float = 60.0,
               events_path: str = "supervise_events.jsonl",
-              procs: int = 1, out=None) -> int:
+              procs: int = 1, max_stale: int = 200, out=None) -> int:
     """Run ``child_argv`` to successful completion, restarting on crash
     or heartbeat-silence with ``--resume auto``; returns the final child
     return code (0 on recovered success). ``procs`` > 1 runs an
-    N-process jax cohort restarted as a unit (module docstring). See the
-    module docstring for the full ladder."""
+    N-process jax cohort restarted as a unit (module docstring).
+    ``max_stale`` (async buffered federation, docs/async.md): a
+    heartbeat whose ``stale`` field — the dispatch-age of the oldest
+    un-folded contribution — reaches this bound stops counting as
+    liveness, so a child that keeps dispatching but never folds is
+    declared hung by the ordinary deadline instead of reading healthy
+    forever (0 disables). See the module docstring for the full
+    ladder."""
     out = out if out is not None else sys.stdout
     procs_n = max(1, int(procs))
     log = EventLog(events_path)
     log.event("supervisor_start", argv=list(child_argv),
               heartbeat_timeout=heartbeat_timeout,
               startup_grace=startup_grace, max_restarts=max_restarts,
-              backoff=backoff, procs=procs_n)
+              backoff=backoff, procs=procs_n, max_stale=max_stale)
     excluded: list = []
     strikes: dict = {}
     restarts = 0
@@ -202,7 +220,7 @@ def supervise(child_argv, heartbeat_timeout: float = 120.0,
                       pids=pids, resume=resume, excluded=list(excluded))
             # ONE shared watch: any member's heartbeat counts as cohort
             # liveness (a wedged collective silences every member at once)
-            watch = _ChildWatch()
+            watch = _ChildWatch(max_stale=max_stale)
             t_launch = time.monotonic()
             readers = []
             for p in children:
@@ -251,11 +269,20 @@ def supervise(child_argv, heartbeat_timeout: float = 120.0,
                     hang = True
                     log.event("supervisor_timeout", attempt=attempt,
                               silent_s=round(silent, 1),
-                              last_round=watch.last_round)
-                    print(f"[supervise] no heartbeat for {silent:.0f}s "
+                              last_round=watch.last_round,
+                              last_stale=watch.last_stale)
+                    stale_note = (
+                        f"; oldest un-folded contribution "
+                        f"{watch.last_stale} dispatches old (>= "
+                        f"--max-stale {watch.max_stale}: beats stopped "
+                        f"counting as liveness)"
+                        if watch.max_stale
+                        and watch.last_stale >= watch.max_stale else "")
+                    print(f"[supervise] no (live) heartbeat for "
+                          f"{silent:.0f}s "
                           f"(deadline {deadline:g}s; last round "
-                          f"{watch.last_round}) — SIGKILL pid(s) "
-                          f"{pids}", file=out, flush=True)
+                          f"{watch.last_round}{stale_note}) — SIGKILL "
+                          f"pid(s) {pids}", file=out, flush=True)
                     kill_cohort()
                     break
                 time.sleep(0.25)
@@ -333,6 +360,13 @@ def main(argv=None) -> int:
                          "no-progress failure")
     ap.add_argument("--backoff-max", type=float, default=60.0,
                     help="restart delay ceiling")
+    ap.add_argument("--max-stale", type=int, default=200,
+                    help="async buffered federation (docs/async.md): a "
+                         "heartbeat whose stale field (dispatch-age of "
+                         "the oldest un-folded contribution) reaches "
+                         "this bound stops refreshing liveness, so a "
+                         "full-but-never-folding buffer is declared "
+                         "hung by the ordinary deadline (0 disables)")
     ap.add_argument("--events", default="supervise_events.jsonl",
                     help="supervisor JSONL event log (rendered by "
                          "scripts/obs_report.py)")
@@ -355,7 +389,8 @@ def main(argv=None) -> int:
                      startup_grace=args.startup_grace,
                      max_restarts=args.max_restarts, backoff=args.backoff,
                      backoff_max=args.backoff_max,
-                     events_path=args.events, procs=args.procs)
+                     events_path=args.events, procs=args.procs,
+                     max_stale=args.max_stale)
 
 
 if __name__ == "__main__":

@@ -91,6 +91,12 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # <= 2% on-idle, rows bit-identical — docs/fault_tolerance.md §silent
 # corruption) ride the same pending window and compile class as the
 # io_faults legs.
+# NOTE (async PR): the async capture (sync vs --async_buffer 4
+# dispatches/sec under injected slow clients — the round-barrier A/B of
+# docs/async.md, gates asserted in-leg) plus the async_ab device-half
+# fold timing ride the next window; both are cheap add-ons (the capture
+# is latency-simulation + small jitted folds; the A/B reuses no heavy
+# compile).
 # NOTE (multihost PR): the multihost capture + multihost_ab A/B (the 2D
 # clients x shard server plane under the per-mesh-axis quantized plan
 # vs the fp32 plan — docs/multihost.md) need >= 4 devices, so they wait
@@ -98,9 +104,9 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # tunnel); the ledger's >= 3.99x DCN-byte projection is pinned on CPU
 # in tests/test_multihost.py meanwhile.
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
-coalesce telemetry watch downlink straggler clients_sweep io_faults \
+coalesce telemetry watch downlink straggler async clients_sweep io_faults \
 integrity participation host_offload_scale watch_ab io_faults_ab \
-integrity_ab multihost multihost_ab \
+integrity_ab async_ab multihost multihost_ab \
 compressed_collectives stream_sketch sketch_coalesce fused_epilogue \
 learning profile profile_fused profile_stream profile_coalesce \
 profile_gpt2 host_offload imagenet ops"}
@@ -131,7 +137,7 @@ for step in $STEPS; do
           && log "note: bench extras carried leg errors (see bench.json)"
       fi
       ;;
-    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|watch|downlink|straggler|clients_sweep|io_faults|integrity|multihost)
+    gpt2_bf16|gpt2_f32|c4|c1|c2|shard|fused|guards|stream|coalesce|telemetry|watch|downlink|straggler|async|clients_sweep|io_faults|integrity|multihost)
       # one resumable capture per heavy compile: a window that lands even
       # one leg banks it in .bench_extras.json for every later artifact.
       # `telemetry` is the telemetry-overhead A/B leg: headline geometry
@@ -263,6 +269,21 @@ for step in $STEPS; do
           && grep -q "integrity A/B" "$OUT/tpu_measure_integrity.log"
       then
         mark_done integrity_ab
+      fi
+      ;;
+    async_ab)
+      # async buffered-fold device half (docs/async.md): the K-deep
+      # masked fold + landing verdict at both FetchSGD geometries, plus
+      # the standing K-transmit HBM footprint for the leg_budgets rows
+      log "step $i: tpu_measure.py async fold timing (timeout 30m)"
+      timeout 1800 python scripts/tpu_measure.py async \
+        >"$OUT/tpu_measure_async.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_async.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "async fold d=124" "$OUT/tpu_measure_async.log"
+      then
+        mark_done async_ab
       fi
       ;;
     multihost_ab)

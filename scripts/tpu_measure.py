@@ -535,6 +535,55 @@ def participation_leg():
               f"(expected ~0 — static shapes)", flush=True)
 
 
+def async_leg(d=6_568_640):
+    """Async buffered-fold device half (docs/async.md): the K-deep masked
+    fold a --async_buffer K server runs at every K-th dispatch — per
+    buffered contribution one finiteness verdict (landing time) and one
+    select + scaled add into the accumulating (sum, count) pair, then the
+    clamped normalize. Timed at the FetchSGD gradient geometry so the
+    number reads as ms added to the fold dispatch; the standing cost is
+    the K un-folded d-sized transmits parked in HBM (K·d·4 B — the async
+    analogue of the straggler hold, printed for the leg_budgets row). The
+    host half (controller bookkeeping, exact-staleness tags) is numpy on
+    a handful of scalars — bench.py --run-cfg async prices it."""
+    from commefficient_tpu.federated import participation as P
+
+    K = 4
+    rng = np.random.RandomState(0)
+    contribs = [jnp.asarray(rng.randn(d).astype(np.float32))
+                for _ in range(K - 1)]
+    base = jnp.asarray(rng.randn(d).astype(np.float32))
+    oks = [P._finite_ok(c) for c in contribs]
+
+    def fold():
+        grad = P._transmit_sum(base, np.float32(8.0))
+        cnt = np.float32(8.0)
+        for j, (c, ok) in enumerate(zip(contribs, oks)):
+            w = P.staleness_weight(j % 3, 0.5)
+            grad = P._masked_fold(grad, c, np.float32(w), ok)
+            cnt = P._masked_count(cnt, np.float32(w * 8.0), ok)
+        return P._safe_mean(grad, cnt)
+
+    drain(fold())  # compile
+    rtt = rtt_measure(fold())
+    best = float("inf")
+    iters = 20
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fold()
+        drain(r)
+        best = min(best, max(time.perf_counter() - t0 - rtt, 1e-9))
+    ms = best / iters * 1e3
+    land_ms = chained(lambda x: x + P._finite_ok(x).astype(jnp.float32),
+                      base, K=10)
+    hbm = K * d * 4
+    print(f"async fold d={d:,} K={K}: {ms:.3f} ms/fold "
+          f"({ms / (K - 1):.3f} ms/buffered contribution), landing "
+          f"verdict {land_ms:.3f} ms; standing buffer {hbm / 2**20:.1f} "
+          f"MiB HBM ({K} pending transmits)", flush=True)
+
+
 def watch_leg():
     """Continuous-observability overhead A/B (docs/observability.md):
     the headline sketched round with telemetry scalars only (schema v2)
@@ -943,7 +992,7 @@ def main():
              "fused_epilogue", "stream_sketch", "sketch_coalesce",
              "compressed_collectives", "participation",
              "host_offload_scale", "watch", "io_faults", "integrity",
-             "multihost"}
+             "multihost", "async"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -984,6 +1033,9 @@ def main():
         leg("multihost", multihost_leg)
     if sel("participation"):
         leg("participation", participation_leg)
+    if sel("async"):
+        leg("async-6.5M", async_leg, 6_568_640)
+        leg("async-124M", async_leg, 124_444_417)
     if sel("host_offload_scale"):
         leg("host_offload_scale", host_offload_scale_leg)
     if sel("watch"):

@@ -1,0 +1,526 @@
+"""Asynchronous buffered federation (``--async_buffer K``,
+federated/participation.py, docs/async.md).
+
+Pins the async PR's contracts:
+
+- **Fold equivalence**: the engine's buffered K-fold trajectory is
+  BIT-identical to a manually-orchestrated twin applying the exact
+  jitted-helper sequence (``_transmit_sum`` → ``_masked_fold`` /
+  ``_masked_count`` over the FIFO buffer → ``_safe_mean``) by hand — on
+  BOTH server planes (replicated / ``--server_shard``). The twin also
+  pins the RNG contract: buffered dispatches consume NO model RNG (the
+  server rule runs only on folds).
+- **Exact staleness**: Δ at fold time is ``server_version -
+  version_read`` — fold-counted, not wall-clock — so a straggler that
+  waited 3 dispatch rounds but saw only one fold lands with Δ=1.
+- **Per-contribution masking**: a poisoned (non-finite) contribution is
+  selected out of the fold with weight 0 (``jnp.where``, never NaN·0),
+  counted via the drained ``masked_dev`` scalar; an all-masked fold
+  degrades to a ZERO update, not 0/0 = NaN.
+- **Mid-buffer checkpoint/resume**: the landed-but-unfolded buffer and
+  the server-version timeline ride the ``part/*`` seam; a restored run
+  continues bit-identically. A pre-async checkpoint warns instead of
+  silently restarting the timeline.
+- **Sync-path bit-identity**: ``async_buffer=0`` leaves the fp32
+  trajectory BIT-identical to the layer absent, across
+  replicated/``--server_shard`` × composed/``--fused_epilogue``.
+- **Conservation**: ``contributions == folded + async_expired +
+  expired`` after the entrypoint-owned end-of-run expiry audit —
+  nothing is silently dropped — and the whole async history reproduces
+  from the telemetry JSONL alone (scripts/obs_report.py).
+- **Liveness**: the heartbeat's ``buf``/``stale`` fields round-trip
+  through ``parse_heartbeat`` so a full-but-never-folding buffer is
+  visible to scripts/supervise.py ``--max-stale``.
+"""
+
+import json
+import os
+import sys
+from typing import Any, NamedTuple
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("COMMEFFICIENT_TINY_MODEL", "1")
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+
+from commefficient_tpu.federated import participation as P  # noqa: E402
+from commefficient_tpu.federated.aggregator import FedModel  # noqa: E402
+from commefficient_tpu.federated.participation import (  # noqa: E402
+    FaultSchedule,
+    ParticipationController,
+    attach_participation,
+    staleness_weight,
+)
+from commefficient_tpu.profiling import (  # noqa: E402
+    Heartbeat,
+    host_sync_monitor,
+    parse_heartbeat,
+)
+from commefficient_tpu.telemetry import RunTelemetry, collective_ledger  # noqa: E402
+
+from test_participation import (  # noqa: E402
+    TinyModel,
+    _args,
+    _engine,
+    _flat_weights,
+    _host_batch,
+    _loss,
+    _predict_faults,
+)
+
+
+class _Ctx(NamedTuple):
+    """A bare RoundContext stand-in for driving async_step directly."""
+
+    gradient: Any
+    count: Any
+
+
+def _count(batch):
+    return float(max(np.asarray(batch["mask"]).sum(), 1.0))
+
+
+# ---------------------------------------------------------------------------
+# fold equivalence vs a hand-computed twin
+# ---------------------------------------------------------------------------
+
+class TestAsyncFoldEquivalence:
+    @pytest.mark.parametrize("server_shard", [False, True],
+                             ids=["replicated", "shard"])
+    def test_trajectory_matches_hand_computed_fold(self, server_shard):
+        """The acceptance pin: drive the engine with --async_buffer K=2
+        (no faults — every fold is a Δ=0, w=1 fold) and reproduce the
+        IDENTICAL weight trajectory with a twin that buffers/folds by
+        hand via the exact jitted helpers. Buffered dispatches leave the
+        weights untouched AND consume no model RNG — the twin only calls
+        opt.step() on fold rounds, so a single extra RNG draw anywhere
+        would break the bitwise comparison."""
+        K, rounds = 2, 6
+        over = {}
+        if server_shard:
+            over.update(num_devices=2, server_shard=True)
+
+        ctl = ParticipationController(schedule=None, async_k=K, decay=0.5)
+        fmA, optA, engineA = _engine(controller=ctl, **over)
+        fmB, optB, engineB = _engine(**over)
+        schedB = engineB.lr_scheduler
+
+        buffered = []  # [(transmit_sum, count)] in FIFO order
+        for rnd in range(rounds):
+            batch = _host_batch([rnd % 4, (rnd + 1) % 4], seed=rnd)
+            engineA.submit(dict(batch))
+
+            # ---- the hand-computed twin ----
+            schedB.step()
+            handleB = fmB.begin_round(dict(batch))
+            ctx = fmB._round_ctx
+            c = _count(batch)
+            if len(buffered) + 1 < K:
+                # buffered dispatch: park the un-normalized transmit SUM,
+                # skip the server phase (and its RNG draw) entirely
+                s = (ctx.gradient if server_shard
+                     else P._transmit_sum(ctx.gradient, np.float32(c)))
+                buffered.append((s, c))
+                fmB._round_ctx = None
+            else:
+                if server_shard:
+                    grad, cnt = ctx.gradient, ctx.count
+                else:
+                    grad = P._transmit_sum(ctx.gradient, np.float32(c))
+                    cnt = np.float32(c)
+                for s, cs in buffered:  # FIFO; Δ=0 ⇒ w=1 by construction
+                    ok = P._finite_ok(s)
+                    grad = P._masked_fold(grad, s, np.float32(1.0), ok)
+                    cnt = P._masked_count(cnt, np.float32(1.0 * cs), ok)
+                buffered = []
+                if server_shard:
+                    ctx = ctx._replace(gradient=grad, count=cnt)
+                else:
+                    ctx = ctx._replace(gradient=P._safe_mean(grad, cnt))
+                fmB._round_ctx = ctx
+                optB.step()
+            fmB.finish_round(handleB)
+
+            np.testing.assert_array_equal(
+                _flat_weights(fmA), _flat_weights(fmB),
+                err_msg=f"round {rnd}: engine buffered fold != "
+                        f"hand-computed twin")
+            # mid-run conservation: every contribution is accounted for
+            assert ctl.contributions == (ctl.folded + len(ctl.buffer)
+                                         + len(ctl.pending))
+        assert ctl.folds == rounds // K
+        assert ctl.folded == ctl.contributions == rounds
+        assert ctl.server_version == ctl.folds
+
+
+# ---------------------------------------------------------------------------
+# exact staleness: fold-counted Δ from version tags
+# ---------------------------------------------------------------------------
+
+class TestExactStaleness:
+    def test_version_tags_give_fold_counted_delta(self):
+        """A straggler dispatched at round 0 (version 0) lands at round 3
+        — 3 dispatch rounds of wall-clock — but only ONE fold happened in
+        between, so its exact staleness is Δ=1, not 3. The synchronous
+        path's schedule-derived delay would get this wrong; the version
+        tag cannot."""
+        sched = FaultSchedule(slow=0.5, delay=3, seed=0)
+        ctl = ParticipationController(schedule=sched, decay=0.5, async_k=2)
+        base = jnp.ones(4)
+
+        ctl.hold(jnp.full((4,), 2.0), 1.0, [7], 0)
+        assert ctl.pending[0].version_read == 0
+
+        fold_infos = []
+        for rnd in range(4):
+            ctx = _Ctx(gradient=base, count=np.float32(1.0))
+            ctx, fold, info = ctl.async_step(ctx, rnd, sharded=True,
+                                             count=1.0, ids=[rnd])
+            if fold:
+                fold_infos.append(info)
+
+        # folds land at rounds 1 (base + round-0 contrib) and 3
+        # (base + round-2 contrib + the straggler)
+        assert [i["version"] for i in fold_infos] == [1, 2]
+        first, second = fold_infos
+        assert [s["delay"] for s in first["staleness"]] == [0]
+        assert [s["delay"] for s in second["staleness"]] == [0, 1]
+        assert [s["weight"] for s in second["staleness"]] == [1.0, 0.5]
+        straggler = second["staleness"][1]
+        assert straggler["from_round"] == 0, \
+            "the Δ=1 record must be the round-0 straggler (wall-clock 3)"
+        assert straggler["weight"] == staleness_weight(1, 0.5)
+        # conservation after the run: 1 held + 4 dispatched, all folded
+        assert ctl.contributions == 5 and ctl.folded == 5
+        assert not ctl.buffer and not ctl.pending
+
+    def test_attach_participation_async_only(self):
+        """--async_buffer alone (no faults, no cohort target) attaches a
+        controller; absent, the legacy path stays untouched."""
+        args = _args()
+        args.async_buffer = 4
+        fm = FedModel(TinyModel(), _loss, args, input_shape=(3,))
+        ctl = attach_participation(args, fm)
+        assert ctl is not None and fm._participation is ctl
+        assert ctl.async_k == 4
+        assert ctl.schedule is None and ctl.target is None
+
+        args2 = _args()  # no async_buffer attr -> getattr default 0
+        fm2 = FedModel(TinyModel(), _loss, args2, input_shape=(3,))
+        assert attach_participation(args2, fm2) is None
+
+
+# ---------------------------------------------------------------------------
+# per-contribution quarantine (masked fold)
+# ---------------------------------------------------------------------------
+
+class TestMaskedContribution:
+    def test_poisoned_buffered_contribution_masked_and_counted(self):
+        """--inject_fault poisons round 0's transmit; with K=2 that
+        contribution BUFFERS (ok=False at landing) and round 1's fold
+        selects it out — the run stays finite and the drained masked
+        count reaches the controller ledger (never silent)."""
+        ctl = ParticipationController(schedule=None, async_k=2, decay=0.5)
+        fm, opt, engine = _engine(controller=ctl, inject_fault="0:nan")
+        for rnd in range(4):
+            engine.submit(_host_batch([rnd % 4, (rnd + 1) % 4], seed=rnd))
+        engine.drain()
+        assert ctl.masked == 1, \
+            "the poisoned contribution's verdict must drain into masked"
+        assert np.all(np.isfinite(_flat_weights(fm))), \
+            "a NaN contribution must never touch the fold accumulator"
+        assert ctl.folds == 2 and ctl.contributions == 4
+
+    def test_all_masked_fold_degrades_to_zero_update(self):
+        """Denominator clamp: when every fold entry (including a poisoned
+        base) is masked, the fold is 0/max(0,1) = 0 — a zero update, not
+        NaN."""
+        bad = jnp.full((4,), jnp.nan)
+        ok = P._finite_ok(bad)
+        assert not bool(np.asarray(ok))
+        grad = P._masked_fold(jnp.zeros(4), bad, np.float32(1.0), ok)
+        cnt = P._masked_count(np.float32(0.0), np.float32(3.0), ok)
+        out = np.asarray(P._safe_mean(grad, cnt))
+        assert np.all(out == 0.0) and np.all(np.isfinite(out))
+        # and the masked counter twin saw exactly one masked entry
+        n = P._count_masked(np.float32(0.0), ok)
+        assert float(np.asarray(n)) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# zero host syncs with the async plane live
+# ---------------------------------------------------------------------------
+
+class TestZeroSyncAudit:
+    def test_strict_no_syncs_with_buffering_and_folds(self):
+        """The zero-blocking-fetch invariant holds on the async plane:
+        buffering (transmit parked, ids passed as HOST arrays), landing
+        verdicts, and K-folds are all dispatch-side. Warm rounds compile
+        both paths first; then 4 monitored rounds — covering at least one
+        buffer and one fold — must fetch nothing."""
+        ctl = ParticipationController(schedule=None, async_k=2, decay=0.5)
+        fm, opt, engine = _engine(drain_every=100, controller=ctl)
+        for rnd in range(4):
+            engine.submit(_host_batch([rnd % 4, (rnd + 1) % 4], seed=rnd))
+        folds_before = ctl.folds
+        with host_sync_monitor(strict=True) as counter:
+            for rnd in range(4, 8):
+                engine.submit(_host_batch([rnd % 4, (rnd + 1) % 4],
+                                          seed=rnd))
+                assert counter.count == 0, \
+                    f"round {rnd}: {counter.count} blocking host syncs " \
+                    "on the async buffered plane"
+        assert ctl.folds > folds_before, \
+            "the monitored window must have folded"
+        engine.drain()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume mid-buffer
+# ---------------------------------------------------------------------------
+
+class TestCheckpointMidBuffer:
+    def test_mid_buffer_resume_continues_bit_exact(self, tmp_path):
+        """save_run_state with K=3 after 5 rounds leaves 2 landed-but-
+        unfolded contributions and server_version=1 in the part/* seam;
+        the restored run's buffer (sums, version tags, recomputed
+        verdicts) matches and the continuation is bitwise identical."""
+        from commefficient_tpu.federated.checkpoint import (
+            load_run_state,
+            save_run_state,
+        )
+
+        def fresh():
+            ctl = ParticipationController(schedule=None, async_k=3,
+                                          decay=0.5)
+            return (*_engine(controller=ctl), ctl)
+
+        fm1, opt1, engine1, ctl1 = fresh()
+        for rnd in range(5):
+            engine1.submit(_host_batch([rnd % 4, (rnd + 1) % 4], seed=rnd))
+        # rounds 0,1 buffer; round 2 folds; rounds 3,4 buffer again
+        assert len(ctl1.buffer) == 2 and ctl1.server_version == 1
+        path = save_run_state(str(tmp_path / "rs"), fm1, opt1,
+                              engine1.lr_scheduler, next_epoch=1)
+
+        fm2, opt2, engine2, ctl2 = fresh()
+        load_run_state(path, fm2, opt2, engine2.lr_scheduler)
+        assert ctl2.counters() == ctl1.counters()
+        assert ctl2.server_version == ctl1.server_version
+        assert len(ctl2.buffer) == len(ctl1.buffer)
+        for a, b in zip(ctl1.buffer, ctl2.buffer):
+            np.testing.assert_array_equal(np.asarray(a.transmit_sum),
+                                          np.asarray(b.transmit_sum))
+            assert (a.count, a.version_read, a.dispatch_round) == \
+                (b.count, b.version_read, b.dispatch_round)
+            # the verdict is recomputed on device at restore, not shipped
+            assert bool(np.asarray(a.ok)) == bool(np.asarray(b.ok))
+
+        for rnd in range(5, 9):
+            batch = _host_batch([rnd % 4, (rnd + 1) % 4], seed=rnd)
+            engine1.submit(dict(batch))
+            engine2.submit(dict(batch))
+        np.testing.assert_array_equal(
+            _flat_weights(fm1), _flat_weights(fm2),
+            err_msg="mid-buffer resume diverged from the uninterrupted "
+                    "run")
+        assert ctl1.counters() == ctl2.counters()
+
+    def test_pre_async_checkpoint_warns(self, tmp_path):
+        """Resuming a pre-async checkpoint into an --async_buffer run
+        must call out that the buffer/version timeline restarts — not
+        silently pretend the save carried it."""
+        from commefficient_tpu.federated.checkpoint import (
+            load_run_state,
+            save_run_state,
+        )
+
+        ctl1 = ParticipationController(
+            schedule=FaultSchedule(drop=0.2, seed=1))
+        fm1, opt1, engine1 = _engine(controller=ctl1)
+        engine1.submit(_host_batch([0, 1], seed=0))
+        path = save_run_state(str(tmp_path / "rs"), fm1, opt1,
+                              engine1.lr_scheduler, next_epoch=1)
+
+        ctl2 = ParticipationController(
+            schedule=FaultSchedule(drop=0.2, seed=1), async_k=2)
+        fm2, opt2, engine2 = _engine(controller=ctl2)
+        with pytest.warns(UserWarning, match="predates the async plane"):
+            load_run_state(path, fm2, opt2, engine2.lr_scheduler)
+        assert ctl2.server_version == 0 and not ctl2.buffer
+
+
+# ---------------------------------------------------------------------------
+# sync path bit-identity with async off
+# ---------------------------------------------------------------------------
+
+class TestSyncPathBitIdentity:
+    @pytest.mark.parametrize("server_shard", [False, True],
+                             ids=["replicated", "shard"])
+    @pytest.mark.parametrize("fused", [False, True],
+                             ids=["composed", "fused"])
+    def test_matrix(self, monkeypatch, server_shard, fused):
+        """async_buffer=0 through the attached layer is BIT-identical to
+        the layer absent — the parity-matrix pin (row A21) across
+        replicated/--server_shard × composed/--fused_epilogue."""
+        if fused:
+            monkeypatch.setenv("COMMEFFICIENT_FUSED_EPILOGUE", "interpret")
+        over = {}
+        if server_shard:
+            over.update(num_devices=2, server_shard=True)
+        if fused:
+            over["fused_epilogue"] = True
+        runs = {}
+        for layered in (False, True):
+            ctl = (ParticipationController(schedule=None, async_k=0)
+                   if layered else None)
+            fm, opt, engine = _engine(controller=ctl, **over)
+            for rnd in range(4):
+                engine.submit(_host_batch([rnd % 4, (rnd + 1) % 4],
+                                          seed=rnd))
+            runs[layered] = _flat_weights(fm)
+        np.testing.assert_array_equal(runs[False], runs[True])
+
+
+# ---------------------------------------------------------------------------
+# conservation + telemetry: nothing silently dropped, log reproduces
+# ---------------------------------------------------------------------------
+
+class TestConservationAndTelemetry:
+    def test_expiry_audit_conserves_and_log_reproduces(self, tmp_path,
+                                                       capsys):
+        """The bugfix pin: with stragglers held past run end AND a
+        non-empty buffer at the last round, the entrypoint-owned expiry
+        audit (cv_train.py's finally block, replicated here) accounts
+        for EVERY contribution — contributions == folded + async_expired
+        + expired — and the whole async history (folds, versions,
+        staleness, expiry) reproduces from the telemetry JSONL alone via
+        scripts/obs_report.py."""
+        rounds, W, delay, K = 10, 2, 4, 3
+        # a seed with an early straggler (lands, folds stale) and a late
+        # one (due past run end -> expires) — found by replaying the
+        # controller's own draw stream
+        for seed in range(300):
+            pattern = _predict_faults(
+                FaultSchedule(slow=0.4, delay=delay, seed=seed), rounds, W)
+            slow_rounds = [r for r, (_, s, _) in enumerate(pattern)
+                           if s.any()]
+            if (any(r + delay < rounds for r in slow_rounds)
+                    and any(r + delay >= rounds for r in slow_rounds)):
+                break
+        else:
+            raise AssertionError("no suitable seed found")
+
+        sched = FaultSchedule(slow=0.4, delay=delay, seed=seed)
+        ctl = ParticipationController(schedule=sched, decay=0.5,
+                                      async_k=K)
+        fm, opt, engine = _engine(drain_every=1, controller=ctl,
+                                  telemetry=True)
+        rt = RunTelemetry(
+            str(tmp_path / "telemetry.jsonl"),
+            run_info={"mode": fm.args.mode, "grad_size": fm.grad_size,
+                      "guards": False,
+                      "participation": "1.0",
+                      "participation_sampling": "uniform",
+                      "staleness_decay": 0.5,
+                      "client_fault": {"spec": sched.spec()},
+                      "async": {"buffer": K, "staleness_decay": 0.5},
+                      "ledger": collective_ledger(fm.args.mode,
+                                                  fm.grad_size,
+                                                  sketch=fm.sketch)})
+        fm.telemetry = rt
+        engine.telemetry = rt
+        for rnd in range(rounds):
+            engine.submit(_host_batch([rnd % 4, (rnd + 1) % 4], seed=rnd))
+        engine.drain()
+        # the entrypoint-owned end-of-run expiry audit
+        expired = ctl.expire_pending()
+        if expired:
+            rt.event("straggler_expired", count=expired)
+        a_expired = ctl.expire_buffer()
+        if a_expired:
+            rt.event("async_expired", count=a_expired)
+        rt.close()
+
+        c = ctl.counters()
+        assert ctl.slows > 0 and ctl.landed > 0, \
+            "the seed must exercise landings"
+        assert ctl.expired > 0, \
+            "the seed must leave a straggler past run end"
+        assert c["contributions"] == (c["folded"] + ctl.async_expired
+                                      + ctl.expired), \
+            f"conservation violated: {c}"
+
+        import obs_report
+
+        events = obs_report.load_events(str(tmp_path))
+        s = obs_report.summarize(events)["async"]
+        assert s["buffer"] == K and s["staleness_decay"] == 0.5
+        assert s["dispatches"] == rounds
+        assert s["folds"] == c["folds"]
+        assert s["folded_contributions"] == c["folded"]
+        assert s["server_version"] == c["server_version"]
+        assert s["expired"] == ctl.async_expired
+        assert s["masked"] == c["masked"]
+        assert sum(s["staleness_hist"].values()) == \
+            c["folded"] - c["folds"], \
+            "every non-base fold entry must appear in the histogram"
+
+        rc = obs_report.main([str(tmp_path / "telemetry.jsonl")])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "## Async buffered federation" in out
+        tail = json.loads(out.strip().splitlines()[-1])
+        assert tail["async"]["folds"] == c["folds"]
+
+
+# ---------------------------------------------------------------------------
+# heartbeat: buffer depth + staleness visible to the supervisor
+# ---------------------------------------------------------------------------
+
+class TestHeartbeat:
+    def test_buf_stale_fields_round_trip(self, capsys):
+        hb = Heartbeat(enabled=True)
+        hb.round(5, loss=0.25, buffer=3, stale=7)
+        line = capsys.readouterr().err.strip()
+        assert parse_heartbeat(line) == {"round": 5, "loss": 0.25,
+                                         "buf": 3, "stale": 7}
+        hb.round(6)  # sync lines carry no async fields
+        line = capsys.readouterr().err.strip()
+        assert parse_heartbeat(line) == {"round": 6}
+
+    def test_oldest_age_spans_buffer_and_pending(self):
+        sched = FaultSchedule(slow=0.5, delay=10, seed=0)
+        ctl = ParticipationController(schedule=sched, async_k=4)
+        assert ctl.oldest_age(5) == 0
+        ctl.hold(jnp.ones(2), 1.0, [0], 2)
+        assert ctl.oldest_age(5) == 3
+        s = jnp.ones(2)
+        ctl.buffer.append(P.AsyncContribution(
+            transmit_sum=s, count=1.0, ids=np.zeros(1, np.int64),
+            version_read=0, dispatch_round=1, ok=P._finite_ok(s)))
+        assert ctl.oldest_age(5) == 4
+
+    def test_engine_heartbeat_carries_buffer_depth(self, monkeypatch,
+                                                   capsys):
+        monkeypatch.setenv("COMMEFFICIENT_HEARTBEAT", "1")
+        ctl = ParticipationController(schedule=None, async_k=3)
+        fm, opt, engine = _engine(controller=ctl)
+        engine.submit(_host_batch([0, 1], seed=0))
+        engine.drain()
+        beats = [parse_heartbeat(ln)
+                 for ln in capsys.readouterr().err.splitlines()]
+        beats = [b for b in beats if b]
+        assert beats, "the drained round must emit a heartbeat"
+        assert beats[-1]["buf"] == 1, \
+            "one buffered contribution must show as buf=1"
+        assert beats[-1]["stale"] == 1, \
+            "the round-0 contribution is 1 dispatch old"

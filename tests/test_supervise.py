@@ -71,8 +71,10 @@ _CHILD = textwrap.dedent("""
         print("resumed run state from " + step["resume_print"]
               + " (continuing at epoch 1)", flush=True)
     for i in range(step.get("beats", 0)):
-        print(f"HEARTBEAT round={i} loss=1.0", file=sys.stderr,
-              flush=True)
+        line = f"HEARTBEAT round={i} loss=1.0"
+        if "stale" in step:
+            line += f" buf={step.get('buf', 0)} stale={step['stale']}"
+        print(line, file=sys.stderr, flush=True)
         time.sleep(step.get("beat_sleep", 0.02))
     if step.get("hang"):
         time.sleep(3600)
@@ -144,6 +146,27 @@ class TestSupervisor:
         assert timeouts[0]["last_round"] == 1  # beats 0,1 then silence
         restart = _evs(events, "supervisor_restart")
         assert restart and restart[0]["reason"] == "hang"
+
+    def test_stale_buffer_beats_stop_counting_as_liveness(self,
+                                                          fake_child):
+        """Async buffered federation (docs/async.md): attempt 0 keeps
+        dispatching heartbeats forever, but every beat reports an
+        un-folded contribution older than --max-stale — those beats must
+        NOT refresh liveness, so the ordinary hang deadline declares the
+        child wedged and restarts it (a full-but-never-folding buffer
+        cannot read as healthy)."""
+        rc, events, _ = fake_child(
+            [{"beats": 400, "beat_sleep": 0.02, "buf": 3, "stale": 50},
+             {"beats": 2, "rc": 0}],
+            heartbeat_timeout=1.0, max_stale=10)
+        assert rc == 0
+        timeouts = _evs(events, "supervisor_timeout")
+        assert timeouts, "stale beats must not keep the child alive"
+        assert timeouts[0]["last_stale"] == 50
+        restart = _evs(events, "supervisor_restart")
+        assert restart and restart[0]["reason"] == "hang"
+        # and a healthy (stale-free) attempt completes normally
+        assert _evs(events, "supervisor_done")
 
     def test_restart_budget_gives_up(self, fake_child):
         rc, events, _ = fake_child([{"rc": 3}], max_restarts=2)
