@@ -929,6 +929,15 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
         "backend": jax.default_backend(),
         "ledger": ledger,
     }
+    # Multi-tenant run packing (scripts/orchestrate.py, docs/packing.md):
+    # an orchestrated tenant records its fleet identity + pinned run dir
+    # in its OWN run header, so a tenant telemetry log found on disk says
+    # which fleet slot produced it without consulting the fleet JSONL.
+    tenant_id = os.environ.get("COMMEFFICIENT_TENANT_ID")
+    if tenant_id is not None:
+        run_info["tenant"] = tenant_id
+        run_info["run_dir_pinned"] = bool(
+            os.environ.get("COMMEFFICIENT_RUN_DIR"))
     if mesh is not None:
         # mesh topology (docs/multihost.md): which axes exist, their
         # sizes, and their ici/dcn placement — with process_count, the

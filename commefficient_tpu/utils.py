@@ -151,7 +151,17 @@ class Timer:
 
 def make_logdir(args) -> str:
     """Run-directory name encoding the federated config + timestamp
-    (reference utils.py:51-64)."""
+    (reference utils.py:51-64).
+
+    ``COMMEFFICIENT_RUN_DIR`` overrides the derived name verbatim: the
+    multi-tenant orchestrator (scripts/orchestrate.py, docs/packing.md)
+    pins each tenant's run dir through this seam so two tenants started
+    the same second can never collide on the timestamp name — and with
+    it, their telemetry.jsonl and trace_round_* profiler captures (both
+    live under the run dir) stay apart."""
+    pinned = os.environ.get("COMMEFFICIENT_RUN_DIR", "")
+    if pinned:
+        return pinned
     parts = [
         time.strftime("%Y-%m-%d-%H%M%S"),
         f"w{getattr(args, 'num_workers', 0)}",

@@ -449,6 +449,14 @@ def main(argv=None):
     lr_scheduler = LambdaLR(opt, lr_lambda=lambda step: lr_schedule(step / spe))
 
     log_dir = make_logdir(args)
+    if os.environ.get("COMMEFFICIENT_RUN_DIR"):
+        # orchestrated tenant (scripts/orchestrate.py, docs/packing.md):
+        # the run dir — and with it telemetry.jsonl + trace_round_*
+        # captures — is pinned per tenant so fleet neighbors never
+        # collide
+        print(f"run dir pinned by orchestrator: {log_dir} "
+              f"(tenant {os.environ.get('COMMEFFICIENT_TENANT_ID', '?')})",
+              flush=True)
     writer = None
     if args.use_tensorboard:
         try:

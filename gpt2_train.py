@@ -396,6 +396,14 @@ def train(argv=None):
             moe_aux_coef=args.moe_aux_coef if args.n_experts else 0.0)
 
     log_dir = make_logdir(args)
+    if os.environ.get("COMMEFFICIENT_RUN_DIR"):
+        # orchestrated tenant (scripts/orchestrate.py, docs/packing.md):
+        # the run dir — and with it telemetry.jsonl + trace_round_*
+        # captures — is pinned per tenant so fleet neighbors never
+        # collide
+        print(f"run dir pinned by orchestrator: {log_dir} "
+              f"(tenant {os.environ.get('COMMEFFICIENT_TENANT_ID', '?')})",
+              flush=True)
     os.makedirs(log_dir, exist_ok=True)
     tokenizer.save_pretrained(log_dir)
 

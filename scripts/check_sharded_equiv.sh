@@ -112,7 +112,21 @@
 #     unchanged, and run_start mesh-topology telemetry rendered by
 #     obs_report (tests/test_multihost.py — the REAL 2-process
 #     jax.distributed legs gate on a jaxlib whose CPU backend compiles
-#     multi-process computations).
+#     multi-process computations);
+#   - multi-tenant run packing (scripts/orchestrate.py, docs/packing.md):
+#     bounded fair-share admission (deterministic FIFO under
+#     --max-concurrent), the cache-warmup admission gate (first tenant
+#     exclusive until its first heartbeat; the second identical jax
+#     tenant observes a warm shared cache), per-tenant restart isolation
+#     (killing tenant 1 restarts ONLY tenant 1 with --resume auto while
+#     tenants 0/2 heartbeat uninterrupted), the COMMEFFICIENT_RUN_DIR /
+#     _TENANT_ID namespace seams (make_logdir pinning, per-tenant
+#     checkpoint/state dirs), the --max-lead SIGSTOP/SIGCONT fair-share
+#     throttle, and the fleet JSONL conservation audit (admitted ==
+#     finished + gave_up + in_flight) rendered from the log alone by
+#     obs_report --fleet (tests/test_packing.py — the real packed-vs-
+#     sequential cv_train drill with bit-identity is its @slow
+#     TestPackingBench leg / bench.py --run-cfg packing).
 # Any extra args are passed through to pytest (e.g. -k bit_identical).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -125,5 +139,5 @@ exec env JAX_PLATFORMS=cpu \
     tests/test_participation.py tests/test_host_offload.py \
     tests/test_io_faults.py tests/test_integrity.py \
     tests/test_supervise.py tests/test_multihost.py \
-    tests/test_async.py \
+    tests/test_async.py tests/test_packing.py \
     -q -m "not slow" -p no:cacheprovider "$@"

@@ -912,6 +912,148 @@ def compare(path_a: str, path_b: str, out=None) -> Dict[str, Any]:
     return {"a": a, "b": b, "delta": deltas}
 
 
+def load_fleet_events(path: str) -> List[dict]:
+    """Like ``load_events`` but a directory resolves to the
+    orchestrator's ``fleet_events.jsonl`` (scripts/orchestrate.py)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "fleet_events.jsonl")
+    return [e for e in read_events(path)
+            if isinstance(e, dict) and "ev" in e]
+
+
+def summarize_fleet(events: List[dict]) -> Dict[str, Any]:
+    """Reconstruct a packed fleet (docs/packing.md) from the
+    orchestrator's JSONL alone: one row per tenant (admission time,
+    attempts, restarts, rounds, terminal state) plus the aggregate
+    rounds/sec and the conservation audit
+    ``admitted == finished + gave_up + in_flight``."""
+    start = next((e for e in events if e.get("ev") == "fleet_start"), {})
+    done = next((e for e in reversed(events)
+                 if e.get("ev") == "fleet_done"), None)
+    tenants: Dict[int, Dict[str, Any]] = {}
+
+    def trow(i: int) -> Dict[str, Any]:
+        return tenants.setdefault(int(i), {
+            "label": None, "admit_t": None, "starts": 0, "attempts": 0,
+            "restarts": 0, "rounds": 0, "last_round": -1,
+            "progress_t": [], "throttles": 0, "finished": False,
+            "gave_up": False, "poison": 0, "state": "in_flight",
+        })
+
+    for e in events:
+        ev = e.get("ev", "")
+        if not ev.startswith("tenant_") or "tenant" not in e:
+            continue
+        row = trow(e["tenant"])
+        if e.get("label") is not None:
+            row["label"] = e["label"]
+        if ev == "tenant_admit":
+            row["admit_t"] = e.get("t")
+        elif ev == "tenant_start":
+            row["starts"] += 1
+            row["attempts"] = max(row["attempts"],
+                                  int(e.get("attempt", row["starts"])))
+        elif ev == "tenant_progress":
+            row["last_round"] = max(row["last_round"],
+                                    int(e.get("round", -1)))
+            row["rounds"] = max(row["rounds"], int(e.get("beats", 0)))
+            if e.get("t") is not None:
+                row["progress_t"].append(e["t"])
+        elif ev == "tenant_exit":
+            row["last_round"] = max(row["last_round"],
+                                    int(e.get("last_round", -1)))
+        elif ev == "tenant_restart":
+            row["restarts"] += 1
+        elif ev == "tenant_throttle":
+            row["throttles"] += 1
+        elif ev == "tenant_poison":
+            row["poison"] += 1
+        elif ev == "tenant_finish":
+            row["finished"] = True
+            row["state"] = "finished"
+            if e.get("rounds") is not None:
+                row["rounds"] = max(row["rounds"], int(e["rounds"]))
+        elif ev == "tenant_giveup":
+            row["gave_up"] = True
+            row["state"] = "gave_up"
+    admitted = sum(1 for r in tenants.values()
+                   if r["admit_t"] is not None)
+    finished = sum(1 for r in tenants.values() if r["finished"])
+    gave_up = sum(1 for r in tenants.values() if r["gave_up"])
+    in_flight = admitted - finished - gave_up
+    total_rounds = sum(r["rounds"] for r in tenants.values())
+    wall = None
+    if done is not None and start.get("t") is not None:
+        wall = done["t"] - start["t"]
+    out: Dict[str, Any] = {
+        "tenants_declared": start.get("tenants"),
+        "max_concurrent": start.get("max_concurrent"),
+        "cache_dir": start.get("cache_dir"),
+        "warm_admission": start.get("warm_admission"),
+        "admitted": admitted,
+        "finished": finished,
+        "gave_up": gave_up,
+        "in_flight": in_flight,
+        "restarts": sum(r["restarts"] for r in tenants.values()),
+        "total_rounds": total_rounds,
+        "wall_s": round(wall, 3) if wall is not None else None,
+        "rounds_per_sec": (round(total_rounds / wall, 4)
+                           if wall else None),
+        # the conservation audit the fleet log must satisfy: every
+        # admitted tenant is terminal or still in flight, nothing
+        # double-counted, nothing lost
+        "conservation_ok": admitted == finished + gave_up + in_flight
+        and in_flight >= 0,
+        "tenants": {str(i): {k: v for k, v in row.items()
+                             if k != "progress_t"}
+                    for i, row in sorted(tenants.items())},
+    }
+    if done is not None:
+        # the orchestrator's own aggregate, kept alongside the
+        # reconstruction so a disagreement is visible in the JSON tail
+        out["reported"] = {k: done.get(k) for k in
+                           ("admitted", "finished", "gave_up", "restarts",
+                            "total_rounds", "wall_s", "rounds_per_sec")}
+    return out
+
+
+def render_fleet(events: List[dict], out=None) -> Dict[str, Any]:
+    """Human-readable fleet report (per-tenant round table + aggregate
+    rounds/sec) from the orchestrator JSONL alone; returns the
+    ``summarize_fleet`` dict for the machine-readable tail."""
+    out = out or sys.stdout
+    s = summarize_fleet(events)
+    w = lambda line="": print(line, file=out)  # noqa: E731
+    w("# Fleet summary (scripts/orchestrate.py, docs/packing.md)")
+    w()
+    w(f"declared tenants: {s['tenants_declared']}  "
+      f"max_concurrent: {s['max_concurrent']}  "
+      f"warm_admission: {s['warm_admission']}")
+    if s.get("cache_dir"):
+        w(f"shared compile cache: {s['cache_dir']}")
+    w()
+    w("## Fleet tenants")
+    w()
+    w("| tenant | label | attempts | restarts | rounds | last round "
+      "| throttles | state |")
+    w("|---|---|---|---|---|---|---|---|")
+    for i, row in s["tenants"].items():
+        w(f"| {i} | {row['label'] or '?'} | {row['attempts']} "
+          f"| {row['restarts']} | {row['rounds']} | {row['last_round']} "
+          f"| {row['throttles']} | {row['state']} |")
+    w()
+    wall = s["wall_s"]
+    rps = s["rounds_per_sec"]
+    w(f"aggregate: {s['total_rounds']} rounds"
+      + (f" in {wall:.1f}s = {rps:.3f} rounds/s" if wall else
+         " (no fleet_done yet — fleet still running?)"))
+    w(f"conservation: admitted {s['admitted']} == finished "
+      f"{s['finished']} + gave_up {s['gave_up']} + in_flight "
+      f"{s['in_flight']} -> {'OK' if s['conservation_ok'] else 'BROKEN'}")
+    w()
+    return s
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="+",
@@ -927,7 +1069,29 @@ def main(argv=None) -> int:
     ap.add_argument("--compare", action="store_true",
                     help="A/B span/metric delta table between two run "
                          "logs (pass exactly two paths)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render an orchestrator fleet JSONL "
+                         "(fleet_events.jsonl or a fleet dir holding "
+                         "one) as a per-tenant round table + aggregate "
+                         "rounds/sec (scripts/orchestrate.py, "
+                         "docs/packing.md)")
     args = ap.parse_args(argv)
+    if args.fleet:
+        if len(args.paths) != 1:
+            print("--fleet expects exactly one fleet log", file=sys.stderr)
+            return 2
+        try:
+            events = load_fleet_events(args.paths[0])
+        except OSError as e:
+            print(e, file=sys.stderr)
+            return 2
+        if not events:
+            print("no events in fleet log", file=sys.stderr)
+            return 2
+        s = (summarize_fleet(events) if args.json
+             else render_fleet(events))
+        print(json.dumps(s, allow_nan=False))
+        return 0
     if args.compare:
         if len(args.paths) != 2:
             print("--compare needs exactly two run logs", file=sys.stderr)

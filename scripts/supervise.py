@@ -30,6 +30,13 @@ Every decision lands in the supervisor's own flushed JSONL event log
 ``scripts/obs_report.py`` renders as a Supervisor section, so an
 unattended night's restarts reconstruct from the log alone.
 
+The whole ladder lives in the reusable ``ChildRun`` state machine — one
+ladder, two drivers: ``supervise()`` below blocks on a single ChildRun
+(events prefixed ``supervisor_``), and ``scripts/orchestrate.py`` ticks N
+of them concurrently as fleet tenants (events prefixed ``tenant_``,
+docs/packing.md). A dead tenant restarts through exactly this ladder
+without touching its neighbors.
+
 Usage:
     python scripts/supervise.py [--heartbeat-timeout S] [--startup-grace S]
         [--max-restarts N] [--backoff S] [--backoff-max S] [--events PATH]
@@ -155,6 +162,309 @@ def _read_child(proc, watch: _ChildWatch, out) -> None:
         pass
 
 
+class ChildRun:
+    """The reusable child-run lifecycle: spawn → heartbeat liveness →
+    crash/hang detection → relaunch with ``--resume auto`` under
+    exponential backoff → poison-checkpoint exclusion → done/give-up.
+
+    Poll-driven so a driver can hold many of them: ``tick()`` advances
+    the state machine one step (spawn when due, poll the cohort, finish
+    an attempt) and never sleeps — backoff is a *deadline* the next
+    ``tick()`` honors, not a blocking wait. ``supervise()`` ticks one in
+    a loop; ``scripts/orchestrate.py`` ticks one per tenant, which is
+    exactly why a tenant's restart cannot stall its neighbors.
+
+    Decisions surface through ``on_event(kind, **fields)`` with the
+    generic kinds ``launch / cohort_kill / timeout / child_exit / poison
+    / restart / giveup / done`` — each driver prefixes its own namespace
+    (``supervisor_`` / ``tenant_``) without the field names drifting.
+    """
+
+    IDLE = "idle"          # not running: waiting out admission/backoff
+    RUNNING = "running"
+    PAUSED = "paused"      # SIGSTOP'd by the fair-share throttle
+    DONE = "done"
+    GAVE_UP = "gave_up"
+
+    def __init__(self, argv, *, heartbeat_timeout: float = 120.0,
+                 startup_grace: float = 900.0, max_restarts: int = 5,
+                 backoff: float = 2.0, backoff_max: float = 60.0,
+                 max_stale: int = 0, procs: int = 1, env_extra=None,
+                 on_event=None, out=None, tag: str = "[child]"):
+        self.argv = list(argv)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.startup_grace = float(startup_grace)
+        self.max_restarts = int(max_restarts)
+        self.backoff = float(backoff)
+        self.backoff_max = float(backoff_max)
+        self.max_stale = int(max_stale)
+        self.procs = max(1, int(procs))
+        self.env_extra = dict(env_extra or {})
+        self.on_event = on_event
+        self.out = out if out is not None else sys.stdout
+        self.tag = tag
+
+        self.state = ChildRun.IDLE
+        self.next_spawn = 0.0         # monotonic gate for (re)launch
+        self.attempt = 0
+        self.restarts = 0
+        self.excluded: list = []
+        self.final_rc: int = 0        # meaningful once DONE/GAVE_UP
+        self.watch: _ChildWatch | None = None
+        self._strikes: dict = {}
+        self._consec_no_progress = 0
+        self._beats_prev = 0          # beats from completed attempts
+        self._last_round_prev = -1    # high-water round over attempts
+        self._children: list = []
+        self._readers: list = []
+        self._pids: list = []
+        self._t_launch = 0.0
+        self._pause_started = 0.0
+
+    # -- progress accounting (fair-share scheduling reads these) ---------
+
+    @property
+    def beats_total(self) -> int:
+        """Heartbeats across ALL attempts (the fleet's progress unit)."""
+        cur = self.watch.beats if self.watch is not None else 0
+        return self._beats_prev + cur
+
+    @property
+    def last_round(self) -> int:
+        cur = self.watch.last_round if self.watch is not None else -1
+        return max(self._last_round_prev, cur)
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in (ChildRun.DONE, ChildRun.GAVE_UP)
+
+    # -- internals -------------------------------------------------------
+
+    def _event(self, kind: str, **fields) -> None:
+        if self.on_event is not None:
+            self.on_event(kind, **fields)
+
+    def _print(self, msg: str) -> None:
+        try:
+            print(f"{self.tag} {msg}", file=self.out, flush=True)
+        except (OSError, ValueError):
+            pass
+
+    def _spawn(self) -> None:
+        self.attempt += 1
+        argv = list(self.argv)
+        resume = self.attempt > 1 and "--resume" not in argv
+        if resume:
+            argv += ["--resume", "auto"]
+        port = _free_port() if self.procs > 1 else None
+        self._children = []
+        for i in range(self.procs):
+            env = dict(os.environ)
+            env.update(self.env_extra)
+            env["COMMEFFICIENT_HEARTBEAT"] = "1"
+            # the child's stdout is a pipe: without this the resume-
+            # report line sits in a block buffer until (possibly
+            # after) the crash the supervisor needs it to diagnose
+            env["PYTHONUNBUFFERED"] = "1"
+            if self.excluded:
+                env["COMMEFFICIENT_RESUME_EXCLUDE"] = \
+                    os.pathsep.join(self.excluded)
+            if self.procs > 1:
+                # the multi-process env seam
+                # (parallel.mesh.maybe_init_distributed)
+                env["COMMEFFICIENT_NUM_PROCS"] = str(self.procs)
+                env["COMMEFFICIENT_PROC_ID"] = str(i)
+                env["COMMEFFICIENT_COORDINATOR"] = f"127.0.0.1:{port}"
+            self._children.append(subprocess.Popen(
+                argv, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        self._pids = [p.pid for p in self._children]
+        self._print(f"launch attempt={self.attempt} pid(s)={self._pids}"
+                    + (f" coordinator=127.0.0.1:{port}" if port else "")
+                    + (" (--resume auto)" if resume else ""))
+        self._event("launch", attempt=self.attempt, pid=self._pids[0],
+                    pids=self._pids, resume=resume,
+                    excluded=list(self.excluded))
+        # ONE shared watch: any member's heartbeat counts as cohort
+        # liveness (a wedged collective silences every member at once)
+        self.watch = _ChildWatch(max_stale=self.max_stale)
+        self._t_launch = time.monotonic()
+        self._readers = []
+        for p in self._children:
+            r = threading.Thread(target=_read_child,
+                                 args=(p, self.watch, self.out),
+                                 daemon=True)
+            r.start()
+            self._readers.append(r)
+        self.state = ChildRun.RUNNING
+
+    def kill(self) -> None:
+        """SIGKILL every live cohort member (lands on SIGSTOP'd ones
+        too); used for hang recovery and driver shutdown."""
+        for p in self._children:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        for p in self._children:
+            try:
+                p.wait(30)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def pause(self) -> None:
+        """SIGSTOP the cohort (fair-share throttle, docs/packing.md). A
+        paused child cannot heartbeat, so the hang deadline is suspended
+        until ``unpause()``."""
+        if self.state != ChildRun.RUNNING:
+            return
+        import signal
+
+        for p in self._children:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGSTOP)
+                except OSError:
+                    pass
+        self._pause_started = time.monotonic()
+        self.state = ChildRun.PAUSED
+
+    def unpause(self) -> None:
+        if self.state != ChildRun.PAUSED:
+            return
+        import signal
+
+        for p in self._children:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGCONT)
+                except OSError:
+                    pass
+        # the liveness clock must not count the stopped interval as
+        # silence: credit the pause duration to the deadline bases
+        paused_for = time.monotonic() - self._pause_started
+        self._t_launch += paused_for
+        if self.watch is not None and self.watch.last_beat:
+            self.watch.last_beat += paused_for
+        self.state = ChildRun.RUNNING
+
+    def _finish_attempt(self, hang: bool) -> None:
+        watch = self.watch
+        rcs = [p.returncode for p in self._children]
+        rc = (0 if all(r == 0 for r in rcs)
+              else next((r for r in rcs if r not in (0, None)), 1))
+        for r in self._readers:
+            r.join(5)
+        self._event("child_exit", attempt=self.attempt, rc=rc, hang=hang,
+                    rounds_seen=watch.beats, last_round=watch.last_round,
+                    resumed_from=watch.resumed_from or None)
+        # fold the finished attempt into the cross-attempt totals and
+        # drop the live watch so beats_total never double-counts it
+        self._beats_prev += watch.beats
+        self._last_round_prev = max(self._last_round_prev,
+                                    watch.last_round)
+        self.watch = None
+        if rc == 0 and not hang:
+            self.final_rc = 0
+            self.state = ChildRun.DONE
+            self._event("done", attempts=self.attempt,
+                        restarts=self.restarts)
+            self._print(f"child completed (attempt {self.attempt}, "
+                        f"{self.restarts} restart(s))")
+            return
+        # poison-checkpoint bookkeeping: a resume that died before a
+        # SINGLE heartbeat never got past restore/round 1 — two such
+        # strikes exclude the candidate (find_resume_checkpoint's
+        # exclude seam) so the next relaunch falls back to an older
+        # checkpoint instead of crash-looping on this one
+        if watch.resumed_from and watch.beats == 0:
+            s = self._strikes.get(watch.resumed_from, 0) + 1
+            self._strikes[watch.resumed_from] = s
+            if s >= 2 and watch.resumed_from not in self.excluded:
+                self.excluded.append(watch.resumed_from)
+                self._event("poison", path=watch.resumed_from, strikes=s)
+                self._print(f"poison checkpoint excluded after {s} "
+                            f"failed resumes: {watch.resumed_from}")
+        self.restarts += 1
+        if self.restarts > self.max_restarts:
+            self.final_rc = rc if isinstance(rc, int) and rc != 0 else 1
+            self.state = ChildRun.GAVE_UP
+            self._event("giveup", restarts=self.restarts - 1, rc=rc)
+            self._print(f"restart budget exhausted ({self.max_restarts})"
+                        f" — giving up (last rc {rc})")
+            return
+        # exponential backoff over CONSECUTIVE no-progress failures
+        # (an attempt that heartbeat at all resets the exponent —
+        # it was making progress before dying, relaunch promptly)
+        self._consec_no_progress = (self._consec_no_progress + 1
+                                    if watch.beats == 0 else 1)
+        delay = min(self.backoff * (2 ** (self._consec_no_progress - 1)),
+                    self.backoff_max)
+        self._event("restart", attempt=self.attempt,
+                    backoff_s=round(delay, 3),
+                    reason="hang" if hang else "crash")
+        self._print(f"restarting in {delay:g}s "
+                    f"({'hang' if hang else f'crash rc={rc}'}; restart "
+                    f"{self.restarts}/{self.max_restarts})")
+        self.next_spawn = time.monotonic() + delay
+        self.state = ChildRun.IDLE
+
+    def tick(self) -> str:
+        """Advance one step; returns the current state. Never blocks
+        beyond a bounded cohort reap."""
+        if self.terminal or self.state == ChildRun.PAUSED:
+            return self.state
+        if self.state == ChildRun.IDLE:
+            if time.monotonic() >= self.next_spawn:
+                self._spawn()
+            return self.state
+        # RUNNING: one poll pass of the old inner loop
+        watch = self.watch
+        rcs = [p.poll() for p in self._children]
+        if any(r is not None and r != 0 for r in rcs):
+            # a failed member takes the cohort down as a unit:
+            # multi-process jax cannot lose one process and keep
+            # the survivors out of a wedged collective
+            if self.procs > 1 and any(r is None for r in rcs):
+                self._event("cohort_kill", attempt=self.attempt, rcs=rcs)
+                self._print(f"cohort member failed (rcs={rcs}) — "
+                            f"SIGKILL the rest")
+            self.kill()
+            self._finish_attempt(hang=False)
+            return self.state
+        if all(r is not None for r in rcs):
+            self._finish_attempt(hang=False)  # every member exited 0
+            return self.state
+        now = time.monotonic()
+        if watch.beats:
+            silent = now - watch.last_beat
+            deadline = self.heartbeat_timeout
+        else:
+            # pre-first-heartbeat: compile + init legitimately
+            # take a while — a separate (longer) grace applies
+            silent = now - self._t_launch
+            deadline = max(self.heartbeat_timeout, self.startup_grace)
+        if silent > deadline:
+            self._event("timeout", attempt=self.attempt,
+                        silent_s=round(silent, 1),
+                        last_round=watch.last_round,
+                        last_stale=watch.last_stale)
+            stale_note = (
+                f"; oldest un-folded contribution {watch.last_stale} "
+                f"dispatches old (>= --max-stale {watch.max_stale}: "
+                f"beats stopped counting as liveness)"
+                if watch.max_stale
+                and watch.last_stale >= watch.max_stale else "")
+            self._print(f"no (live) heartbeat for {silent:.0f}s "
+                        f"(deadline {deadline:g}s; last round "
+                        f"{watch.last_round}{stale_note}) — SIGKILL "
+                        f"pid(s) {self._pids}")
+            self.kill()
+            self._finish_attempt(hang=True)
+        return self.state
+
+
 def supervise(child_argv, heartbeat_timeout: float = 120.0,
               startup_grace: float = 900.0, max_restarts: int = 5,
               backoff: float = 2.0, backoff_max: float = 60.0,
@@ -169,8 +479,8 @@ def supervise(child_argv, heartbeat_timeout: float = 120.0,
     un-folded contribution — reaches this bound stops counting as
     liveness, so a child that keeps dispatching but never folds is
     declared hung by the ordinary deadline instead of reading healthy
-    forever (0 disables). See the module docstring for the full
-    ladder."""
+    forever (0 disables). Thin blocking driver over ONE ``ChildRun``;
+    the full ladder lives there."""
     out = out if out is not None else sys.stdout
     procs_n = max(1, int(procs))
     log = EventLog(events_path)
@@ -178,167 +488,23 @@ def supervise(child_argv, heartbeat_timeout: float = 120.0,
               heartbeat_timeout=heartbeat_timeout,
               startup_grace=startup_grace, max_restarts=max_restarts,
               backoff=backoff, procs=procs_n, max_stale=max_stale)
-    excluded: list = []
-    strikes: dict = {}
-    restarts = 0
-    attempt = 0
-    consec_no_progress = 0
+    run = ChildRun(child_argv, heartbeat_timeout=heartbeat_timeout,
+                   startup_grace=startup_grace, max_restarts=max_restarts,
+                   backoff=backoff, backoff_max=backoff_max,
+                   max_stale=max_stale, procs=procs_n, out=out,
+                   tag="[supervise]",
+                   on_event=lambda ev, **f: log.event("supervisor_" + ev,
+                                                      **f))
     try:
         while True:
-            attempt += 1
-            argv = list(child_argv)
-            resume = attempt > 1 and "--resume" not in argv
-            if resume:
-                argv += ["--resume", "auto"]
-            port = _free_port() if procs_n > 1 else None
-            children = []
-            for i in range(procs_n):
-                env = dict(os.environ)
-                env["COMMEFFICIENT_HEARTBEAT"] = "1"
-                # the child's stdout is a pipe: without this the resume-
-                # report line sits in a block buffer until (possibly
-                # after) the crash the supervisor needs it to diagnose
-                env["PYTHONUNBUFFERED"] = "1"
-                if excluded:
-                    env["COMMEFFICIENT_RESUME_EXCLUDE"] = \
-                        os.pathsep.join(excluded)
-                if procs_n > 1:
-                    # the multi-process env seam
-                    # (parallel.mesh.maybe_init_distributed)
-                    env["COMMEFFICIENT_NUM_PROCS"] = str(procs_n)
-                    env["COMMEFFICIENT_PROC_ID"] = str(i)
-                    env["COMMEFFICIENT_COORDINATOR"] = f"127.0.0.1:{port}"
-                children.append(subprocess.Popen(
-                    argv, env=env, stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT, text=True))
-            pids = [p.pid for p in children]
-            print(f"[supervise] launch attempt={attempt} pid(s)={pids}"
-                  + (f" coordinator=127.0.0.1:{port}" if port else "")
-                  + (" (--resume auto)" if resume else ""),
-                  file=out, flush=True)
-            log.event("supervisor_launch", attempt=attempt, pid=pids[0],
-                      pids=pids, resume=resume, excluded=list(excluded))
-            # ONE shared watch: any member's heartbeat counts as cohort
-            # liveness (a wedged collective silences every member at once)
-            watch = _ChildWatch(max_stale=max_stale)
-            t_launch = time.monotonic()
-            readers = []
-            for p in children:
-                r = threading.Thread(target=_read_child,
-                                     args=(p, watch, out), daemon=True)
-                r.start()
-                readers.append(r)
-
-            def kill_cohort():
-                for p in children:
-                    if p.poll() is None:
-                        p.kill()  # SIGKILL: lands on SIGSTOP'd ones too
-                for p in children:
-                    try:
-                        p.wait(30)
-                    except subprocess.TimeoutExpired:
-                        pass
-
-            hang = False
-            while True:
-                rcs = [p.poll() for p in children]
-                if any(r is not None and r != 0 for r in rcs):
-                    # a failed member takes the cohort down as a unit:
-                    # multi-process jax cannot lose one process and keep
-                    # the survivors out of a wedged collective
-                    if procs_n > 1 and any(r is None for r in rcs):
-                        log.event("supervisor_cohort_kill",
-                                  attempt=attempt, rcs=rcs)
-                        print(f"[supervise] cohort member failed "
-                              f"(rcs={rcs}) — SIGKILL the rest",
-                              file=out, flush=True)
-                    kill_cohort()
-                    break
-                if all(r is not None for r in rcs):
-                    break  # every member exited 0
-                now = time.monotonic()
-                if watch.beats:
-                    silent = now - watch.last_beat
-                    deadline = heartbeat_timeout
-                else:
-                    # pre-first-heartbeat: compile + init legitimately
-                    # take a while — a separate (longer) grace applies
-                    silent = now - t_launch
-                    deadline = max(heartbeat_timeout, startup_grace)
-                if silent > deadline:
-                    hang = True
-                    log.event("supervisor_timeout", attempt=attempt,
-                              silent_s=round(silent, 1),
-                              last_round=watch.last_round,
-                              last_stale=watch.last_stale)
-                    stale_note = (
-                        f"; oldest un-folded contribution "
-                        f"{watch.last_stale} dispatches old (>= "
-                        f"--max-stale {watch.max_stale}: beats stopped "
-                        f"counting as liveness)"
-                        if watch.max_stale
-                        and watch.last_stale >= watch.max_stale else "")
-                    print(f"[supervise] no (live) heartbeat for "
-                          f"{silent:.0f}s "
-                          f"(deadline {deadline:g}s; last round "
-                          f"{watch.last_round}{stale_note}) — SIGKILL "
-                          f"pid(s) {pids}", file=out, flush=True)
-                    kill_cohort()
-                    break
-                time.sleep(0.25)
-            rcs = [p.returncode for p in children]
-            rc = (0 if all(r == 0 for r in rcs)
-                  else next((r for r in rcs if r not in (0, None)), 1))
-            for r in readers:
-                r.join(5)
-            log.event("supervisor_child_exit", attempt=attempt, rc=rc,
-                      hang=hang, rounds_seen=watch.beats,
-                      last_round=watch.last_round,
-                      resumed_from=watch.resumed_from or None)
-            if rc == 0 and not hang:
-                log.event("supervisor_done", attempts=attempt,
-                          restarts=restarts)
-                print(f"[supervise] child completed (attempt {attempt}, "
-                      f"{restarts} restart(s))", file=out, flush=True)
+            st = run.tick()
+            if st == ChildRun.DONE:
                 return 0
-            # poison-checkpoint bookkeeping: a resume that died before a
-            # SINGLE heartbeat never got past restore/round 1 — two such
-            # strikes exclude the candidate (find_resume_checkpoint's
-            # exclude seam) so the next relaunch falls back to an older
-            # checkpoint instead of crash-looping on this one
-            if watch.resumed_from and watch.beats == 0:
-                s = strikes.get(watch.resumed_from, 0) + 1
-                strikes[watch.resumed_from] = s
-                if s >= 2 and watch.resumed_from not in excluded:
-                    excluded.append(watch.resumed_from)
-                    log.event("supervisor_poison",
-                              path=watch.resumed_from, strikes=s)
-                    print(f"[supervise] poison checkpoint excluded "
-                          f"after {s} failed resumes: "
-                          f"{watch.resumed_from}", file=out, flush=True)
-            restarts += 1
-            if restarts > max_restarts:
-                log.event("supervisor_giveup", restarts=restarts - 1,
-                          rc=rc)
-                print(f"[supervise] restart budget exhausted "
-                      f"({max_restarts}) — giving up (last rc {rc})",
-                      file=out, flush=True)
-                return rc if isinstance(rc, int) and rc != 0 else 1
-            # exponential backoff over CONSECUTIVE no-progress failures
-            # (an attempt that heartbeat at all resets the exponent —
-            # it was making progress before dying, relaunch promptly)
-            consec_no_progress = (consec_no_progress + 1
-                                  if watch.beats == 0 else 1)
-            delay = min(backoff * (2 ** (consec_no_progress - 1)),
-                        backoff_max)
-            log.event("supervisor_restart", attempt=attempt,
-                      backoff_s=round(delay, 3),
-                      reason="hang" if hang else "crash")
-            print(f"[supervise] restarting in {delay:g}s "
-                  f"({'hang' if hang else f'crash rc={rc}'}; restart "
-                  f"{restarts}/{max_restarts})", file=out, flush=True)
-            time.sleep(delay)
+            if st == ChildRun.GAVE_UP:
+                return run.final_rc
+            time.sleep(0.05 if st == ChildRun.IDLE else 0.25)
     finally:
+        run.kill()
         log.close()
 
 

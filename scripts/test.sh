@@ -34,6 +34,21 @@ cd "$(dirname "$0")/.."
 # "Troubleshooting" note documents). conftest.py uses setdefault, so the
 # env set here wins.
 if [ "${COMMEFFICIENT_PERSISTENT_CACHE:-0}" != "1" ]; then
+  # Stale-dir sweep (run-packing PR satellite): the EXIT trap below never
+  # fires on SIGKILL / OOM / a hard machine reset, so a crashed run leaks
+  # its per-run cache dir forever — on long-lived machines that
+  # accumulates gigabytes of dead caches. Sweep sibling run caches older
+  # than COMMEFFICIENT_CACHE_SWEEP_MIN minutes (default 240, i.e. well
+  # past any plausible live run; 0 disables). Age-gating keeps a
+  # concurrently RUNNING sibling's younger cache safe, and the prefix
+  # match can only ever touch our own run-scoped dirs (README
+  # Troubleshooting documents the manual recovery).
+  SWEEP_MIN="${COMMEFFICIENT_CACHE_SWEEP_MIN:-240}"
+  if [ "$SWEEP_MIN" != "0" ]; then
+    find "${TMPDIR:-/tmp}" -maxdepth 1 -type d \
+      -name 'commefficient_jax_cache_run_*' -mmin +"$SWEEP_MIN" \
+      -exec rm -rf {} + 2>/dev/null
+  fi
   CACHE_DIR=$(mktemp -d "${TMPDIR:-/tmp}/commefficient_jax_cache_run_XXXXXX")
   export JAX_COMPILATION_CACHE_DIR="$CACHE_DIR"
   trap 'rm -rf "$CACHE_DIR"' EXIT

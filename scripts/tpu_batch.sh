@@ -97,6 +97,12 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # fold timing ride the next window; both are cheap add-ons (the capture
 # is latency-simulation + small jitted folds; the A/B reuses no heavy
 # compile).
+# NOTE (run-packing PR): the packing_ab step prices the shared-compile-
+# cache mechanism ON SILICON (cold vs warm persistent-cache load of one
+# compile chain in fresh subprocesses — docs/packing.md); the full
+# packed-fleet A/B stays CPU-only because the single axon chip serializes
+# tenant claims (bench.py --run-cfg packing is the gated CPU leg). Cheap
+# add-on: no heavy compile class, rides any window.
 # NOTE (multihost PR): the multihost capture + multihost_ab A/B (the 2D
 # clients x shard server plane under the per-mesh-axis quantized plan
 # vs the fp32 plan — docs/multihost.md) need >= 4 devices, so they wait
@@ -106,7 +112,7 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
 coalesce telemetry watch downlink straggler async clients_sweep io_faults \
 integrity participation host_offload_scale watch_ab io_faults_ab \
-integrity_ab async_ab multihost multihost_ab \
+integrity_ab async_ab packing_ab multihost multihost_ab \
 compressed_collectives stream_sketch sketch_coalesce fused_epilogue \
 learning profile profile_fused profile_stream profile_coalesce \
 profile_gpt2 host_offload imagenet ops"}
@@ -284,6 +290,22 @@ for step in $STEPS; do
           && grep -q "async fold d=124" "$OUT/tpu_measure_async.log"
       then
         mark_done async_ab
+      fi
+      ;;
+    packing_ab)
+      # shared-compile-cache warm-load A/B (docs/packing.md): one
+      # compile chain built cold into a fresh persistent cache, then
+      # re-built warm from a second fresh subprocess — the on-silicon
+      # price of what orchestrate.py's warm admission harvests
+      log "step $i: tpu_measure.py packing cache A/B (timeout 20m)"
+      timeout 1200 python scripts/tpu_measure.py packing \
+        >"$OUT/tpu_measure_packing.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_packing.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "packing A/B:" "$OUT/tpu_measure_packing.log"
+      then
+        mark_done packing_ab
       fi
       ;;
     multihost_ab)
