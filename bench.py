@@ -1465,6 +1465,162 @@ def run_packing_measurement(n_tenants: int = 3, workdir: str = "",
     return out
 
 
+def run_serving_measurement(workdir: str = "", gate: float = 1.50,
+                            load_interval: float = 0.2):
+    """Child-process entry (--run-cfg serving): the serving-interference
+    A/B of docs/service.md — one tiny cv_train run solo vs the SAME run
+    (same seed) with a live serving replica (scripts/serve.py) tracking
+    its checkpoint dir and a query load loop hammering the file queue
+    the whole time. The replica is read-only by construction (weights
+    loaded from drained snapshots, pin lease instead of file moves), so
+    the training trajectory must stay bit-identical; the wall-clock
+    ratio prices what the replica's polling + request traffic cost the
+    trainer on a shared host.
+
+    CPU by design (the crash_matrix child env, same reasoning as the
+    packing leg): the mechanism measured — snapshot-handoff polling,
+    pin-lease I/O, request/response file traffic — is identical on both
+    backends; tpu_measure.py's ``serving`` leg prices it on silicon.
+
+    Gates (asserted in-leg): final weights bit-identical solo vs
+    served; wall-clock ratio <= ``gate``; the replica answered at least
+    one query, hot-swapped at least once, and its model_version stream
+    (rebuilt from serving.jsonl by obs_report — the report path IS the
+    verifier) is monotone."""
+    import shutil
+    import tempfile
+    import threading
+
+    sys.path.insert(0, os.path.join(_REPO_DIR, "scripts"))
+    import crash_matrix as cm
+    import obs_report
+
+    own_workdir = not workdir
+    workdir = workdir or tempfile.mkdtemp(prefix="commefficient_serving_")
+    data = os.path.join(workdir, "data")
+    os.makedirs(data, exist_ok=True)
+
+    def leg_argv(ckpt: str) -> list:
+        argv = cm.train_argv(data, ckpt, shard=False)
+        argv += ["--num_epochs", "1"]  # last flag wins
+        return argv
+
+    # --- leg A: solo baseline
+    solo_ckpt = os.path.join(workdir, "solo", "ckpt")
+    t0 = time.perf_counter()
+    cm.run_to_completion(leg_argv(solo_ckpt), timeout=1800)
+    solo_wall = time.perf_counter() - t0
+    _log(f"serving solo leg: {solo_wall:.1f}s")
+
+    # --- leg B: same run with a live replica + query load
+    live_ckpt = os.path.join(workdir, "live", "ckpt")
+    serve_dir = os.path.join(workdir, "serve")
+    stop_file = os.path.join(workdir, "serve.stop")
+    os.makedirs(live_ckpt, exist_ok=True)
+    replica = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO_DIR, "scripts", "serve.py"),
+         "--checkpoint_path", live_ckpt, "--serve_dir", serve_dir,
+         "--owner", "bench", "--poll_interval", "0.05",
+         "--stop_file", stop_file, "--deadline_s", "1800"],
+        env=cm.child_env(), cwd=_REPO_DIR, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+
+    from commefficient_tpu.federated.serving import (
+        read_response,
+        submit_request,
+    )
+
+    queries = {"sent": 0, "answered": 0}
+    done = threading.Event()
+
+    def load_loop():
+        # steady query load for the whole training run — every answer
+        # carries the model_version the replica served it from
+        seed = 0
+        while not done.is_set():
+            rid = submit_request(serve_dir, op="query", probe_seed=seed)
+            queries["sent"] += 1
+            seed += 1
+            resp = read_response(serve_dir, rid, timeout=10, poll=0.02)
+            if "error" not in resp:
+                queries["answered"] += 1
+            done.wait(load_interval)
+
+    loader = threading.Thread(target=load_loop, daemon=True)
+    loader.start()
+    try:
+        t0 = time.perf_counter()
+        cm.run_to_completion(leg_argv(live_ckpt), timeout=1800)
+        live_wall = time.perf_counter() - t0
+    finally:
+        done.set()
+        loader.join(timeout=30)
+        with open(stop_file, "w") as f:
+            f.write("done")
+        try:
+            replica.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            replica.kill()
+    _log(f"serving live leg: {live_wall:.1f}s "
+         f"({queries['answered']}/{queries['sent']} queries answered)")
+
+    # the report path IS the verifier: rebuild the replica's story from
+    # serving.jsonl alone (docs/service.md acceptance)
+    sv = obs_report.summarize(obs_report.load_events(
+        os.path.join(serve_dir, "serving.jsonl")))["serving"]
+    assert sv is not None, "replica wrote no serving.jsonl events"
+    assert sv["answers"] > 0 and queries["answered"] > 0, (
+        f"replica answered nothing (log {sv['answers']}, "
+        f"client-side {queries['answered']}) — queue or snapshot "
+        f"discovery is wedged")
+    # error answers are legitimate pre-first-snapshot ("no model yet"),
+    # but at least one query must have been served FROM a model
+    assert sv["answers"] > sv["errors"], (
+        f"every answer was an error ({sv['errors']}/{sv['answers']}) — "
+        "the replica never served from a loaded snapshot")
+    assert sv["swaps"] >= 1, (
+        "replica never hot-swapped a snapshot — checkpoint discovery "
+        "is wedged (run saved every 3 rounds)")
+    assert sv["versions_monotone"], (
+        f"served model_version stream is not monotone: "
+        f"swaps {sv['swap_versions']}")
+
+    # serving is read-only: the trained trajectory must not move
+    cm.assert_identical(cm.final_weights(solo_ckpt),
+                        cm.final_weights(live_ckpt),
+                        "serving leg (live replica) vs solo baseline")
+
+    ratio = live_wall / solo_wall
+    out = {
+        "serving_metric": (
+            "tiny cv_train wall-clock solo vs with a live serving "
+            "replica (scripts/serve.py: snapshot handoff + pin lease + "
+            "file-queue query load every "
+            f"{load_interval:g}s; docs/service.md)"),
+        "serving_solo_s": round(solo_wall, 2),
+        "serving_live_s": round(live_wall, 2),
+        "serving_overhead_ratio": round(ratio, 3),
+        "serving_queries_sent": queries["sent"],
+        "serving_answers": sv["answers"],
+        "serving_errors": sv["errors"],
+        "serving_qps": sv["qps"],
+        "serving_latency_ms_p50": sv["latency_ms_p50"],
+        "serving_swaps": sv["swaps"],
+        "serving_final_version": sv["final_version"],
+        "serving_versions_monotone": True,   # asserted above
+        "serving_bit_identical": True,       # assert_identical raised
+        "platform": "cpu",  # by design; see docstring
+    }
+    assert ratio <= gate, (
+        f"serving interference {ratio:.2f}x > gate {gate:g}x — the "
+        f"replica's polling/IO is stealing too much from the trainer "
+        f"(solo {solo_wall:.1f}s, live {live_wall:.1f}s)")
+    if own_workdir:
+        shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps(out), flush=True)
+    return out
+
+
 # --------------------------------------------------------------------------
 # parent orchestration
 # --------------------------------------------------------------------------
@@ -1900,6 +2056,13 @@ if __name__ == "__main__":
             # children, CPU by design (one process per chip claim)
             run_packing_measurement()
             sys.exit(0)
+        if sel == "serving":
+            # serving-interference A/B: tiny cv_train solo vs with a
+            # live serving replica + query load (snapshot handoff, pin
+            # lease, file queue); wall-clock over real children, CPU by
+            # design (docs/service.md)
+            run_serving_measurement()
+            sys.exit(0)
         # the allowlist IS the leg table — a hand-maintained copy here
         # silently orphaned the coalesce/straggler captures (their
         # children exited "unknown config" while the parent reported a
@@ -1909,7 +2072,8 @@ if __name__ == "__main__":
             # parent orchestration and claim the chip for a headline bench
             sys.exit(f"--run-cfg: unknown config {sel!r}; use "
                      + "|".join(sorted(_CFG_LEGS))
-                     + "|clients_sweep|io_faults|integrity|async|packing")
+                     + "|clients_sweep|io_faults|integrity|async|packing"
+                       "|serving")
         run_config_measurement(sel)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--capture":

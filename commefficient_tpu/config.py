@@ -406,6 +406,20 @@ def build_parser(default_lr=None) -> argparse.ArgumentParser:
                         help="Max requeues per client per epoch for "
                              "dropped-client data before the drop is "
                              "abandoned (participation layer).")
+    # Open-world population churn (federated/participation.py,
+    # docs/service.md): clients register and depart mid-run; the sampler
+    # draws from the LIVE population only, and on the disk state tier the
+    # row store allocates/retires/compacts rows to track it. Off =
+    # closed population, bit-identical legacy path (parity row A22).
+    parser.add_argument("--churn", type=str, default="",
+                        help="Seeded population-churn schedule "
+                             "'join=R,depart=R,init=F,seed=N,compact=N': "
+                             "R = expected clients per round (Poisson "
+                             "draws), init = fraction registered at "
+                             "round 0, compact = disk-tier hole count "
+                             "that triggers checkpoint-time row-store "
+                             "compaction. Empty = closed population "
+                             "(docs/service.md).")
     # Asynchronous buffered federation (docs/async.md): remove the round
     # barrier — cohorts dispatch continuously and the server folds a
     # buffered update whenever K contributions have landed (FedBuff,
@@ -679,6 +693,16 @@ def validate_args(args):
                   "per-client velocity/error/stale-weight state does not "
                   "advance for a straggler cohort "
                   "(docs/fault_tolerance.md)")
+    churn_spec = (getattr(args, "churn", "") or "").strip()
+    if churn_spec:
+        from commefficient_tpu.federated.participation import parse_churn
+
+        parse_churn(churn_spec)
+        assert args.train_dataloader_workers == 0, (
+            "--churn needs --train_dataloader_workers 0: the sampler "
+            "steps the churn clock in-order on the main thread, and a "
+            "prefetch thread would have drawn rounds past the churn "
+            "point (same constraint as --inject_client_fault)")
     # continuous-observability surface (docs/observability.md): fail fast
     # on malformed watch-rule / trace-window specs, not rounds into a run
     if getattr(args, "watch_rules", ""):

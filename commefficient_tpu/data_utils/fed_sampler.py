@@ -70,6 +70,10 @@ class FedSampler:
         self._permuted = None   # active epoch's within-client permutation
         self._cursor = None     # active epoch's per-client consumption
         self._pending_state = None
+        # open-world churn (federated/participation.PopulationManager,
+        # docs/service.md): None = closed population, the untouched
+        # legacy path
+        self._population = None
 
     def _draw_cohort(self, alive, n, remaining):
         """One round's cohort of ``n`` clients from the ``alive`` set.
@@ -119,14 +123,46 @@ class FedSampler:
             self._retry[:] = 0
         self._permuted, self._cursor = permuted, cursor
 
+        pop = self._population
         while True:
-            alive = np.where((cursor < data_per_client)
-                             & ~self._quarantined)[0]
+            has_data = (cursor < data_per_client) & ~self._quarantined
+            if pop is None:
+                alive = np.where(has_data)[0]
+            else:
+                # one churn step per cohort draw (the manager's clock);
+                # only the LIVE population is sampleable — departed
+                # clients never, joiners from the round after their
+                # registration (docs/service.md)
+                pop.step()
+                alive = np.where(has_data & pop.live)[0]
+                spins = 0
+                while (len(alive) == 0
+                       and np.any(has_data & pop.joinable())):
+                    # live population is (momentarily) empty but future
+                    # joiners still hold unserved data: idle-spin the
+                    # churn clock until someone arrives, bounded so a
+                    # mis-specified schedule fails loudly
+                    spins += 1
+                    if spins > pop.MAX_IDLE_SPIN:
+                        raise RuntimeError(
+                            f"--churn: live population stayed empty for "
+                            f"{spins} churn rounds with joiners still "
+                            f"pending — join rate too low to ever refill "
+                            f"the pool?")
+                    pop.step(idle=True)
+                    alive = np.where(has_data & pop.live)[0]
             if len(alive) == 0:
                 return
             target = (self.num_workers if self.participation is None
                       else min(int(self.participation), self.num_workers))
             n = min(target, len(alive))
+            if (pop is not None and self.participation is not None
+                    and n < target
+                    and np.any(has_data & ~pop.live)):
+                # churn (not epoch exhaustion) left the pool short of the
+                # participation target: clamp — the data-weighted round
+                # mean makes the smaller cohort exact — and count it
+                pop.note_cohort_short(target, n)
             workers = self._draw_cohort(
                 alive, n, data_per_client[alive] - cursor[alive])
             remaining = data_per_client[workers] - cursor[workers]

@@ -104,6 +104,21 @@ class FedLoader:
             return self.steps_per_epoch()
         return int(np.ceil(len(self.dataset) / self.val_batch_size))
 
+    def _pad_id(self, workers):
+        """Client id used for the inert padding lanes of a short cohort.
+        The legacy closed-population value is 0 (kept byte-for-byte:
+        client 0 always owns row 0 there, and masked lanes scatter an
+        exactly-zero delta, so a padding collision with a sampled client
+        is a no-op by construction). Under open-world churn
+        (--churn, docs/service.md) client 0 may be departed or
+        never-registered — no row to gather — so padding reuses a LIVE
+        cohort member instead: same zero-delta inertness, but the row
+        directory can always translate it."""
+        if getattr(self.sampler, "_population", None) is not None \
+                and len(workers):
+            return int(workers[0])
+        return 0
+
     def _fetch(self, idx_list):
         items = []
         for i in idx_list:
@@ -129,7 +144,7 @@ class FedLoader:
         W, B = self.num_workers, self.batch_pad
         for workers, idx_lists in self.sampler.iter_structured():
             n = len(workers)
-            client_ids = np.zeros(W, np.int32)
+            client_ids = np.full(W, self._pad_id(workers), np.int32)
             client_ids[:n] = workers
             worker_mask = np.zeros(W, np.float32)
             worker_mask[:n] = 1.0
@@ -207,7 +222,7 @@ class FedLoader:
         access = self.dataset.native_train_access()
         for workers, idx_lists in self.sampler.iter_structured():
             n = len(workers)
-            client_ids = np.zeros(W, np.int32)
+            client_ids = np.full(W, self._pad_id(workers), np.int32)
             client_ids[:n] = workers
             worker_mask = np.zeros(W, np.float32)
             worker_mask[:n] = 1.0

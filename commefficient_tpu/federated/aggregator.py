@@ -574,6 +574,11 @@ class FedModel:
         # untouched legacy path (bit-identical trajectories, pinned in
         # tests/test_participation.py).
         self._participation = None
+        # open-world population churn (--churn, docs/service.md): set by
+        # participation.attach_churn — drives the sampler's live mask,
+        # the disk-tier row directory, the heartbeat population= field,
+        # and the pop/* checkpoint keys. None = closed population.
+        self._population = None
         # async buffered federation (--async_buffer, docs/async.md): set
         # by begin_round when a dispatch only BUFFERS its contribution —
         # _apply_server then skips the server phase for that dispatch
@@ -900,6 +905,15 @@ class FedModel:
             batch, late_batch, cohort_info = part.apply_faults(batch,
                                                                round_no)
             wmask = np.asarray(batch["worker_mask"])
+        pop = self._population
+        if pop is not None and self.telemetry is not None:
+            # churn records buffered by the sampler-side PopulationManager
+            # (churn_join / churn_depart / cohort_short) become telemetry
+            # events keyed to the engine round that sampled the changed
+            # population — the obs_report Churn section reads them back
+            for ev in pop.pop_events():
+                kind = ev.pop("kind")
+                self.telemetry.event(kind, round=round_no, **ev)
         live = wmask > 0
         if late_batch is not None:
             # stragglers DO participate (their contribution lands late,

@@ -256,6 +256,18 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
         meta_participation = p_meta
     else:
         meta_participation = None
+    # open-world churn (--churn, federated/participation.py,
+    # docs/service.md): the population masks + churn RNG ride pop/* keys;
+    # the disk-tier row DIRECTORY rides the .rows snapshot's store.json
+    # below (one atomic pair — restore cross-checks them). Churn-off runs
+    # write no pop/* keys, so their checkpoints stay byte-identical to
+    # pre-churn ones.
+    pop = getattr(fm, "_population", None)
+    if pop is not None:
+        pop_arrays, meta_population = pop.state_payload()
+        arrays.update({"pop/" + k: v for k, v in pop_arrays.items()})
+    else:
+        meta_population = None
     if fm._simple_download:
         arrays["acct/updated_since_init"] = canon(fm._updated_since_init)
     else:
@@ -287,6 +299,8 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
     }
     if meta_participation is not None:
         meta["participation"] = meta_participation
+    if meta_population is not None:
+        meta["population"] = meta_population
     if mid_epoch is not None:
         sampler = mid_epoch.get("sampler")
         assert sampler is not None, (
@@ -333,6 +347,15 @@ def save_run_state(path: str, fed_model, optimizer, lr_scheduler,
         tmp_rows = stem + ".tmp.rows"
         if os.path.isdir(tmp_rows):
             shutil.rmtree(tmp_rows)
+        if getattr(store, "directory", None) is not None:
+            # open-world churn (docs/service.md): the save point IS the
+            # drain barrier the row lifecycle needs — every in-flight
+            # scatter has landed, so retired rows can zero + join the
+            # free pool now, and compaction (when the hole threshold is
+            # reached) rewrites the backing files so THIS snapshot
+            # records the packed layout + directory in one atomic pair
+            store.flush_retired()
+            store.maybe_compact()
         store_meta = store.save_snapshot(tmp_rows)
         store_meta["dir"] = os.path.basename(stem) + ".rows"
         if os.path.isdir(stem + ".rows"):
@@ -471,13 +494,48 @@ def _run_state_files(checkpoint_path: str):
     return sorted(cands, key=key, reverse=True)
 
 
+def pinned_run_states(checkpoint_path: str) -> set:
+    """Checkpoints a live reader currently PINS (absolute paths): every
+    ``*.pin`` file in the checkpoint dir is a JSON lease
+    ``{"paths": [...], "owner": ...}`` written atomically by a serving
+    replica (federated/serving.py) and removed when it releases. An
+    unreadable pin file pins NOTHING it names but is reported — a torn
+    lease must not silently protect (or expose) a checkpoint forever."""
+    pinned = set()
+    try:
+        names = os.listdir(checkpoint_path)
+    except OSError:
+        return pinned
+    for n in names:
+        if not n.endswith(".pin"):
+            continue
+        fn = os.path.join(checkpoint_path, n)
+        try:
+            with open(fn) as f:
+                lease = json.load(f)
+            for p in lease.get("paths", []):
+                if not os.path.isabs(p):
+                    p = os.path.join(checkpoint_path, p)
+                pinned.add(os.path.abspath(p))
+        except (OSError, ValueError) as e:
+            print(f"ignoring unreadable pin file {fn}: {e}")
+    return pinned
+
+
 def prune_run_states(checkpoint_path: str, keep: int) -> None:
     """``--keep_checkpoints N`` retention: drop all but the newest N
     run-state files. ``keep`` <= 0 keeps everything (the default, so
-    existing workflows are unchanged)."""
+    existing workflows are unchanged). Checkpoints named by a live
+    ``*.pin`` lease (a serving replica mid-handoff, docs/service.md) are
+    never deleted — long-lived serving must not race checkpoint GC — and
+    do not count against ``keep``."""
     if not keep or keep <= 0:
         return
+    pinned = pinned_run_states(checkpoint_path)
     for path in _run_state_files(checkpoint_path)[keep:]:
+        if os.path.abspath(path) in pinned:
+            print(f"keeping pinned run state {path} (serving lease)")
+            continue
         try:
             os.remove(path)
             # a disk-tier checkpoint's row snapshot lives beside the .npz
@@ -843,6 +901,31 @@ def load_run_state(path: str, fed_model, optimizer, lr_scheduler,
             "this run injects client faults but the checkpoint predates "
             "the participation layer; the fault schedule restarts from "
             "its seed")
+    # open-world churn (--churn, docs/service.md): population masks +
+    # churn RNG from the pop/* keys. A churn-on resume from a churn-off
+    # checkpoint restarts the schedule from its seed (warn — the
+    # fault-schedule precedent; on the disk tier restore_snapshot already
+    # failed loudly on the missing directory before reaching here). A
+    # churn-off resume from a churn-on checkpoint warns and ignores (the
+    # disk tier again fails loudly upstream).
+    pop = getattr(fm, "_population", None)
+    pop_flat = {k[len("pop/"):]: flat.pop(k) for k in list(flat)
+                if k.startswith("pop/")}
+    if meta.get("population") is not None:
+        if pop is not None:
+            pop.restore_state(pop_flat, meta["population"])
+        else:
+            import warnings
+
+            warnings.warn(
+                "checkpoint carries population-churn state but this run "
+                "has no --churn; the closed-population run ignores it")
+    elif pop is not None:
+        import warnings
+
+        warnings.warn(
+            "this run churns the population but the checkpoint predates "
+            "the churn layer; the churn schedule restarts from its seed")
     if fm._simple_download:
         fm._updated_since_init = resident(flat["acct/updated_since_init"])
     else:

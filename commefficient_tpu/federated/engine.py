@@ -251,10 +251,16 @@ class PipelinedRoundEngine:
                     hb_stale = part.oldest_age(
                         getattr(self.model, "rounds_dispatched",
                                 self._next_index))
+                # open-world churn (--churn, docs/service.md): the live
+                # population rides the line so a supervisor sees the
+                # churn trajectory without the telemetry log; None (and
+                # absent) for a closed population
+                pop = getattr(self.model, "_population", None)
+                hb_pop = pop.population if pop is not None else None
                 self.heartbeat.round(
                     rn, loss=hb_loss,
                     guard_ok=getattr(self.model, "last_guard_ok", None),
-                    buffer=hb_buf, stale=hb_stale)
+                    buffer=hb_buf, stale=hb_stale, population=hb_pop)
             if self.telemetry is not None:
                 self.telemetry.on_drained(rn,
                                           time.monotonic() - t_fetch)

@@ -41,6 +41,7 @@ what it reads.  ``COMMEFFICIENT_COHORT_PREFETCH=0`` is the kill-switch.
 from __future__ import annotations
 
 import errno
+import heapq
 import json
 import os
 import queue
@@ -544,6 +545,127 @@ class _PendingStream:
         return self._value
 
 
+class RowDirectory:
+    """Client-id → physical-row indirection for an open-world population
+    (docs/service.md): rows are ALLOCATED when a client registers,
+    RETIRED into reusable holes when it departs, and the backing file is
+    COMPACTED (live rows packed down, holes punched above) at checkpoint
+    boundaries once enough holes accumulate.
+
+    Lifecycle safety is split in two phases because scatters for
+    in-flight rounds are not yet enqueued when a departure is drawn:
+    ``retire`` only removes the mapping (the sampler never draws the
+    client again, so its row goes cold), and the physical zero-write +
+    hole reuse happen at the next DRAIN BARRIER (``flush_pending`` via
+    ``MemmapRowStore.flush_retired``, called after the engine has
+    drained) — a straggler's scatter therefore always lands on its
+    original row before that row can be zeroed or handed to a joiner.
+
+    Without a directory attached the store translates ids 1:1 (churn
+    off = the exact pre-lifecycle path, bit-identical by construction —
+    docs/parity_matrix.md row A22).
+    """
+
+    def __init__(self, capacity: int, compact_after: int = 0):
+        self.capacity = int(capacity)
+        # auto-compaction threshold in reusable holes (0 = only explicit
+        # compact() calls); checked by MemmapRowStore.maybe_compact at
+        # checkpoint-save boundaries
+        self.compact_after = int(compact_after)
+        self._row_of: Dict[int, int] = {}
+        self._free: list = []     # zeroed holes, reusable (lowest first)
+        self._pending: list = []  # retired rows awaiting the drain barrier
+        self._high = 0            # rows ever handed out (high-water mark)
+        self.allocated_total = 0
+        self.retired_total = 0
+        self.compactions = 0
+
+    @property
+    def live_count(self) -> int:
+        return len(self._row_of)
+
+    def holes(self) -> int:
+        """Reusable + pending-retire holes (the compaction trigger)."""
+        return len(self._free) + len(self._pending)
+
+    def row_of(self, cid: int) -> int:
+        return self._row_of[int(cid)]
+
+    def client_ids(self) -> list:
+        """Sorted client ids that currently own a row (the restore-time
+        cross-check against the population masks)."""
+        return sorted(self._row_of)
+
+    def translate(self, ids: np.ndarray) -> np.ndarray:
+        """Map a cohort's client ids to physical rows (the gather/scatter
+        seam). A departed or never-registered id here is an upstream
+        sampling bug — fail loudly, never read someone else's row."""
+        try:
+            return np.fromiter((self._row_of[int(c)] for c in ids),
+                               np.int64, count=len(ids))
+        except KeyError as e:
+            raise KeyError(
+                f"client {e.args[0]} has no allocated row — sampled "
+                f"while departed/unregistered?") from None
+
+    def allocate(self, cid: int) -> int:
+        cid = int(cid)
+        assert cid not in self._row_of, f"client {cid} already has a row"
+        if self._free:
+            row = heapq.heappop(self._free)
+        else:
+            row = self._high
+            assert row < self.capacity, (
+                f"row store full: {self.capacity} rows allocated and no "
+                f"reusable holes (compaction pending?)")
+            self._high += 1
+        self._row_of[cid] = row
+        self.allocated_total += 1
+        return row
+
+    def retire(self, cid: int) -> int:
+        row = self._row_of.pop(int(cid))
+        self._pending.append(row)
+        self.retired_total += 1
+        return row
+
+    def flush_pending(self) -> list:
+        """Hand the pending-retire rows over for zeroing and make them
+        reusable. ONLY call behind a drain barrier (see class docstring);
+        ``MemmapRowStore.flush_retired`` owns that contract."""
+        rows, self._pending = self._pending, []
+        for row in rows:
+            heapq.heappush(self._free, row)
+        return rows
+
+    def state(self) -> dict:
+        """JSON-able state riding the row-store snapshot's meta blob
+        (``checkpoint.save_run_state`` → ``meta_json['client_store']``)."""
+        return {"capacity": self.capacity,
+                "compact_after": self.compact_after,
+                "rows": {str(c): int(r) for c, r in self._row_of.items()},
+                "free": [int(r) for r in self._free],
+                "pending": [int(r) for r in self._pending],
+                "high": int(self._high),
+                "allocated_total": int(self.allocated_total),
+                "retired_total": int(self.retired_total),
+                "compactions": int(self.compactions)}
+
+    def load_state(self, state: dict) -> None:
+        assert int(state["capacity"]) == self.capacity, (
+            f"checkpoint directory capacity {state['capacity']} != this "
+            f"run's {self.capacity} — different client population?")
+        self._row_of = {int(c): int(r)
+                        for c, r in state["rows"].items()}
+        self._free = [int(r) for r in state["free"]]
+        heapq.heapify(self._free)
+        self._pending = [int(r) for r in state["pending"]]
+        self._high = int(state["high"])
+        self.allocated_total = int(state["allocated_total"])
+        self.retired_total = int(state["retired_total"])
+        self.compactions = int(state["compactions"])
+
+
 class MemmapRowStore:
     """Out-of-core ``(num_clients, *row)`` client state: one sparse
     memory-mapped-style row file per allocated state member, with the
@@ -732,6 +854,9 @@ class MemmapRowStore:
         self._jitter_rng = np.random.RandomState(0xC0FFEE)
         self._coalesce = os.environ.get("COMMEFFICIENT_IO_COALESCE",
                                         "1") != "0"
+        # optional id→row indirection (open-world churn, docs/service.md);
+        # None = identity translation, the exact pre-lifecycle path
+        self._directory: Optional[RowDirectory] = None
         self._fatal: Optional[BaseException] = None
         self._inflight = None        # (op, member, row, t0) under the raw op
         self._cur_pending: Optional[_PendingStream] = None
@@ -1282,6 +1407,20 @@ class MemmapRowStore:
                         self._read_row(name, row, "scatter") + d[slot])
             self.last_scatter_ms = (time.perf_counter() - t0) * 1e3
             self.scatters += 1
+        elif kind == "retire":
+            # zero retired physical rows so a later reuse starts a fresh
+            # client from the base representation (rows store deltas off
+            # init_rows — zero delta IS the fresh state). Rides the same
+            # write ladder as a scatter; FIFO ordering after the barrier
+            # flush_retired requires means every in-flight scatter to
+            # these rows has already landed.
+            for row in payload:
+                row = int(row)
+                for name in self._fd:
+                    self._write_row(name, row,
+                                    np.zeros(self.row_shapes[name],
+                                             np.float32))
+                self._row_fails.pop(row, None)
         elif kind == "scrub":
             self._run_scrub(payload)
         else:  # "barrier"
@@ -1363,6 +1502,12 @@ class MemmapRowStore:
         assert not self._closed, "gather on a closed row store"
         self._check_fatal()
         ids = np.asarray(ids, np.int64)
+        if self._directory is not None:
+            # translate ONCE, on the dispatch thread: the StreamedRound
+            # carries physical rows from here on, so the round's eventual
+            # scatter(stream, ...) writes back to the same rows even if
+            # the client departs (mapping removed) while it is in flight
+            ids = self._directory.translate(ids)
         pending = _PendingStream(store=self)
         self._put(("gather", time.monotonic(), (ids, pending)))
         return pending
@@ -1458,12 +1603,114 @@ class MemmapRowStore:
         self.close_report = report
         return report
 
+    # -- row lifecycle (open-world population churn, docs/service.md) --------
+
+    def attach_directory(self, directory: RowDirectory) -> None:
+        """Arm id→row indirection. The attach layer runs right after
+        FedModel construction — nothing has been gathered yet, so every
+        subsequent op goes through the translation. Without this call the
+        store translates 1:1 (churn off = the exact pre-lifecycle path)."""
+        assert directory.capacity <= self.num_rows, (
+            f"directory capacity {directory.capacity} exceeds the store's "
+            f"{self.num_rows} allocated rows")
+        self._directory = directory
+
+    @property
+    def directory(self) -> Optional[RowDirectory]:
+        return self._directory
+
+    def flush_retired(self) -> int:
+        """Zero the pending-retired rows and make them reusable holes.
+        ONLY call behind a drain barrier (checkpoint saves, compaction,
+        teardown): scatters for in-flight rounds are not enqueued until
+        those rounds finish, so a retired row may still receive its
+        straggler's delta until the engine has drained. The zero-writes
+        ride the ordered worker queue, so anything enqueued afterwards
+        (a joiner reusing the hole) observes fresh zero rows."""
+        d = self._directory
+        if d is None or not d._pending:
+            return 0
+        rows = d.flush_pending()
+        self._put(("retire", time.monotonic(), rows))
+        with self._ev_lock:
+            self._events.append({"kind": "rows_retired",
+                                 "rows": len(rows)})
+        return len(rows)
+
+    def maybe_compact(self) -> Optional[dict]:
+        """Compact when the directory's hole count has reached its
+        ``compact_after`` threshold — called by ``save_run_state`` right
+        before the snapshot copy, so compaction is checkpoint-coordinated
+        by construction: the next ``.rows`` snapshot records the packed
+        layout plus the updated directory, and a crash between the two
+        is impossible (same drain-first save path)."""
+        d = self._directory
+        if d is None or d.compact_after <= 0 \
+                or d.holes() < d.compact_after:
+            return None
+        return self.compact()
+
+    def compact(self) -> dict:
+        """Pack live rows down to ``[0, live)`` (ascending by physical
+        row, so every move is downward and never overwrites an unmoved
+        live row), punch the backing files back to holes above, and
+        rebase the directory. Runs on the caller thread behind a full
+        drain (the worker is idle); moves go through the laddered
+        read/write path, so fault injection and CRC verification cover
+        the rewrite too. The old-layout snapshot can no longer repair
+        rows, so it is disarmed until the next checkpoint re-arms one."""
+        d = self._directory
+        assert d is not None, "compact() requires an attached RowDirectory"
+        self.drain()
+        d.flush_pending()  # the rewrite itself reclaims them — no zero-write
+        reclaimed = len(d._free)
+        live = sorted(d._row_of.items(), key=lambda kv: kv[1])
+        mapping: Dict[int, int] = {}
+        moved = 0
+        for new_row, (cid, old_row) in enumerate(live):
+            mapping[old_row] = new_row
+            if old_row != new_row:
+                # unconditional write: position new_row may hold a
+                # retired row's stale bytes (retire zero-writes are
+                # skipped when compaction will rewrite anyway)
+                for name in self._fd:
+                    self._write_row(
+                        name, new_row,
+                        self._read_row(name, old_row, "compact"))
+                moved += 1
+            d._row_of[cid] = new_row
+        n = len(live)
+        for name, fd in self._fd.items():
+            nb = self._row_nbytes[name]
+            os.ftruncate(fd, n * nb)
+            os.ftruncate(fd, self.num_rows * nb)
+            if self._crc is not None:
+                self._crc[name][n:] = self._zero_crc[name]
+        # consecutive-failure counts follow their rows; holes drop out
+        self._row_fails = {mapping[r]: c for r, c in self._row_fails.items()
+                           if r in mapping}
+        self._snap = None
+        for dirty in self._dirty.values():
+            dirty[:] = False
+        d._free = []
+        d._high = n
+        d.compactions += 1
+        stats = {"live": n, "moved": moved, "holes_reclaimed": reclaimed}
+        with self._ev_lock:
+            self._events.append(dict(stats, kind="rows_compacted"))
+        return stats
+
     # -- whole-array access (cross-tier checkpoint restore) -----------------
 
     def write_full(self, name: str, array: np.ndarray) -> None:
         """Overwrite one member from a full in-memory array (restoring an
         hbm/host-tier checkpoint into a disk-tier run). Subtracts the
         member's init row so the stored-delta representation is preserved."""
+        if self._directory is not None:
+            raise RuntimeError(
+                "cross-tier restore into a store with an active client "
+                "directory (--churn) is not supported — the full array "
+                "is id-ordered but physical rows are directory-mapped")
         self.drain()
         base = self.init_rows.get(name)
         nb = self._row_nbytes[name]
@@ -1541,6 +1788,10 @@ class MemmapRowStore:
                 members[name]["init"] = True
         meta = {"backend": self.backend, "rows": self.num_rows,
                 "members": members}
+        if self._directory is not None:
+            # the id→row table is part of the rows' meaning: a snapshot
+            # of packed/holed physical rows is unreadable without it
+            meta["directory"] = self._directory.state()
         with open(os.path.join(snap_dir, "store.json"), "w") as f:
             json.dump(meta, f)
         if self._crc is not None:
@@ -1597,6 +1848,18 @@ class MemmapRowStore:
         assert set(saved) == set(self._fd), (
             f"checkpoint row store members {sorted(saved)} != this "
             f"config's {sorted(self._fd)}")
+        if self._directory is not None:
+            if "directory" not in meta:
+                raise RuntimeError(
+                    "--churn resume from a checkpoint that carries no "
+                    "client directory — was it written by a churn-off "
+                    "run? Restart without --churn or from scratch.")
+            self._directory.load_state(meta["directory"])
+        elif "directory" in meta:
+            raise RuntimeError(
+                "checkpoint row store carries a client directory (the "
+                "run that wrote it had --churn on) — resume with the "
+                "same --churn spec so ids map to the right rows.")
         for name, m in saved.items():
             # geometry must match BEFORE any bytes move: a different row
             # shape with the same member set and row count would pass the

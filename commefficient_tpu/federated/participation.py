@@ -112,10 +112,14 @@ import jax.numpy as jnp
 __all__ = [
     "SAMPLING_CHOICES",
     "AsyncContribution",
+    "ChurnSchedule",
     "FaultSchedule",
     "LateCohort",
     "ParticipationController",
+    "PopulationManager",
+    "attach_churn",
     "attach_participation",
+    "parse_churn",
     "parse_client_fault",
     "parse_participation",
     "staleness_weight",
@@ -854,3 +858,358 @@ def attach_participation(args, fed_model, sampler=None):
     print("participation layer: " + "; ".join(parts)
           + " (docs/fault_tolerance.md)")
     return ctl
+
+
+# ---------------------------------------------------------------------------
+# Open-world population churn (--churn, docs/service.md): clients REGISTER
+# and DEPART mid-run instead of the closed num_clients universe every FL
+# paper assumes — the always-on-service regime the practicality survey
+# (arXiv:2405.20431) names as the gap between FL papers and FL systems.
+# The universe of POTENTIAL clients is still the dataset's num_clients
+# (their shards exist up front); churn decides WHO of them is sampleable
+# WHEN. A departed client is never sampled again (open-world departures are
+# permanent for the run); a joiner registers at churn round r and enters
+# the sampling pool at round r+1. On the disk state tier the manager drives
+# host_state.RowDirectory — joiners allocate rows (reusing retired holes),
+# departures retire them — so the backing files track the LIVE population,
+# not the all-time one.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ChurnSchedule:
+    """Seeded population-churn schedule (``--churn``).
+
+    ``join`` / ``depart`` are EXPECTED clients per round — each round
+    draws the actual counts from Poisson(rate) on the schedule's own
+    RandomState, so the trajectory is deterministic in ``seed`` and
+    independent of every other RNG stream. ``init`` is the fraction of
+    the client universe registered before round 0 (the rest form the
+    join pool). ``compact`` is the disk-tier hole threshold: when at
+    least that many retired rows have accumulated, the next checkpoint
+    compacts the row store (0 = never compact)."""
+
+    join: float = 0.0
+    depart: float = 0.0
+    init: float = 1.0
+    seed: int = 0
+    compact: int = 0
+
+    @property
+    def active(self) -> bool:
+        return bool(self.join or self.depart or self.init < 1.0)
+
+    def spec(self) -> str:
+        return (f"join={self.join:g},depart={self.depart:g},"
+                f"init={self.init:g},seed={self.seed},"
+                f"compact={self.compact}")
+
+
+def parse_churn(spec: str) -> ChurnSchedule:
+    """``--churn`` grammar → ChurnSchedule.
+
+    ``'join=R,depart=R,init=F,seed=N,compact=N'`` — every key optional,
+    the schedule must actually churn something (join/depart > 0 or
+    init < 1), and a population that starts empty needs a join rate to
+    ever become non-empty. Fails at parse time with the offending entry
+    named, not rounds into a run."""
+    fields: Dict[str, Any] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            key, val = (x.strip() for x in part.split("="))
+        except ValueError:
+            raise ValueError(
+                f"--churn: bad entry {part!r}; expected KEY=VALUE with "
+                f"KEY in join|depart|init|seed|compact") from None
+        if key in ("join", "depart"):
+            r = float(val)
+            assert r >= 0.0, f"--churn: {key}={val} must be >= 0"
+            fields[key] = r
+        elif key == "init":
+            f = float(val)
+            assert 0.0 <= f <= 1.0, (
+                f"--churn: init={val} must be in [0, 1]")
+            fields[key] = f
+        elif key in ("seed", "compact"):
+            fields[key] = int(val)
+        else:
+            raise ValueError(
+                f"--churn: unknown key {key!r}; use "
+                f"join|depart|init|seed|compact")
+    sched = ChurnSchedule(**fields)
+    assert sched.active, (
+        "--churn: schedule churns nothing (join=0, depart=0, init=1); "
+        "omit the flag for a closed population")
+    assert sched.compact >= 0, "--churn: compact must be >= 0"
+    assert sched.init > 0.0 or sched.join > 0.0, (
+        "--churn: init=0 with join=0 is a forever-empty population")
+    return sched
+
+
+class PopulationManager:
+    """Open-world population state: who is registered, live, departed —
+    and, on the disk tier, which backing-file row each live client owns
+    (host_state.RowDirectory). Stepped by ``FedSampler._gen`` exactly
+    once per cohort draw (main thread, in-order — the same
+    ``--train_dataloader_workers 0`` contract as requeue), so the churn
+    timeline is deterministic and rides checkpoints bit-exactly
+    (``pop/*`` keys in ``save_run_state``)."""
+
+    # idle-spin bound: an empty live population waits for joiners at most
+    # this many churn rounds before the run fails loudly instead of
+    # spinning forever on a mis-specified schedule
+    MAX_IDLE_SPIN = 100_000
+
+    def __init__(self, schedule: ChurnSchedule, num_clients: int,
+                 store=None, sampler=None):
+        self.schedule = schedule
+        self.num_clients = int(num_clients)
+        self.sampler = sampler
+        self.rng = np.random.RandomState(schedule.seed)
+        self.registered = np.zeros(self.num_clients, bool)
+        self.departed = np.zeros(self.num_clients, bool)
+        # live = sampleable NOW; pending joiners are registered but enter
+        # the pool one round later ("sampled after their registration
+        # round")
+        self.live = np.zeros(self.num_clients, bool)
+        self._pending_join = np.array([], np.int64)
+        self.round = 0          # churn rounds stepped (own clock)
+        self.joins = 0          # post-init registrations
+        self.departs = 0
+        self.cohort_short = 0   # rounds the live pool undershot the target
+        self.idle_rounds = 0    # empty-population rounds spent waiting
+        self._events: List[dict] = []
+        self.store = store
+        self.directory = None
+        if store is not None:
+            from commefficient_tpu.federated.host_state import RowDirectory
+
+            d = RowDirectory(capacity=store.num_rows,
+                             compact_after=schedule.compact)
+            store.attach_directory(d)
+            self.directory = d
+        # initial population: a seeded uniform subset, registered before
+        # round 0 and sampleable immediately (rows allocated in ascending
+        # cid order — the deterministic layout tests pin)
+        if schedule.init >= 1.0:
+            first = np.arange(self.num_clients, dtype=np.int64)
+        else:
+            n0 = int(round(schedule.init * self.num_clients))
+            first = (np.sort(self.rng.choice(self.num_clients, size=n0,
+                                             replace=False)).astype(np.int64)
+                     if n0 > 0 else np.array([], np.int64))
+        self.initial = int(len(first))
+        self.registered[first] = True
+        self.live[first] = True
+        if self.directory is not None:
+            for c in first:
+                self.directory.allocate(int(c))
+
+    # -- the churn clock ---------------------------------------------------
+
+    @property
+    def population(self) -> int:
+        """Registered-and-not-departed count (live + pending joiners) —
+        the heartbeat's ``population=`` field."""
+        return int(self.registered.sum() - self.departed.sum())
+
+    def joinable(self) -> np.ndarray:
+        """Mask of clients that can still ENTER the pool: pending joiners
+        plus (when the schedule joins at all) the never-registered pool.
+        The sampler's empty-population wait spins only while one of these
+        still holds unserved data."""
+        mask = np.zeros(self.num_clients, bool)
+        mask[self._pending_join] = True
+        if self.schedule.join > 0:
+            mask |= ~self.registered
+        return mask
+
+    def step(self, idle: bool = False) -> None:
+        """One churn round: activate last round's joiners, then draw this
+        round's departures and registrations. ``idle`` marks a spin round
+        the sampler spent waiting for a non-empty population (counted,
+        bounded by MAX_IDLE_SPIN at the call site)."""
+        self.round += 1
+        if idle:
+            self.idle_rounds += 1
+        if len(self._pending_join):
+            self.live[self._pending_join] = True
+            self._pending_join = np.array([], np.int64)
+        sch = self.schedule
+        if sch.depart > 0:
+            pool = np.where(self.live)[0]
+            n = min(int(self.rng.poisson(sch.depart)), len(pool))
+            if n:
+                gone = np.sort(self.rng.choice(pool, size=n, replace=False))
+                self.live[gone] = False
+                self.departed[gone] = True
+                self.departs += n
+                if self.directory is not None:
+                    # the mapping dies NOW (never sampled again); the
+                    # physical row retires at the next drain barrier
+                    # (host_state.MemmapRowStore.flush_retired) so an
+                    # in-flight straggler's scatter still lands on it
+                    for c in gone:
+                        self.directory.retire(int(c))
+                self._events.append({
+                    "kind": "churn_depart", "churn_round": self.round,
+                    "clients": [int(c) for c in gone],
+                    "population": self.population})
+        if sch.join > 0:
+            pool = np.where(~self.registered)[0]
+            n = min(int(self.rng.poisson(sch.join)), len(pool))
+            if n:
+                new = np.sort(self.rng.choice(pool, size=n, replace=False))
+                self.registered[new] = True
+                self.joins += n
+                if self.directory is not None:
+                    # the row allocates at REGISTRATION (possibly reusing
+                    # a zeroed hole — zero row == fresh client state by
+                    # the store's delta-off-base construction), one round
+                    # before the first possible sample
+                    for c in new:
+                        self.directory.allocate(int(c))
+                self._pending_join = new
+                self._events.append({
+                    "kind": "churn_join", "churn_round": self.round,
+                    "clients": [int(c) for c in new],
+                    "population": self.population})
+
+    def note_cohort_short(self, target: int, got: int) -> None:
+        """Churn left the live pool smaller than the participation
+        target this round: the cohort CLAMPS (the data-weighted round
+        mean makes the smaller cohort exact, same as partial
+        participation) and the shortfall is counted, never silent."""
+        self.cohort_short += 1
+        self._events.append({"kind": "cohort_short", "target": int(target),
+                             "got": int(got),
+                             "population": self.population})
+
+    def pop_events(self) -> List[dict]:
+        """Drain buffered churn records (the aggregator relays them to
+        telemetry with the engine's round number attached)."""
+        out, self._events = self._events, []
+        return out
+
+    # -- conservation audit ------------------------------------------------
+
+    def audit(self) -> Dict[str, Any]:
+        """End-of-run conservation audit: every client that ever
+        registered is exactly one of active / departed / quarantined.
+        ``ok`` cross-checks the mask arithmetic against the live mask AND
+        the running counters — a drifted mask or lost event breaks it."""
+        registered = int(self.registered.sum())
+        departed = int(self.departed.sum())
+        live_now = self.live.copy()
+        live_now[self._pending_join] = True
+        q_mask = np.zeros(self.num_clients, bool)
+        if self.sampler is not None:
+            q_mask = np.asarray(self.sampler._quarantined, bool)
+        quarantined = int(np.count_nonzero(
+            q_mask & self.registered & ~self.departed))
+        active = int(np.count_nonzero(live_now & ~q_mask))
+        ok = (registered == active + departed + quarantined
+              and registered == self.initial + self.joins
+              and departed == self.departs)
+        out = {"registered": registered, "active": active,
+               "departed": departed, "quarantined": quarantined,
+               "ok": bool(ok), "initial": self.initial,
+               "joins": self.joins, "departs": self.departs,
+               "cohort_short": self.cohort_short,
+               "idle_rounds": self.idle_rounds,
+               "churn_rounds": self.round}
+        if self.directory is not None:
+            out["rows_live"] = self.directory.live_count
+            out["rows_holes"] = self.directory.holes()
+            out["compactions"] = self.directory.compactions
+        return out
+
+    # -- checkpoint seam (pop/* keys in save_run_state) --------------------
+
+    def state_payload(self) -> Tuple[Dict[str, np.ndarray], dict]:
+        arrays = {
+            "registered": self.registered.copy(),
+            "departed": self.departed.copy(),
+            "live": self.live.copy(),
+            "pending_join": np.asarray(self._pending_join, np.int64),
+        }
+        _, keys, pos, has_gauss, cached = self.rng.get_state()
+        arrays["rng_keys"] = keys
+        arrays["rng_meta"] = np.asarray([pos, has_gauss], np.int64)
+        arrays["rng_cached"] = np.asarray([cached], np.float64)
+        meta = {"spec": self.schedule.spec(), "round": self.round,
+                "initial": self.initial, "joins": self.joins,
+                "departs": self.departs,
+                "cohort_short": self.cohort_short,
+                "idle_rounds": self.idle_rounds}
+        return arrays, meta
+
+    def restore_state(self, arrays: Dict[str, np.ndarray],
+                      meta: dict) -> None:
+        """Inverse of ``state_payload``. The RowDirectory restores
+        separately (it rides the ``.rows`` snapshot's store.json); this
+        re-checks the two against each other, because the ``.npz`` and
+        ``.rows`` land by separate renames and a crash between them can
+        pair files from different saves."""
+        if meta.get("spec") != self.schedule.spec():
+            import warnings
+
+            warnings.warn(
+                f"--churn spec changed across resume "
+                f"({meta.get('spec')!r} -> {self.schedule.spec()!r}); "
+                f"the churn timeline continues under the new schedule")
+        self.registered = np.asarray(arrays["registered"], bool).copy()
+        self.departed = np.asarray(arrays["departed"], bool).copy()
+        self.live = np.asarray(arrays["live"], bool).copy()
+        self._pending_join = np.asarray(arrays["pending_join"],
+                                        np.int64).copy()
+        pos, has_gauss = (int(x) for x in arrays["rng_meta"])
+        self.rng.set_state(("MT19937", arrays["rng_keys"], pos, has_gauss,
+                            float(arrays["rng_cached"][0])))
+        self.round = int(meta.get("round", 0))
+        self.initial = int(meta.get("initial", 0))
+        self.joins = int(meta.get("joins", 0))
+        self.departs = int(meta.get("departs", 0))
+        self.cohort_short = int(meta.get("cohort_short", 0))
+        self.idle_rounds = int(meta.get("idle_rounds", 0))
+        if self.directory is not None:
+            have = np.zeros(self.num_clients, bool)
+            for c in self.directory.client_ids():
+                have[c] = True
+            expect = self.registered & ~self.departed
+            assert np.array_equal(have, expect), (
+                "client directory and population masks disagree after "
+                "restore — the .rows snapshot and the run-state .npz are "
+                "from different saves; fall back to an older checkpoint")
+
+
+def attach_churn(args, fed_model, sampler):
+    """Entrypoint hook (cv_train/gpt2_train, after the aggregator built
+    its state tier): parse ``--churn``, build the PopulationManager
+    against the sampler's client universe, wire the disk-tier row
+    directory when one exists, and attach to both the model (heartbeat,
+    checkpoint, audit) and the sampler (per-round stepping). Returns the
+    manager, or None when the flag is unset — the sampler then runs the
+    untouched closed-population path, bit-identical to pre-churn code."""
+    spec = (getattr(args, "churn", "") or "").strip()
+    if not spec:
+        return None
+    assert sampler is not None, (
+        "--churn needs the federated sampler (does this loader build "
+        "one?) — the sampler steps the churn clock")
+    schedule = parse_churn(spec)
+    pm = PopulationManager(
+        schedule, num_clients=int(sampler.dataset.num_clients),
+        store=getattr(fed_model, "_row_store", None), sampler=sampler)
+    fed_model._population = pm
+    sampler._population = pm
+    tier = ("disk row directory" if pm.directory is not None
+            else "mask-only (id==row on this state tier)")
+    print(f"churn layer: {schedule.spec()} over "
+          f"{pm.num_clients} potential clients, "
+          f"{pm.population} registered at round 0; {tier} "
+          f"(docs/service.md)")
+    return pm

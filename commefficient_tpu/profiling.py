@@ -54,16 +54,20 @@ HEARTBEAT_RE = re.compile(
     r"(?: loss=(\S+))?"
     r"(?: guard=(ok|TRIP))?"
     r"(?: buf=(\d+))?"
-    r"(?: stale=(\d+))?")
+    r"(?: stale=(\d+))?"
+    r"(?: population=(\d+))?"
+    r"(?: serve_lag=(\d+))?")
 
 
 def parse_heartbeat(line: str):
     """Parse one ``Heartbeat.round`` stderr line; None for non-heartbeat
     lines. Returns ``{"round": int}`` plus whichever optional fields the
-    line carried (``epoch`` int, ``loss`` float, ``guard_ok`` bool, and —
+    line carried (``epoch`` int, ``loss`` float, ``guard_ok`` bool; —
     async buffered federation, docs/async.md — ``buf`` int buffer depth
-    and ``stale`` int dispatch-age of the oldest un-folded
-    contribution)."""
+    and ``stale`` int dispatch-age of the oldest un-folded contribution;
+    — always-on service, docs/service.md — ``population`` int live
+    population under ``--churn`` and ``serve_lag`` int newest-minus-
+    served model version from a serving replica)."""
     m = HEARTBEAT_RE.match(line.strip())
     if m is None:
         return None
@@ -81,6 +85,10 @@ def parse_heartbeat(line: str):
         out["buf"] = int(m.group(5))
     if m.group(6) is not None:
         out["stale"] = int(m.group(6))
+    if m.group(7) is not None:
+        out["population"] = int(m.group(7))
+    if m.group(8) is not None:
+        out["serve_lag"] = int(m.group(8))
     return out
 
 
@@ -115,13 +123,18 @@ class Heartbeat:
               loss: float | None = None,
               guard_ok: bool | None = None,
               buffer: int | None = None,
-              stale: int | None = None) -> None:
+              stale: int | None = None,
+              population: int | None = None,
+              serve_lag: int | None = None) -> None:
         """``buffer``/``stale`` (async buffered federation, docs/async.md)
         carry the landed-but-unfolded buffer depth and the dispatch-age of
         the oldest un-folded contribution, so a full-but-never-folding
         buffer is visible to the supervisor's hang detection
         (scripts/supervise.py --max-stale) even while dispatch heartbeats
-        keep ticking."""
+        keep ticking. ``population`` (--churn) is the live population;
+        ``serve_lag`` (a serving replica's heartbeat, docs/service.md) is
+        newest-available minus currently-served model version — a wedged
+        replica beats with a growing lag instead of going silent."""
         if not self.enabled:
             return
         line = f"HEARTBEAT round={index}"
@@ -135,6 +148,10 @@ class Heartbeat:
             line += f" buf={buffer}"
         if stale is not None:
             line += f" stale={stale}"
+        if population is not None:
+            line += f" population={population}"
+        if serve_lag is not None:
+            line += f" serve_lag={serve_lag}"
         print(line, file=sys.stderr, flush=True)
 
 

@@ -972,6 +972,21 @@ def attach_run_telemetry(args, fed_model, log_dir: str,
             "quarantine_after": sched.quarantine_after}
     else:
         run_info["client_fault"] = None
+    # Open-world population churn (--churn, docs/service.md): the seeded
+    # schedule in the run header — spec + seed IS the whole population
+    # trajectory, so the obs_report Churn section reproduces it from the
+    # log alone (same auditability contract as the fault schedule)
+    churn_spec = (getattr(args, "churn", "") or "").strip()
+    if churn_spec:
+        from commefficient_tpu.federated.participation import parse_churn
+
+        csched = parse_churn(churn_spec)
+        run_info["churn"] = {
+            "spec": csched.spec(), "join": csched.join,
+            "depart": csched.depart, "init": csched.init,
+            "seed": csched.seed, "compact": csched.compact}
+    else:
+        run_info["churn"] = None
     # Async buffered federation (--async_buffer, docs/async.md): the
     # fold threshold + decay in the run header, so a logged async run's
     # buffer/staleness story reproduces from the log alone (obs_report's

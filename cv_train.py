@@ -46,7 +46,10 @@ from commefficient_tpu.federated.checkpoint import (
     save_round_state,
 )
 from commefficient_tpu.federated.losses import make_cv_losses
-from commefficient_tpu.federated.participation import attach_participation
+from commefficient_tpu.federated.participation import (
+    attach_churn,
+    attach_participation,
+)
 from commefficient_tpu.profiling import StepProfiler
 from commefficient_tpu.telemetry import attach_run_telemetry
 from commefficient_tpu.ops.flat import ravel_pytree
@@ -214,6 +217,12 @@ def run_batches(model, opt, lr_scheduler, loader, training, epoch_fraction,
                 return np.nan, np.nan, np.nan, np.nan
         finally:
             prof.close()
+        if not losses and getattr(model, "_population", None) is not None:
+            # open-world end state (--churn, docs/service.md): the live
+            # population emptied before this epoch produced a single
+            # cohort and no joiner can ever refill it — a clean end of
+            # training, not a NaN trajectory
+            return None, None, client_download, client_upload
         return (np.mean(losses), np.mean(accs), client_download,
                 client_upload)
     for batch in loader:
@@ -246,6 +255,10 @@ def train(model, opt, lr_scheduler, train_loader, test_loader, args, writer,
             args, epoch=epoch,
             resume_mid=(resume_mid if epoch == start_epoch else None),
             totals=(total_download, total_upload))
+        if train_loss is None:
+            print("ending training: live population is empty with no "
+                  "pending joiners (--churn open-world end state)")
+            break
         if np.isnan(train_loss):
             print("TERMINATING TRAINING DUE TO NAN LOSS")
             return
@@ -442,6 +455,11 @@ def main(argv=None):
     pc = attach_participation(args, fed_model,
                               sampler=getattr(train_loader, "sampler",
                                               None))
+    # open-world population churn (--churn, docs/service.md): clients
+    # register/depart mid-run; the sampler draws from the live population
+    # and the disk-tier row store allocates/retires/compacts rows
+    pm = attach_churn(args, fed_model,
+                      sampler=getattr(train_loader, "sampler", None))
 
     lr_schedule = PiecewiseLinear([0, args.pivot_epoch, args.num_epochs],
                                   [0, args.lr_scale, 0])
@@ -496,6 +514,23 @@ def main(argv=None):
             a_expired = pc.expire_buffer() if pc.async_k else 0
             if a_expired and rt is not None:
                 rt.event("async_expired", count=a_expired)
+        if pm is not None:
+            # open-world conservation audit (docs/service.md): every
+            # client that ever registered is exactly one of active /
+            # departed / quarantined — cross-checked against the live
+            # mask AND the running counters, recorded so the whole churn
+            # story reproduces from the JSONL log alone
+            audit = pm.audit()
+            if rt is not None:
+                # churn records drawn after the last dispatched round
+                # (e.g. the departure that emptied the pool) have no
+                # begin_round left to relay them — flush here so the
+                # event totals match the audit's counters
+                for ev in pm.pop_events():
+                    rt.event(ev.pop("kind"), **ev)
+                rt.event("churn_audit", **audit)
+            if not audit["ok"]:
+                print(f"CHURN AUDIT FAILED: {audit}")
         tracer = getattr(fed_model, "tracer", None)
         if tracer is not None:
             # a capture window left open at run end stops here; its

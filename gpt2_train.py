@@ -45,7 +45,10 @@ from commefficient_tpu.federated.checkpoint import (
 )
 from commefficient_tpu.telemetry import attach_run_telemetry
 from commefficient_tpu.federated.losses import make_gpt2_losses
-from commefficient_tpu.federated.participation import attach_participation
+from commefficient_tpu.federated.participation import (
+    attach_churn,
+    attach_participation,
+)
 from commefficient_tpu.models.gpt2 import (
     GPT2DoubleHeads,
     load_hf_gpt2,
@@ -211,6 +214,12 @@ def run_batches(model, opt, lr_scheduler, loader, args, timer, training,
             consume(engine.drain())
         finally:
             prof.close()
+        if not losses and getattr(model, "_population", None) is not None:
+            # open-world end state (--churn, docs/service.md): the live
+            # population emptied before this epoch produced a single
+            # cohort and no joiner can ever refill it — a clean end of
+            # training, not a NaN trajectory
+            return None, client_download, client_upload
         return np.mean(losses), client_download, client_upload
 
     nlls, accs = [], []
@@ -244,12 +253,16 @@ def train_gpt2(model, opt, scheduler, train_loader, val_loader, args,
             epoch_fraction = args.num_epochs - epoch
         else:
             epoch_fraction = 1
-        _, download, upload = run_batches(
+        train_loss, download, upload = run_batches(
             model, opt, scheduler, train_loader, args, timer, training=True,
             epoch=epoch, epoch_fraction=epoch_fraction, logger=logger,
             writer=writer,
             resume_mid=(resume_mid if epoch == start_epoch else None),
             totals=(total_download, total_upload))
+        if train_loss is None:
+            print("ending training: live population is empty with no "
+                  "pending joiners (--churn open-world end state)")
+            break
         if epoch == 0:
             # download tracking valid in epoch 1 only (reference
             # gpt2_train.py:132-145)
@@ -476,6 +489,9 @@ def train(argv=None):
         pc = attach_participation(args, fed_model,
                                   sampler=getattr(train_loader, "sampler",
                                                   None))
+        # open-world population churn (--churn, docs/service.md)
+        pm = attach_churn(args, fed_model,
+                          sampler=getattr(train_loader, "sampler", None))
         # zero-sync telemetry plane (--telemetry, on by default): per-round
         # device metrics + the structured run event log under log_dir
         # (docs/observability.md; render with scripts/obs_report.py)
@@ -505,6 +521,20 @@ def train(argv=None):
                 a_expired = pc.expire_buffer() if pc.async_k else 0
                 if a_expired and rt is not None:
                     rt.event("async_expired", count=a_expired)
+            if pm is not None:
+                # open-world conservation audit (docs/service.md):
+                # registered == active + departed + quarantined, from
+                # the masks AND the counters, in the JSONL run log
+                audit = pm.audit()
+                if rt is not None:
+                    # flush churn records drawn after the last dispatched
+                    # round (no begin_round left to relay them), so the
+                    # event totals match the audit's counters
+                    for ev in pm.pop_events():
+                        rt.event(ev.pop("kind"), **ev)
+                    rt.event("churn_audit", **audit)
+                if not audit["ok"]:
+                    print(f"CHURN AUDIT FAILED: {audit}")
             tracer = getattr(fed_model, "tracer", None)
             if tracer is not None:
                 # a capture window left open at run end stops here; its

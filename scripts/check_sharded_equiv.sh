@@ -126,7 +126,21 @@
 #     finished + gave_up + in_flight) rendered from the log alone by
 #     obs_report --fleet (tests/test_packing.py — the real packed-vs-
 #     sequential cv_train drill with bit-identity is its @slow
-#     TestPackingBench leg / bench.py --run-cfg packing).
+#     TestPackingBench leg / bench.py --run-cfg packing);
+#   - the always-on service plane (docs/service.md): the --churn grammar
+#     + RowDirectory lifecycle (allocate/retire/compact with hole reuse
+#     as fresh zero state), the seeded PopulationManager trajectory
+#     (deterministic events + the registered == active + departed +
+#     quarantined conservation audit, bit-exact pop/* state round trip,
+#     spec-change warn), the loader's open-vs-closed-world pad-lane id,
+#     SnapshotTracker handoff over crafted checksummed run states
+#     (monotone model_version, torn-candidate skip, pin lease) with
+#     prune_run_states never GCing a pinned checkpoint, the
+#     ServingReplica request plane, and the obs_report Churn/Serving
+#     sections rebuilt from the JSONL alone (tests/test_service.py — the
+#     disk-tier churn e2e with mid-churn SIGKILL/resume bit-identity and
+#     the serving-interference bench leg are its @slow TestServiceE2E
+#     legs / bench.py --run-cfg serving).
 # Any extra args are passed through to pytest (e.g. -k bit_identical).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -139,5 +153,5 @@ exec env JAX_PLATFORMS=cpu \
     tests/test_participation.py tests/test_host_offload.py \
     tests/test_io_faults.py tests/test_integrity.py \
     tests/test_supervise.py tests/test_multihost.py \
-    tests/test_async.py tests/test_packing.py \
+    tests/test_async.py tests/test_packing.py tests/test_service.py \
     -q -m "not slow" -p no:cacheprovider "$@"

@@ -373,6 +373,110 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
                               default=None),
         }
 
+    # Open-world churn (--churn, federated/participation.py,
+    # docs/service.md §population churn): the population timeline rebuilt
+    # entirely from the relayed churn_* events + the end-of-run
+    # conservation audit + the run header — the same log-alone
+    # reproducibility drill as the participation section
+    # (tests/test_service.py compares these totals against the live
+    # PopulationManager's counters).
+    join_events = [e for e in events if e.get("ev") == "churn_join"]
+    depart_events = [e for e in events if e.get("ev") == "churn_depart"]
+    short_events = [e for e in events if e.get("ev") == "cohort_short"]
+    compact_events = [e for e in events
+                      if e.get("ev") == "rows_compacted"]
+    churn_audit_ev = next((e for e in reversed(events)
+                           if e.get("ev") == "churn_audit"), None)
+    churn = None
+    if (join_events or depart_events or churn_audit_ev
+            or run_info.get("churn")):
+        # events land in churn-clock order (the sampler steps the clock
+        # in-order on the main thread), so file order IS time order
+        pop_curve = [(e.get("churn_round"), e.get("population"))
+                     for e in events
+                     if e.get("ev") in ("churn_join", "churn_depart")]
+        pops = [pv for _, pv in pop_curve
+                if isinstance(pv, (int, float))]
+        churn = {
+            "schedule": run_info.get("churn"),
+            "joins": sum(len(e.get("clients", []))
+                         for e in join_events),
+            "departs": sum(len(e.get("clients", []))
+                           for e in depart_events),
+            "join_rounds": len(join_events),
+            "depart_rounds": len(depart_events),
+            "cohort_short": len(short_events),
+            "rows_retired": sum(e.get("rows", 0) for e in events
+                                if e.get("ev") == "rows_retired"),
+            "compactions": len(compact_events),
+            "rows_moved": sum(e.get("moved", 0)
+                              for e in compact_events),
+            "holes_reclaimed": sum(e.get("holes_reclaimed", 0)
+                                   for e in compact_events),
+            "population_first": pops[0] if pops else None,
+            "population_last": pops[-1] if pops else None,
+            "population_min": min(pops) if pops else None,
+            "population_max": max(pops) if pops else None,
+            # the acceptance audit: registered == active + departed +
+            # quarantined, cross-checked against the running counters
+            "audit": ({k: v for k, v in churn_audit_ev.items()
+                       if k not in ("ev", "t")}
+                      if churn_audit_ev else None),
+        }
+
+    # Serving replica (scripts/serve.py, docs/service.md §serving):
+    # rebuilt from <serve_dir>/serving.jsonl — point obs_report at that
+    # file directly (load_events takes a bare jsonl path). The monotone
+    # model_version check replays the chronological swap/answer stream,
+    # which is the e2e acceptance property.
+    serve_start = next((e for e in events
+                        if e.get("ev") == "serving_start"), None)
+    serve_stop = next((e for e in reversed(events)
+                       if e.get("ev") == "serving_stop"), None)
+    serve_swaps = [e for e in events if e.get("ev") == "serving_swap"]
+    answers = [e for e in events if e.get("ev") == "serving_answer"]
+    serving = None
+    if serve_start or serve_swaps or answers:
+        by_op: Dict[str, int] = {}
+        for e in answers:
+            op = str(e.get("op"))
+            by_op[op] = by_op.get(op, 0) + 1
+        stamps = [e["t"] for e in answers if "t" in e]
+        span = (max(stamps) - min(stamps)) if len(stamps) >= 2 else None
+        seq = [e.get("model_version") for e in events
+               if e.get("ev") in ("serving_swap", "serving_answer")
+               and isinstance(e.get("model_version"), int)]
+        lat = [e["latency_ms"] for e in answers
+               if isinstance(e.get("latency_ms"), (int, float))]
+        serving = {
+            "owner": (serve_start or {}).get("owner"),
+            "checkpoint_path": (serve_start or {}).get(
+                "checkpoint_path"),
+            "answers": len(answers),
+            "errors": len([e for e in answers if "error" in e]),
+            "by_op": by_op,
+            "qps": _fin(round(len(answers) / span, 3)
+                        if span else None),
+            "latency_ms_p50": _fin(_pct(lat, 0.5)),
+            "latency_ms_p90": _fin(_pct(lat, 0.9)),
+            "swaps": len(serve_swaps),
+            "swap_versions": [e.get("model_version")
+                              for e in serve_swaps],
+            "load_ms_p50": _fin(_pct([e["load_ms"] for e in serve_swaps
+                                      if "load_ms" in e], 0.5)),
+            "versions_monotone": all(a <= b for a, b
+                                     in zip(seq, seq[1:])),
+            "first_version": seq[0] if seq else None,
+            "final_version": seq[-1] if seq else None,
+            "clean_stop": serve_stop is not None,
+            # the replica's own terminal counters, kept alongside the
+            # reconstruction so a disagreement is visible in the tail
+            "reported": ({k: serve_stop.get(k) for k in
+                          ("answered", "errors", "swaps",
+                           "model_version")}
+                         if serve_stop else None),
+        }
+
     return {
         "log_rounds": len(rounds),
         "partial_rounds": len([e for e in events
@@ -438,6 +542,9 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         "alerts": alerts,
         "trace_captures": trace_captures,
         "supervisor": supervisor,
+        # always-on federation service (docs/service.md)
+        "churn": churn,
+        "serving": serving,
         "histograms": {
             "update": _hist_summary(rounds, "update_hist_"),
             "error": _hist_summary(rounds, "error_hist_"),
@@ -726,6 +833,67 @@ def render(events: List[dict], out=None) -> Dict[str, Any]:
               f"{e.get('backoff_s')}s backoff")
         for path in sup.get("poisoned") or []:
             p(f"- POISON checkpoint excluded: {path}")
+
+    ch = s.get("churn")
+    if ch:
+        p("\n## Open-world churn (--churn, docs/service.md)")
+        sched = ch.get("schedule")
+        if sched:
+            p(f"schedule: {sched.get('spec')} — join {sched.get('join')}"
+              f"/round, depart {sched.get('depart')}/round, "
+              f"init {sched.get('init')}, seed {sched.get('seed')}"
+              + (f", compact after {sched.get('compact')} hole(s)"
+                 if sched.get("compact") else ""))
+        p(f"{ch['joins']} join(s) over {ch['join_rounds']} round(s), "
+          f"{ch['departs']} depart(s) over {ch['depart_rounds']} "
+          f"round(s); live population {ch['population_first']} -> "
+          f"{ch['population_last']} "
+          f"(min {ch['population_min']} / max {ch['population_max']})")
+        if ch["cohort_short"]:
+            p(f"{ch['cohort_short']} cohort(s) clamped below the "
+              "participation target (churn shortfall, counted — "
+              "never silent)")
+        if ch["rows_retired"] or ch["compactions"]:
+            p(f"row lifecycle: {ch['rows_retired']} row(s) retired at "
+              f"drain barriers, {ch['compactions']} compaction(s) "
+              f"({ch['rows_moved']} row(s) moved, "
+              f"{ch['holes_reclaimed']} hole(s) reclaimed)")
+        a = ch.get("audit")
+        if a:
+            p(f"conservation: registered {a.get('registered')} == "
+              f"active {a.get('active')} + departed {a.get('departed')} "
+              f"+ quarantined {a.get('quarantined')} -> "
+              f"{'OK' if a.get('ok') else 'BROKEN'}"
+              + (f"  ({a.get('idle_rounds')} idle churn round(s) spun "
+                 "waiting for joiners)" if a.get("idle_rounds") else ""))
+        else:
+            p("no churn_audit event — run crashed, was killed, or is "
+              "still running")
+
+    sv = s.get("serving")
+    if sv:
+        p("\n## Serving replica (scripts/serve.py, docs/service.md)")
+        p(f"owner {sv.get('owner')} tracking "
+          f"{sv.get('checkpoint_path') or '?'}")
+        ops = ", ".join(f"{op}: {n}"
+                        for op, n in sorted(sv["by_op"].items()))
+        p(f"{sv['answers']} answer(s), {sv['errors']} error(s)"
+          + (f" — {ops}" if ops else ""))
+        if sv.get("qps") or sv.get("latency_ms_p50") is not None:
+            p(f"throughput ~{sv.get('qps')} answers/s, latency p50 "
+              f"{sv.get('latency_ms_p50')} ms / p90 "
+              f"{sv.get('latency_ms_p90')} ms")
+        mono = ("monotone" if sv["versions_monotone"]
+                else "NON-MONOTONE (BROKEN)")
+        p(f"{sv['swaps']} hot swap(s) "
+          f"(weights load p50 {sv.get('load_ms_p50')} ms): "
+          f"model_version {sv.get('first_version')} -> "
+          f"{sv.get('final_version')}, {mono} across swaps")
+        if sv["swap_versions"]:
+            p(f"- swap versions: {sv['swap_versions']}")
+        if not sv["clean_stop"]:
+            p("no serving_stop event — replica crashed, was killed, or "
+              "is still serving")
 
     p("\n## Guard / rollback history")
     if not s["guards"]:

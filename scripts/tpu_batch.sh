@@ -103,6 +103,13 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 # packed-fleet A/B stays CPU-only because the single axon chip serializes
 # tenant claims (bench.py --run-cfg packing is the gated CPU leg). Cheap
 # add-on: no heavy compile class, rides any window.
+# NOTE (service PR): the serving_ab step prices the serving replica's
+# snapshot handoff ON SILICON (checksummed run_state weights-only load,
+# file-queue query round trip, hot swap under load — docs/service.md);
+# the trainer-interference + bit-identity A/B stays CPU-only for the
+# same one-chip-serializes-tenants reason as packing (bench.py
+# --run-cfg serving is the gated CPU leg). Cheap add-on: no heavy
+# compile class, rides any window.
 # NOTE (multihost PR): the multihost capture + multihost_ab A/B (the 2D
 # clients x shard server plane under the per-mesh-axis quantized plan
 # vs the fp32 plan — docs/multihost.md) need >= 4 devices, so they wait
@@ -112,7 +119,7 @@ mark_done() { echo "$1" >>"$STATE"; log "step '$1' recorded as DONE"; }
 STEPS=${*:-"bench gpt2_bf16 gpt2_f32 c4 c1 c2 shard fused guards stream \
 coalesce telemetry watch downlink straggler async clients_sweep io_faults \
 integrity participation host_offload_scale watch_ab io_faults_ab \
-integrity_ab async_ab packing_ab multihost multihost_ab \
+integrity_ab async_ab packing_ab serving_ab multihost multihost_ab \
 compressed_collectives stream_sketch sketch_coalesce fused_epilogue \
 learning profile profile_fused profile_stream profile_coalesce \
 profile_gpt2 host_offload imagenet ops"}
@@ -306,6 +313,23 @@ for step in $STEPS; do
           && grep -q "packing A/B:" "$OUT/tpu_measure_packing.log"
       then
         mark_done packing_ab
+      fi
+      ;;
+    serving_ab)
+      # serving replica snapshot-handoff pricing (docs/service.md):
+      # checksummed run_state weights-only swap, file-queue query round
+      # trip, and a hot swap under load with the monotone-version assert
+      # — the on-silicon price of what scripts/serve.py does per poll
+      log "step $i: tpu_measure.py serving handoff pricing (timeout 20m)"
+      timeout 1200 python scripts/tpu_measure.py serving \
+        >"$OUT/tpu_measure_serving.log" 2>&1
+      rc=$?
+      log "step $i rc=$rc (see $OUT/tpu_measure_serving.log)"
+      if [ $rc -eq 0 ] \
+          && grep -q "serving hot swap + answer under load:" \
+            "$OUT/tpu_measure_serving.log"
+      then
+        mark_done serving_ab
       fi
       ;;
     multihost_ab)

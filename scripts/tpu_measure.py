@@ -961,6 +961,88 @@ def packing_leg():
         shutil.rmtree(cache, ignore_errors=True)
 
 
+def serving_leg():
+    """Live serving replica (docs/service.md): price the snapshot
+    handoff on this host — the weights-only, checksum-verified load of a
+    d=6.5M run state (the hot-swap cost), the pin-lease I/O around it,
+    and a ``query`` answer against the loaded weights. (The full
+    trainer-interference A/B runs on CPU in bench.py --run-cfg serving —
+    same one-process-per-chip-claim reasoning as the packing leg; this
+    is the per-swap / per-answer number that story rests on.)"""
+    import json as _json
+    import shutil
+    import tempfile
+    import time as _time
+    import zlib as _zlib
+
+    from commefficient_tpu.federated.serving import (
+        ServingReplica,
+        read_response,
+        submit_request,
+    )
+
+    D = 6_568_640
+    work = tempfile.mkdtemp(prefix="serving_leg_")
+    ckpt = os.path.join(work, "ckpt")
+    serve = os.path.join(work, "serve")
+    os.makedirs(ckpt)
+
+    def write_state(rounds, seed):
+        # a real run_state's serving-relevant shape: flat ps_weights +
+        # checksummed meta (checkpoint._content_checksum contract)
+        w = np.random.RandomState(seed).standard_normal(D) \
+            .astype(np.float32)
+        crc = _zlib.crc32("ps_weights".encode())
+        crc = _zlib.crc32(str(w.dtype).encode(), crc)
+        crc = _zlib.crc32(np.ascontiguousarray(w), crc)
+        meta = {"checksum": crc, "rounds_dispatched": rounds}
+        path = os.path.join(ckpt, f"run_state_ep1_r{rounds}.npz")
+        np.savez(path, ps_weights=w,
+                 meta_json=np.frombuffer(
+                     _json.dumps(meta).encode(), np.uint8))
+        return path
+
+    try:
+        write_state(8, seed=0)
+        replica = ServingReplica(ckpt, serve, owner="tpu_measure")
+        t0 = _time.perf_counter()
+        replica.step()  # discovery + first weights load
+        load_s = _time.perf_counter() - t0
+        assert replica.tracker.version == 8, (
+            f"tracker loaded version {replica.tracker.version}, want 8")
+        print(f"serving swap (d={D / 1e6:.1f}M weights, checksummed "
+              f"npz): {load_s * 1e3:.1f} ms", flush=True)
+
+        lats = []
+        for i in range(20):
+            rid = submit_request(serve, op="query", probe_seed=i)
+            t0 = _time.perf_counter()
+            replica.step()
+            lats.append(_time.perf_counter() - t0)
+            resp = read_response(serve, rid, timeout=5, poll=0.005)
+            assert resp["model_version"] == 8, resp
+        lats.sort()
+        print(f"serving query answer (file queue round trip): p50 "
+              f"{lats[len(lats) // 2] * 1e3:.1f} ms over {len(lats)} "
+              f"queries", flush=True)
+
+        write_state(16, seed=1)  # training advanced: hot swap mid-serve
+        rid = submit_request(serve, op="query", probe_seed=0)
+        t0 = _time.perf_counter()
+        replica.step()
+        swap_s = _time.perf_counter() - t0
+        resp = read_response(serve, rid, timeout=5, poll=0.005)
+        assert resp["model_version"] == 16, (
+            f"answer after hot swap served version "
+            f"{resp['model_version']}, want 16 (monotone handoff)")
+        print(f"serving hot swap + answer under load: "
+              f"{swap_s * 1e3:.1f} ms (version 8 -> 16, monotone)",
+              flush=True)
+        replica.close()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def gpt2_leg(bf16):
     steps, ps, ss, cs, batch, tokens = B.build_gpt2(bf16=bf16)
     # train_step donates ps/client_states: after this call the local
@@ -1055,7 +1137,7 @@ def main():
              "fused_epilogue", "stream_sketch", "sketch_coalesce",
              "compressed_collectives", "participation",
              "host_offload_scale", "watch", "io_faults", "integrity",
-             "multihost", "async", "packing"}
+             "multihost", "async", "packing", "serving"}
     want = set(sys.argv[1:])
     unknown = want - known
     if unknown:
@@ -1109,6 +1191,8 @@ def main():
         leg("integrity", integrity_leg)
     if sel("packing"):
         leg("packing", packing_leg)
+    if sel("serving"):
+        leg("serving", serving_leg)
 
 
 if __name__ == "__main__":
